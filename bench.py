@@ -1,0 +1,85 @@
+"""Benchmark driver: trains the reference's headline Transformer benchmark
+config (examples/cpp/Transformer defaults: hidden 1024, 16 heads, 12 layers,
+seq 512; batch 8 per scripts/osdi22ae/bert.sh) and prints ONE JSON line with
+per-chip training throughput.
+
+Runs on whatever jax.devices() provides (one real TPU chip under the driver).
+Mixed precision (bf16 compute, f32 master weights) is on — the TPU-native
+equivalent of the reference's f32 cuDNN path, since bf16 is the MXU's native
+input type.
+"""
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from flexflow_tpu import (
+        FFConfig,
+        FFModel,
+        LossType,
+        MetricsType,
+        SGDOptimizer,
+    )
+    from flexflow_tpu.models.transformer import build_transformer
+
+    batch = 8
+    seq, hidden, heads, layers = 512, 1024, 16, 12
+
+    cfg = FFConfig()
+    cfg.batch_size = batch
+    cfg.allow_mixed_precision = True
+    model = FFModel(cfg)
+    build_transformer(
+        model,
+        batch_size=batch,
+        seq_length=seq,
+        hidden_size=hidden,
+        num_heads=heads,
+        num_layers=layers,
+    )
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR],
+    )
+    ex = model.executor
+    step = ex.build_train_step()
+    in_pt = ex.input_pts[0]
+    rng = np.random.RandomState(0)
+    x = ex.shard_batch(in_pt, rng.randn(*in_pt.material_shape()).astype(np.float32))
+    y = jax.numpy.asarray(rng.randn(*in_pt.material_shape()).astype(np.float32))
+    key = jax.random.PRNGKey(0)
+
+    state = model.state
+    # warmup (compile)
+    for _ in range(3):
+        state, partials = step(state, [x], y, key)
+    jax.block_until_ready(state.params)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, partials = step(state, [x], y, key)
+    jax.block_until_ready(state.params)
+    elapsed = time.perf_counter() - t0
+
+    n_chips = max(1, len(jax.devices()))
+    samples_per_sec_per_chip = batch * iters / elapsed / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "transformer_train_throughput",
+                "value": round(samples_per_sec_per_chip, 3),
+                "unit": "samples/s/chip",
+                "vs_baseline": None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

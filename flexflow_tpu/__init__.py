@@ -1,0 +1,38 @@
+"""flexflow_tpu: a TPU-native deep-learning framework with the capabilities
+of FlexFlow (automatic discovery of distributed parallelization strategies),
+re-designed for JAX/XLA/Pallas on TPU device meshes.
+
+Public API mirrors the reference's Python surface
+(python/flexflow/core/flexflow_cffi.py) so reference model scripts port with
+trivial edits:
+
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer, ...
+"""
+from .config import FFConfig, FFIterationConfig  # noqa: F401
+from .core.dataloader import SingleDataLoader  # noqa: F401
+from .core.initializers import (  # noqa: F401
+    ConstantInitializer,
+    GlorotUniformInitializer,
+    Initializer,
+    NormInitializer,
+    OneInitializer,
+    UniformInitializer,
+    ZeroInitializer,
+)
+from .core.metrics import Metrics, PerfMetrics  # noqa: F401
+from .core.model import FFModel  # noqa: F401
+from .core.optimizers import AdamOptimizer, Optimizer, SGDOptimizer  # noqa: F401
+from .core.tensor import Layer, Tensor  # noqa: F401
+from .ff_types import (  # noqa: F401
+    ActiMode,
+    AggrMode,
+    CompMode,
+    DataType,
+    LossType,
+    MetricsType,
+    OperatorType,
+    ParameterSyncType,
+    PoolType,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,172 @@
+"""Runtime configuration.
+
+TPU-native analogue of the reference FFConfig (include/flexflow/config.h:92-160,
+parse_args src/runtime/model.cc:3556). Instead of Legion `-ll:gpu` worker
+counts, we describe a TPU mesh: number of chips visible to this process plus a
+logical multi-host topology for the strategy search. Flags keep the reference's
+spellings so reference launch scripts port over directly.
+"""
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import List, Optional
+
+import jax
+
+from .ff_types import CompMode
+
+
+@dataclasses.dataclass
+class FFConfig:
+    """Global run configuration.
+
+    Mirrors reference config.h:92-160 field-for-field where meaningful on TPU;
+    `workersPerNode` counts TPU chips instead of GPUs.
+    """
+
+    epochs: int = 1
+    batch_size: int = 64
+    numNodes: int = 1
+    workersPerNode: int = 0  # 0 = all visible devices
+    cpusPerNode: int = 1
+    learning_rate: float = 0.01
+    weight_decay: float = 0.0001
+    # Strategy-search knobs (reference config.h:128-160)
+    search_budget: int = -1
+    search_alpha: float = 1.2
+    search_overlap_backward_update: bool = False
+    computationMode: CompMode = CompMode.COMP_MODE_TRAINING
+    only_data_parallel: bool = False
+    enable_sample_parallel: bool = True
+    enable_parameter_parallel: bool = False
+    enable_attribute_parallel: bool = False
+    enable_inplace_optimizations: bool = False
+    # TPU addition: sequence/context parallelism as a first-class strategy
+    enable_sequence_parallel: bool = False
+    # Manual strategy degrees (no-search path). data_parallel_degree 0 =
+    # fill remaining devices. The Unity search overrides these.
+    tensor_parallel_degree: int = 1
+    sequence_parallel_degree: int = 1
+    expert_parallel_degree: int = 1
+    # bf16 compute with f32 master weights (TPU-native mixed precision).
+    # Off by default so numerical-alignment tests match f32 references;
+    # benchmarks turn it on.
+    allow_mixed_precision: bool = False
+    simulator_work_space_size: int = 64 * 1024 * 1024
+    search_num_nodes: int = -1
+    search_num_workers: int = -1
+    base_optimize_threshold: int = 10
+    enable_control_replication: bool = True
+    python_data_loader_type: int = 2
+    perform_fusion: bool = False
+    profiling: bool = False
+    export_strategy_file: str = ""
+    import_strategy_file: str = ""
+    export_strategy_computation_graph_file: str = ""
+    substitution_json_path: Optional[str] = None
+    machine_model_version: int = 0
+    machine_model_file: str = ""
+    simulator_segment_size: int = 16777216
+    simulator_max_num_segments: int = 1
+    enable_propagation: bool = False
+    perform_memory_search: bool = False
+    device_mem: int = 0  # bytes of HBM per chip for the memory-aware search
+    seed: int = 0
+    iterations: int = 1
+
+    def __post_init__(self):
+        if self.workersPerNode == 0:
+            try:
+                self.workersPerNode = max(1, jax.local_device_count())
+            except Exception:  # pragma: no cover - no backend at all
+                self.workersPerNode = 1
+        argv = sys.argv[1:]
+        if argv:
+            self.parse_args(argv)
+
+    # -- reference: model.cc:3556 parse_args ------------------------------
+    def parse_args(self, argv: List[str]) -> None:
+        i = 0
+        take = lambda: argv[i + 1]  # noqa: E731
+        while i < len(argv):
+            a = argv[i]
+            try:
+                if a in ("-e", "--epochs"):
+                    self.epochs = int(take()); i += 1
+                elif a in ("-b", "--batch-size"):
+                    self.batch_size = int(take()); i += 1
+                elif a == "--lr" or a == "-lr":
+                    self.learning_rate = float(take()); i += 1
+                elif a == "--wd" or a == "-wd":
+                    self.weight_decay = float(take()); i += 1
+                elif a in ("-p", "--print-freq"):
+                    i += 1
+                elif a in ("-ll:gpu", "-ll:tpu"):
+                    self.workersPerNode = int(take()); i += 1
+                elif a == "-ll:cpu":
+                    self.cpusPerNode = int(take()); i += 1
+                elif a == "--nodes":
+                    self.numNodes = int(take()); i += 1
+                elif a == "--budget" or a == "--search-budget":
+                    self.search_budget = int(take()); i += 1
+                elif a == "--alpha" or a == "--search-alpha":
+                    self.search_alpha = float(take()); i += 1
+                elif a == "--only-data-parallel":
+                    self.only_data_parallel = True
+                elif a == "--enable-parameter-parallel":
+                    self.enable_parameter_parallel = True
+                elif a == "--enable-attribute-parallel":
+                    self.enable_attribute_parallel = True
+                elif a == "--enable-sequence-parallel":
+                    self.enable_sequence_parallel = True
+                elif a == "--fusion":
+                    self.perform_fusion = True
+                elif a == "--profiling":
+                    self.profiling = True
+                elif a == "--search-num-nodes":
+                    self.search_num_nodes = int(take()); i += 1
+                elif a == "--search-num-workers":
+                    self.search_num_workers = int(take()); i += 1
+                elif a == "--export" or a == "--export-strategy":
+                    self.export_strategy_file = take(); i += 1
+                elif a == "--import" or a == "--import-strategy":
+                    self.import_strategy_file = take(); i += 1
+                elif a == "--memory-search":
+                    self.perform_memory_search = True
+                elif a == "--machine-model-version":
+                    self.machine_model_version = int(take()); i += 1
+                elif a == "--machine-model-file":
+                    self.machine_model_file = take(); i += 1
+                elif a == "--substitution-json":
+                    self.substitution_json_path = take(); i += 1
+                elif a == "--simulator-workspace-size":
+                    self.simulator_work_space_size = int(take()); i += 1
+                elif a == "--iterations":
+                    self.iterations = int(take()); i += 1
+                # silently skip unknown flags (Legion-style passthrough)
+            except (IndexError, ValueError):
+                pass
+            i += 1
+
+    @property
+    def numWorkers(self) -> int:
+        """Total chips in the (possibly hypothetical) machine."""
+        if self.search_num_nodes > 0 and self.search_num_workers > 0:
+            return self.search_num_nodes * self.search_num_workers
+        return self.numNodes * self.workersPerNode
+
+    def get_current_time(self) -> float:
+        import time
+
+        return time.time() * 1e6  # microseconds, like Realm::Clock
+
+
+@dataclasses.dataclass
+class FFIterationConfig:
+    """Per-iteration config (reference: config.h:162-167)."""
+
+    seq_length: int = -1
+
+    def reset(self):
+        self.seq_length = -1

@@ -1,0 +1,41 @@
+"""SingleDataLoader: batched feeding of a full in-memory dataset.
+
+TPU-native equivalent of the reference SingleDataLoader
+(python/flexflow/core/flexflow_cffi.py:2447 + python/flexflow_dataloader.cc):
+the reference keeps the full dataset in zero-copy host memory and launches
+per-batch index tasks to copy each GPU's shard (PY_DL_* tasks, model.h:
+168-176). Here the full array lives in host RAM and next_batch() device_puts
+the batch with the input tensor's NamedSharding — each TPU chip receives
+exactly its shard, the same data path without the task machinery.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class SingleDataLoader:
+    def __init__(self, ffmodel, batch_tensor, full_array: np.ndarray, num_samples: Optional[int] = None):
+        self.model = ffmodel
+        self.batch_tensor = batch_tensor
+        self.full_array = np.asarray(full_array)
+        self.num_samples = num_samples or self.full_array.shape[0]
+        self.batch_size = batch_tensor.dims[0]
+        self.next_index = 0
+
+    @property
+    def num_batches(self) -> int:
+        return self.num_samples // self.batch_size
+
+    def reset(self):
+        self.next_index = 0
+
+    def next_batch(self, ffmodel=None) -> np.ndarray:
+        i = self.next_index
+        b = self.batch_size
+        if i + b > self.num_samples:
+            i = 0
+        batch = self.full_array[i : i + b]
+        self.next_index = i + b
+        return batch

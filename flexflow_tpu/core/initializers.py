@@ -1,0 +1,107 @@
+"""Weight initializers.
+
+TPU-native equivalents of reference src/runtime/initializer.cc (349 LoC) +
+initializer_kernel.cu (curand kernels): each initializer is a pure function of
+a PRNGKey, applied per weight at compile time (the reference launches a Legion
+task per weight partition).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Initializer:
+    """Base (reference: include/flexflow/initializer.h:21)."""
+
+    def __call__(self, key, shape, dtype):
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class GlorotUniformInitializer(Initializer):
+    """reference: initializer.h GlorotUniform; matches Keras glorot_uniform."""
+
+    seed: int = 0
+
+    def __call__(self, key, shape, dtype):
+        if len(shape) >= 2:
+            # fan layout conventions: Linear (in, out); Conv OIHW
+            if len(shape) == 4:  # OIHW conv kernel
+                receptive = shape[2] * shape[3]
+                fan_in, fan_out = shape[1] * receptive, shape[0] * receptive
+            else:
+                fan_in = int(np.prod(shape[:-1]))
+                fan_out = shape[-1]
+        else:
+            fan_in = fan_out = shape[0] if shape else 1
+        limit = float(np.sqrt(6.0 / max(1, fan_in + fan_out)))
+        return jax.random.uniform(key, shape, jnp.float32, -limit, limit).astype(dtype)
+
+
+@dataclasses.dataclass
+class ZeroInitializer(Initializer):
+    def __call__(self, key, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+@dataclasses.dataclass
+class OneInitializer(Initializer):
+    def __call__(self, key, shape, dtype):
+        return jnp.ones(shape, dtype)
+
+
+@dataclasses.dataclass
+class ConstantInitializer(Initializer):
+    value: float = 0.0
+
+    def __call__(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+@dataclasses.dataclass
+class UniformInitializer(Initializer):
+    seed: int = 0
+    min_value: float = 0.0
+    max_value: float = 1.0
+
+    def __call__(self, key, shape, dtype):
+        return jax.random.uniform(
+            key, shape, jnp.float32, self.min_value, self.max_value
+        ).astype(dtype)
+
+
+@dataclasses.dataclass
+class NormInitializer(Initializer):
+    seed: int = 0
+    mean: float = 0.0
+    stddev: float = 1.0
+
+    def __call__(self, key, shape, dtype):
+        return (
+            self.mean + self.stddev * jax.random.normal(key, shape, jnp.float32)
+        ).astype(dtype)
+
+
+_BY_NAME = {
+    "glorot_uniform": GlorotUniformInitializer(),
+    "zero": ZeroInitializer(),
+    "zeros": ZeroInitializer(),
+    "one": OneInitializer(),
+    "ones": OneInitializer(),
+    "uniform": UniformInitializer(),
+    "normal": NormInitializer(),
+    "norm": NormInitializer(),
+}
+
+
+def get_initializer(spec) -> Initializer:
+    if isinstance(spec, Initializer):
+        return spec
+    if isinstance(spec, str):
+        return _BY_NAME[spec]
+    raise TypeError(f"bad initializer spec {spec!r}")

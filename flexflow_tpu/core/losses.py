@@ -1,0 +1,76 @@
+"""Loss functions.
+
+TPU-native equivalents of reference src/loss_functions/ (214 cc + 141 cu):
+categorical CE, sparse categorical CE, MSE (avg/sum reduce), identity. The
+reference hand-writes logit-gradient kernels (LOSS_BWD_TASK); here each loss
+is a scalar-valued jnp function and jax.grad produces the same gradients
+(including the reference's scale factor handling for replicas, which is
+subsumed by mean-reduction over the global batch).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ff_types import LossType
+
+
+def categorical_crossentropy(logits_or_probs, labels):
+    """Labels are one-hot/probabilities (reference: loss expects label tensor
+    matching logit shape). The final Softmax op produces probs, so we take
+    log of probs like the reference's CE-from-softmax backward."""
+    p = jnp.clip(logits_or_probs.astype(jnp.float32), 1e-12, 1.0)
+    return -jnp.mean(jnp.sum(labels.astype(jnp.float32) * jnp.log(p), axis=-1))
+
+
+def sparse_categorical_crossentropy(probs, labels):
+    """Labels are int class ids with shape (..., 1) or (...)."""
+    if labels.ndim == probs.ndim:
+        labels = labels[..., 0]
+    p = jnp.clip(probs.astype(jnp.float32), 1e-12, 1.0)
+    logp = jnp.log(p)
+    nll = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)
+    return jnp.mean(nll)
+
+
+def mean_squared_error_avg(preds, labels):
+    d = preds.astype(jnp.float32) - labels.astype(jnp.float32)
+    return jnp.mean(jnp.sum(d * d, axis=-1))
+
+
+def mean_squared_error_sum(preds, labels):
+    d = preds.astype(jnp.float32) - labels.astype(jnp.float32)
+    return jnp.sum(d * d)
+
+
+def identity_loss(preds, labels):
+    """reference: LOSS_IDENTITY — the model output *is* the loss."""
+    return jnp.mean(preds.astype(jnp.float32))
+
+
+_LOSS_FNS = {
+    LossType.LOSS_CATEGORICAL_CROSSENTROPY: categorical_crossentropy,
+    LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY: sparse_categorical_crossentropy,
+    LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE: mean_squared_error_avg,
+    LossType.LOSS_MEAN_SQUARED_ERROR_SUM_REDUCE: mean_squared_error_sum,
+    LossType.LOSS_IDENTITY: identity_loss,
+}
+
+_BY_NAME = {
+    "categorical_crossentropy": LossType.LOSS_CATEGORICAL_CROSSENTROPY,
+    "sparse_categorical_crossentropy": LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+    "mse": LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+    "mean_squared_error_sum": LossType.LOSS_MEAN_SQUARED_ERROR_SUM_REDUCE,
+    "identity": LossType.LOSS_IDENTITY,
+}
+
+
+def to_loss_type(spec) -> LossType:
+    if isinstance(spec, LossType):
+        return spec
+    return _BY_NAME[spec]
+
+
+def get_loss_fn(loss_type) -> callable:
+    return _LOSS_FNS[to_loss_type(loss_type)]

@@ -1,0 +1,121 @@
+"""Metrics.
+
+TPU-native equivalents of reference src/metrics_functions/ (249 cc + 185 cu):
+accuracy, categorical CE, sparse categorical CE, MSE, RMSE, MAE. The reference
+computes per-batch partial metrics on-device (METRICS_COMP_TASK) and folds
+them into a PerfMetrics accumulator on the CPU via chained Legion futures
+(UPDATE_METRICS_TASK, model.cc:2401-2407); here the per-batch partials are a
+jnp dict computed inside the jitted step and the fold is PerfMetrics.update.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Sequence
+
+import jax.numpy as jnp
+
+from ..ff_types import LossType, MetricsType
+from . import losses
+
+
+_BY_NAME = {
+    "accuracy": MetricsType.METRICS_ACCURACY,
+    "categorical_crossentropy": MetricsType.METRICS_CATEGORICAL_CROSSENTROPY,
+    "sparse_categorical_crossentropy": MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": MetricsType.METRICS_MEAN_SQUARED_ERROR,
+    "root_mean_squared_error": MetricsType.METRICS_ROOT_MEAN_SQUARED_ERROR,
+    "mean_absolute_error": MetricsType.METRICS_MEAN_ABSOLUTE_ERROR,
+}
+
+
+def to_metrics_type(spec) -> MetricsType:
+    if isinstance(spec, MetricsType):
+        return spec
+    return _BY_NAME[spec]
+
+
+class Metrics:
+    """Per-batch metric computation (reference: metrics_functions.h:27-43)."""
+
+    def __init__(self, loss_type: LossType, metrics: Sequence):
+        self.loss_type = loss_type
+        self.measures: List[MetricsType] = [to_metrics_type(m) for m in metrics]
+
+    def compute(self, preds, labels) -> Dict[str, jnp.ndarray]:
+        """Returns summed (not averaged) partials + count, for exact folding
+        across batches like the reference PerfMetrics."""
+        out: Dict[str, jnp.ndarray] = {}
+        b = preds.shape[0]
+        out["num_samples"] = jnp.asarray(b, jnp.float32)
+        pf = preds.astype(jnp.float32)
+        lf = labels.astype(jnp.float32) if labels.dtype != jnp.int32 else labels
+        for m in self.measures:
+            if m == MetricsType.METRICS_ACCURACY:
+                pred_cls = jnp.argmax(pf, axis=-1)
+                one_hot = (
+                    labels.ndim == preds.ndim
+                    and labels.shape[-1] == preds.shape[-1]
+                    and not jnp.issubdtype(labels.dtype, jnp.integer)
+                )
+                if one_hot:
+                    true_cls = jnp.argmax(lf, axis=-1)
+                else:
+                    true_cls = labels.reshape(pred_cls.shape).astype(pred_cls.dtype)
+                out["train_correct"] = jnp.sum(
+                    (pred_cls == true_cls).astype(jnp.float32)
+                )
+            elif m == MetricsType.METRICS_CATEGORICAL_CROSSENTROPY:
+                out["cce_loss"] = b * losses.categorical_crossentropy(preds, labels)
+            elif m == MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY:
+                out["sparse_cce_loss"] = b * losses.sparse_categorical_crossentropy(
+                    preds, labels
+                )
+            elif m == MetricsType.METRICS_MEAN_SQUARED_ERROR:
+                d = pf - lf
+                out["mse_loss"] = jnp.sum(jnp.mean(d * d, axis=-1))
+            elif m == MetricsType.METRICS_ROOT_MEAN_SQUARED_ERROR:
+                d = pf - lf
+                out["rmse_loss"] = jnp.sum(jnp.sqrt(jnp.mean(d * d, axis=-1)))
+            elif m == MetricsType.METRICS_MEAN_ABSOLUTE_ERROR:
+                out["mae_loss"] = jnp.sum(jnp.mean(jnp.abs(pf - lf), axis=-1))
+        return out
+
+
+@dataclasses.dataclass
+class PerfMetrics:
+    """Accumulator (reference: metrics_functions.h:44-80 PerfMetrics)."""
+
+    train_all: int = 0
+    train_correct: int = 0
+    cce_loss: float = 0.0
+    sparse_cce_loss: float = 0.0
+    mse_loss: float = 0.0
+    rmse_loss: float = 0.0
+    mae_loss: float = 0.0
+    start_time: float = dataclasses.field(default_factory=time.time)
+
+    def update(self, partials: Dict[str, float]):
+        self.train_all += int(partials.get("num_samples", 0))
+        self.train_correct += int(partials.get("train_correct", 0))
+        for k in ("cce_loss", "sparse_cce_loss", "mse_loss", "rmse_loss", "mae_loss"):
+            if k in partials:
+                setattr(self, k, getattr(self, k) + float(partials[k]))
+
+    def get_accuracy(self) -> float:
+        return 100.0 * self.train_correct / max(1, self.train_all)
+
+    def report(self) -> str:
+        """reference: PerfMetrics::print"""
+        elapsed = time.time() - self.start_time
+        tp = self.train_all / elapsed if elapsed > 0 else 0.0
+        parts = [f"throughput: {tp:.2f} samples/s"]
+        if self.train_all:
+            parts.append(f"accuracy: {self.get_accuracy():.2f}% ({self.train_correct}/{self.train_all})")
+            if self.sparse_cce_loss:
+                parts.append(f"sparse_cce: {self.sparse_cce_loss / self.train_all:.4f}")
+            if self.cce_loss:
+                parts.append(f"cce: {self.cce_loss / self.train_all:.4f}")
+            if self.mse_loss:
+                parts.append(f"mse: {self.mse_loss / self.train_all:.4f}")
+        return "[Metrics] " + " ".join(parts)

@@ -1,0 +1,832 @@
+"""FFModel: the user-facing model container and training driver.
+
+TPU-native equivalent of the reference FFModel (include/flexflow/model.h:326,
+src/runtime/model.cc:1160-3700) and its Python mirror
+(python/flexflow/core/flexflow_cffi.py:883). API-call-for-API-call compatible:
+each op method creates a deferred Layer; `compile()` lowers Layer graph → PCG,
+applies/searches a parallelization strategy, and builds the jitted SPMD train
+step; `fit()` runs the training loop (reference: flexflow_cffi.py:2058-2102
+begin_trace → next_batch → forward → zero_gradients → backward → update →
+end_trace — here one fused jitted step per iteration).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import FFConfig, FFIterationConfig
+from ..ff_types import (
+    ActiMode,
+    AggrMode,
+    CompMode,
+    DataType,
+    LossType,
+    MetricsType,
+    OperatorType,
+    PoolType,
+)
+from ..ops.attention import MultiHeadAttentionParams
+from ..ops.batch_matmul import BatchMatmulParams
+from ..ops.conv2d import Conv2DParams
+from ..ops.dropout import DropoutParams
+from ..ops.elementwise import ElementBinaryParams, ElementUnaryParams
+from ..ops.embedding import EmbeddingParams
+from ..ops.linear import LinearParams
+from ..ops.moe import AggregateParams, AggregateSpecParams, CacheParams, GroupByParams
+from ..ops.normalization import BatchNormParams, LayerNormParams
+from ..ops.pool2d import Pool2DParams
+from ..ops.reduce import ReduceParams, TopKParams
+from ..ops.registry import get_op_def
+from ..ops.softmax import SoftmaxParams
+from ..ops.tensor_ops import (
+    CastParams,
+    ConcatParams,
+    FlatParams,
+    GatherParams,
+    NoOpParams,
+    PadParams,
+    ReshapeParams,
+    ReverseParams,
+    SliceParams,
+    SplitParams,
+    TransposeParams,
+)
+from ..parallel import strategies
+from ..parallel.executor import PCGExecutor, TrainState
+from ..parallel.mesh import build_mesh
+from ..pcg.lowering import layers_to_pcg
+from .losses import to_loss_type
+from .metrics import Metrics, PerfMetrics
+from .optimizers import AdamOptimizer, Optimizer, SGDOptimizer
+from .tensor import Layer, Tensor
+
+
+class FFModel:
+    """reference: model.h:326 FFModel / flexflow_cffi.py:883."""
+
+    def __init__(self, ffconfig: Optional[FFConfig] = None):
+        self.config = ffconfig or FFConfig()
+        self.layers: List[Layer] = []
+        self.input_tensors: List[Tensor] = []
+        self.label_tensor: Optional[Tensor] = None
+        self.optimizer: Optional[Optimizer] = None
+        self.iter_config = FFIterationConfig()
+        # compile products
+        self.graph = None
+        self.executor: Optional[PCGExecutor] = None
+        self.state: Optional[TrainState] = None
+        self.metrics_obj: Optional[Metrics] = None
+        self.perf_metrics = PerfMetrics()
+        self.loss_type: Optional[LossType] = None
+        self.comp_mode = CompMode.COMP_MODE_TRAINING
+        self._tensor_map: Dict[int, int] = {}
+        self._pt_by_guid: Dict[int, object] = {}
+        self._current_batch: Optional[Tuple] = None
+        self._pending_grads = None
+        self._dataloaders: List[object] = []
+        self._rng = jax.random.PRNGKey(self.config.seed)
+
+    # ------------------------------------------------------------------
+    # Graph building (reference: FFModel::create_tensor, model.cc)
+    # ------------------------------------------------------------------
+    def create_tensor(
+        self,
+        dims: Sequence[int],
+        dtype: DataType = DataType.DT_FLOAT,
+        create_grad: bool = True,
+        name: str = "",
+    ) -> Tensor:
+        t = Tensor(tuple(dims), _to_dt(dtype), create_gradients=create_grad, name=name)
+        t._model = self
+        self.input_tensors.append(t)
+        return t
+
+    def _add_layer(
+        self,
+        op_type: OperatorType,
+        params,
+        inputs: List[Tensor],
+        name: str = "",
+        initializers: Optional[Dict[str, object]] = None,
+    ) -> Union[Tensor, List[Tensor]]:
+        layer = Layer(op_type, params, inputs, name=name)
+        if initializers:
+            layer.initializers.update(
+                {k: v for k, v in initializers.items() if v is not None}
+            )
+        opdef = get_op_def(op_type)
+        in_shapes = [t.dims for t in inputs]
+        in_dtypes = [t.data_type for t in inputs]
+        out_shapes, out_dtypes = opdef.infer(params, in_shapes, in_dtypes)
+        for i, (s, dt) in enumerate(zip(out_shapes, out_dtypes)):
+            out = Tensor(s, dt, owner_layer=layer, owner_idx=i)
+            out._model = self
+            layer.outputs.append(out)
+        # expose weight tensors for get/set_weights parity
+        for spec in opdef.weights(params, in_shapes, in_dtypes):
+            wt = Tensor(spec.shape, spec.dtype, owner_layer=layer, name=spec.name)
+            wt._model = self
+            layer.weights.append(wt)
+        self.layers.append(layer)
+        if len(layer.outputs) == 1:
+            return layer.outputs[0]
+        return layer.outputs
+
+    # -- op API (reference: flexflow_cffi.py FFModel methods) ----------
+    def conv2d(
+        self,
+        input: Tensor,
+        out_channels: int,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int,
+        stride_w: int,
+        padding_h: int,
+        padding_w: int,
+        activation: ActiMode = ActiMode.AC_MODE_NONE,
+        groups: int = 1,
+        use_bias: bool = True,
+        shared_op=None,
+        kernel_initializer=None,
+        bias_initializer=None,
+        name: str = "",
+    ) -> Tensor:
+        p = Conv2DParams(
+            out_channels=out_channels,
+            kernel_h=kernel_h,
+            kernel_w=kernel_w,
+            stride_h=stride_h,
+            stride_w=stride_w,
+            padding_h=padding_h,
+            padding_w=padding_w,
+            groups=groups,
+            use_bias=use_bias,
+            activation=_to_acti(activation),
+        )
+        return self._add_layer(
+            OperatorType.OP_CONV2D,
+            p,
+            [input],
+            name,
+            {"kernel": kernel_initializer, "bias": bias_initializer},
+        )
+
+    def dense(
+        self,
+        input: Tensor,
+        out_dim: int,
+        activation: ActiMode = ActiMode.AC_MODE_NONE,
+        use_bias: bool = True,
+        datatype: DataType = DataType.DT_FLOAT,
+        shared_op=None,
+        kernel_initializer=None,
+        bias_initializer=None,
+        kernel_regularizer=None,
+        name: str = "",
+    ) -> Tensor:
+        p = LinearParams(
+            out_channels=out_dim,
+            use_bias=use_bias,
+            activation=_to_acti(activation),
+            data_type=_to_dt(datatype),
+        )
+        return self._add_layer(
+            OperatorType.OP_LINEAR,
+            p,
+            [input],
+            name,
+            {"kernel": kernel_initializer, "bias": bias_initializer},
+        )
+
+    def embedding(
+        self,
+        input: Tensor,
+        num_entries: int,
+        out_dim: int,
+        aggr: AggrMode = AggrMode.AGGR_MODE_NONE,
+        dtype: DataType = DataType.DT_FLOAT,
+        shared_op=None,
+        kernel_initializer=None,
+        name: str = "",
+    ) -> Tensor:
+        p = EmbeddingParams(
+            num_entries=num_entries,
+            out_channels=out_dim,
+            aggr=aggr,
+            data_type=_to_dt(dtype),
+        )
+        return self._add_layer(
+            OperatorType.OP_EMBEDDING, p, [input], name, {"weight": kernel_initializer}
+        )
+
+    def pool2d(
+        self,
+        input: Tensor,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int,
+        stride_w: int,
+        padding_h: int,
+        padding_w: int,
+        pool_type: PoolType = PoolType.POOL_MAX,
+        activation: ActiMode = ActiMode.AC_MODE_NONE,
+        name: str = "",
+    ) -> Tensor:
+        p = Pool2DParams(
+            kernel_h=kernel_h,
+            kernel_w=kernel_w,
+            stride_h=stride_h,
+            stride_w=stride_w,
+            padding_h=padding_h,
+            padding_w=padding_w,
+            pool_type=pool_type,
+            activation=_to_acti(activation),
+        )
+        return self._add_layer(OperatorType.OP_POOL2D, p, [input], name)
+
+    def batch_norm(self, input: Tensor, relu: bool = True, name: str = "") -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_BATCHNORM, BatchNormParams(relu=relu), [input], name
+        )
+
+    def layer_norm(
+        self,
+        input: Tensor,
+        axes: Sequence[int] = (-1,),
+        elementwise_affine: bool = True,
+        eps: float = 1e-5,
+        name: str = "",
+    ) -> Tensor:
+        p = LayerNormParams(
+            axes=tuple(axes), elementwise_affine=elementwise_affine, eps=eps
+        )
+        return self._add_layer(OperatorType.OP_LAYERNORM, p, [input], name)
+
+    def batch_matmul(
+        self,
+        A: Tensor,
+        B: Tensor,
+        a_seq_length_dim: int = -1,
+        b_seq_length_dim: int = -1,
+        name: str = "",
+    ) -> Tensor:
+        p = BatchMatmulParams(a_seq_length_dim, b_seq_length_dim)
+        return self._add_layer(OperatorType.OP_BATCHMATMUL, p, [A, B], name)
+
+    def multihead_attention(
+        self,
+        query: Tensor,
+        key: Tensor,
+        value: Tensor,
+        embed_dim: int,
+        num_heads: int,
+        kdim: int = 0,
+        vdim: int = 0,
+        dropout: float = 0.0,
+        bias: bool = True,
+        add_bias_kv: bool = False,
+        add_zero_attn: bool = False,
+        kernel_initializer=None,
+        name: str = "",
+    ) -> Tensor:
+        p = MultiHeadAttentionParams(
+            embed_dim=embed_dim,
+            num_heads=num_heads,
+            kdim=kdim,
+            vdim=vdim,
+            dropout=dropout,
+            bias=bias,
+            add_bias_kv=add_bias_kv,
+            add_zero_attn=add_zero_attn,
+        )
+        inits = (
+            {k: kernel_initializer for k in ("wq", "wk", "wv", "wo")}
+            if kernel_initializer
+            else None
+        )
+        return self._add_layer(
+            OperatorType.OP_MULTIHEAD_ATTENTION, p, [query, key, value], name, inits
+        )
+
+    # elementwise binary
+    def _binary(self, t: OperatorType, x: Tensor, y: Tensor, name: str) -> Tensor:
+        return self._add_layer(t, ElementBinaryParams(op_type=t), [x, y], name)
+
+    def add(self, x, y, inplace_a=False, name=""):
+        return self._binary(OperatorType.OP_EW_ADD, x, y, name)
+
+    def subtract(self, x, y, inplace_a=False, name=""):
+        return self._binary(OperatorType.OP_EW_SUB, x, y, name)
+
+    def multiply(self, x, y, inplace_a=False, name=""):
+        return self._binary(OperatorType.OP_EW_MUL, x, y, name)
+
+    def divide(self, x, y, inplace_a=False, name=""):
+        return self._binary(OperatorType.OP_EW_DIV, x, y, name)
+
+    def max(self, x, y, inplace_a=False, name=""):
+        return self._binary(OperatorType.OP_EW_MAX, x, y, name)
+
+    def min(self, x, y, inplace_a=False, name=""):
+        return self._binary(OperatorType.OP_EW_MIN, x, y, name)
+
+    # elementwise unary
+    def _unary(self, t: OperatorType, x: Tensor, name: str, scalar=0.0, inplace=False):
+        p = ElementUnaryParams(op_type=t, inplace=inplace, scalar=scalar)
+        return self._add_layer(t, p, [x], name)
+
+    def exp(self, x, name=""):
+        return self._unary(OperatorType.OP_EXP, x, name)
+
+    def log(self, x, name=""):
+        return self._unary(OperatorType.OP_LOG, x, name)
+
+    def relu(self, x, inplace=True, name=""):
+        return self._unary(OperatorType.OP_RELU, x, name, inplace=inplace)
+
+    def sigmoid(self, x, name=""):
+        return self._unary(OperatorType.OP_SIGMOID, x, name)
+
+    def tanh(self, x, name=""):
+        return self._unary(OperatorType.OP_TANH, x, name)
+
+    def elu(self, x, inplace=True, name=""):
+        return self._unary(OperatorType.OP_ELU, x, name, inplace=inplace)
+
+    def gelu(self, x, name=""):
+        return self._unary(OperatorType.OP_GELU, x, name)
+
+    def identity(self, x, name=""):
+        return self._unary(OperatorType.OP_IDENTITY, x, name)
+
+    def rsqrt(self, x, name=""):
+        return self._unary(OperatorType.OP_RSQRT, x, name)
+
+    def sqrt(self, x, name=""):
+        return self._unary(OperatorType.OP_SQRT, x, name)
+
+    def sin(self, x, name=""):
+        return self._unary(OperatorType.OP_SIN, x, name)
+
+    def cos(self, x, name=""):
+        return self._unary(OperatorType.OP_COS, x, name)
+
+    def pow(self, x, exponent: float, name=""):
+        return self._unary(OperatorType.OP_POW, x, name, scalar=exponent)
+
+    def scalar_multiply(self, x, scalar: float, inplace=True, name=""):
+        return self._unary(OperatorType.OP_SCALAR_MULTIPLY, x, name, scalar=scalar)
+
+    def scalar_add(self, x, scalar: float, inplace=True, name=""):
+        return self._unary(OperatorType.OP_SCALAR_ADD, x, name, scalar=scalar)
+
+    def scalar_sub(self, x, scalar: float, inplace=True, name=""):
+        return self._unary(OperatorType.OP_SCALAR_SUB, x, name, scalar=scalar)
+
+    def scalar_true_divide(self, x, scalar: float, inplace=True, name=""):
+        return self._unary(OperatorType.OP_SCALAR_TRUE_DIV, x, name, scalar=scalar)
+
+    # shape ops
+    def concat(self, tensors: List[Tensor], axis: int, name="") -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_CONCAT, ConcatParams(axis=axis), list(tensors), name
+        )
+
+    def split(self, input: Tensor, sizes, axis: int, name="") -> List[Tensor]:
+        if isinstance(sizes, int):
+            assert input.dims[axis] % sizes == 0, (
+                f"split: dim {input.dims[axis]} not divisible into {sizes} parts"
+            )
+            sizes = [input.dims[axis] // sizes] * sizes
+        assert sum(sizes) == input.dims[axis], (
+            f"split sizes {sizes} don't sum to dim {input.dims[axis]}"
+        )
+        out = self._add_layer(
+            OperatorType.OP_SPLIT, SplitParams(tuple(sizes), axis), [input], name
+        )
+        return out if isinstance(out, list) else [out]
+
+    def flat(self, input: Tensor, name="") -> Tensor:
+        return self._add_layer(OperatorType.OP_FLAT, FlatParams(), [input], name)
+
+    def softmax(self, input: Tensor, axis: int = -1, name="") -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_SOFTMAX, SoftmaxParams(dim=axis), [input], name
+        )
+
+    def reshape(self, input: Tensor, shape: Sequence[int], name="") -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_RESHAPE, ReshapeParams(tuple(shape)), [input], name
+        )
+
+    def transpose(self, input: Tensor, perm: Sequence[int], name="") -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_TRANSPOSE, TransposeParams(tuple(perm)), [input], name
+        )
+
+    def reverse(self, input: Tensor, axis: int, name="") -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_REVERSE, ReverseParams(axis=axis), [input], name
+        )
+
+    def cast(self, input: Tensor, dtype: DataType, name="") -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_CAST, CastParams(dtype=_to_dt(dtype)), [input], name
+        )
+
+    def dropout(self, input: Tensor, rate: float = 0.5, seed: int = 0, name="") -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_DROPOUT, DropoutParams(rate=rate, seed=seed), [input], name
+        )
+
+    def gather(self, input: Tensor, index: Tensor, dim: int = 0, name="") -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_GATHER, GatherParams(dim=dim), [input, index], name
+        )
+
+    def reduce_sum(self, input: Tensor, axes, keepdims=False, name="") -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_REDUCE_SUM,
+            ReduceParams(tuple(axes), keepdims),
+            [input],
+            name,
+        )
+
+    def reduce_mean(self, input: Tensor, axes, keepdims=False, name="") -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_REDUCE_MEAN,
+            ReduceParams(tuple(axes), keepdims),
+            [input],
+            name,
+        )
+
+    def mean(self, input: Tensor, dims, keepdims=False, name="") -> Tensor:
+        return self._add_layer(
+            OperatorType.OP_MEAN, ReduceParams(tuple(dims), keepdims), [input], name
+        )
+
+    def top_k(self, input: Tensor, k: int, sorted: bool = True, name="") -> List[Tensor]:
+        out = self._add_layer(
+            OperatorType.OP_TOPK, TopKParams(k=k, sorted=sorted), [input], name
+        )
+        return out
+
+    # MoE family (reference: moe.cc:20-44 FFModel::moe composite)
+    def group_by(self, input: Tensor, assign: Tensor, n: int, alpha: float, name=""):
+        return self._add_layer(
+            OperatorType.OP_GROUP_BY, GroupByParams(n=n, alpha=alpha), [input, assign], name
+        )
+
+    def aggregate(self, tensors: List[Tensor], n: int, lambda_bal: float = 0.0, name=""):
+        return self._add_layer(
+            OperatorType.OP_AGGREGATE,
+            AggregateParams(n=n, lambda_bal=lambda_bal),
+            list(tensors),
+            name,
+        )
+
+    def aggregate_spec(self, tensors: List[Tensor], n: int, lambda_bal: float = 0.0, name=""):
+        return self._add_layer(
+            OperatorType.OP_AGG_SPEC,
+            AggregateSpecParams(n=n, lambda_bal=lambda_bal),
+            list(tensors),
+            name,
+        )
+
+    def moe(
+        self,
+        input: Tensor,
+        num_exp: int,
+        num_select: int,
+        expert_hidden_size: int,
+        alpha: float = 2.0,
+        lambda_bal: float = 0.0,
+    ) -> Tensor:
+        """reference: src/ops/moe.cc:20-44 — gate -> top_k -> group_by ->
+        per-expert dense -> aggregate."""
+        gate_preds = self.dense(input, num_exp, ActiMode.AC_MODE_RELU)
+        topk_out, topk_assign = self.top_k(gate_preds, num_select)
+        exp_tensors = self.group_by(input, topk_assign, num_exp, alpha)
+        if not isinstance(exp_tensors, list):
+            exp_tensors = [exp_tensors]
+        agg_inputs = [self.softmax(topk_out), topk_assign, topk_assign, gate_preds]
+        for et in exp_tensors:
+            agg_inputs.append(
+                self.dense(et, expert_hidden_size, ActiMode.AC_MODE_RELU)
+            )
+        return self.aggregate(agg_inputs, num_exp, lambda_bal)
+
+    # ------------------------------------------------------------------
+    # compile (reference: model.cc:2803 FFModel::compile)
+    # ------------------------------------------------------------------
+    def set_optimizer(self, opt: Optimizer):
+        self.optimizer = opt
+
+    optimizer_setter = set_optimizer  # cffi property-style parity
+
+    def compile(
+        self,
+        optimizer: Optional[Optimizer] = None,
+        loss_type=None,
+        metrics: Sequence = (),
+        comp_mode: CompMode = CompMode.COMP_MODE_TRAINING,
+    ):
+        if optimizer is not None:
+            self.optimizer = optimizer
+        if self.optimizer is None:
+            self.optimizer = SGDOptimizer(lr=self.config.learning_rate)
+        assert loss_type is not None, "compile() needs a loss_type"
+        self.loss_type = to_loss_type(loss_type)
+        self.comp_mode = comp_mode
+        self.metrics_obj = Metrics(self.loss_type, metrics)
+
+        # 1. Layer graph -> PCG (reference: create_operators_from_layers)
+        self.graph, self._tensor_map = layers_to_pcg(self.layers)
+        self._pt_by_guid = {}
+        for op in self.graph.ops:
+            for t in list(op.outputs) + list(op.weights):
+                self._pt_by_guid[t.guid] = t
+        for t in self.graph.input_tensors():
+            self._pt_by_guid[t.guid] = t
+
+        # 2. Parallelization strategy. Default: data parallel over remaining
+        #    devices after manual tp/sp/ep degrees (reference
+        #    --only-data-parallel path when all degrees are 1); the Unity
+        #    search replaces these annotations when budget >= 0.
+        ndev = min(self.config.numWorkers, len(jax.devices()))
+        tp = max(1, self.config.tensor_parallel_degree)
+        sp = max(1, self.config.sequence_parallel_degree)
+        ep = max(1, self.config.expert_parallel_degree)
+        dp = max(1, ndev // (tp * sp * ep))
+        mesh = build_mesh({"data": dp, "model": tp, "seq": sp, "expert": ep})
+        strategies.apply_data_parallel(self.graph, dp, axis_idx=0)
+        strategies.apply_tensor_parallel(self.graph, tp, axis_idx=1)
+        strategies.apply_sequence_parallel(self.graph, sp, axis_idx=2)
+        strategies.apply_expert_parallel(self.graph, ep, axis_idx=3)
+
+        # 3. Label tensor matched to final op's sharding (model.cc:3054)
+        logits_pt = self.graph.output_tensors()[-1]
+        if self.label_tensor is None:
+            label_dt = (
+                DataType.DT_INT32
+                if self.loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY
+                else logits_pt.data_type
+            )
+            label_dims = (
+                tuple(logits_pt.material_shape()[:-1]) + (1,)
+                if label_dt == DataType.DT_INT32
+                else logits_pt.material_shape()
+            )
+            self.label_tensor = Tensor(label_dims, label_dt, name="label")
+            self.label_tensor._model = self
+
+        # 4. Build executor + initialize weights (reference: optimizer->init,
+        #    NCCL communicator setup — here: jit + shardings)
+        compute_dtype = (
+            jnp.bfloat16 if self.config.allow_mixed_precision else None
+        )
+        # Map user input tensors (creation order) to their PCG tensors; only
+        # those actually consumed by the graph become executor inputs.
+        graph_input_guids = {t.guid for t in self.graph.input_tensors()}
+        ordered_inputs = [
+            self._pt_by_guid[self._tensor_map[t.guid]]
+            for t in self.input_tensors
+            if self._tensor_map.get(t.guid) in graph_input_guids
+        ]
+        self.executor = PCGExecutor(
+            self.graph,
+            mesh,
+            self.optimizer,
+            self.loss_type,
+            self.metrics_obj,
+            compute_dtype=compute_dtype,
+            seed=self.config.seed,
+            input_order=ordered_inputs,
+        )
+        self.state = self.executor.init_state()
+        self.perf_metrics = PerfMetrics()
+
+    # ------------------------------------------------------------------
+    # training loop (reference: flexflow_cffi.py:2058 fit)
+    # ------------------------------------------------------------------
+    def _batches(self, arrays: List[np.ndarray], batch_size: int):
+        n = arrays[0].shape[0]
+        nb = n // batch_size
+        for i in range(nb):
+            yield [a[i * batch_size : (i + 1) * batch_size] for a in arrays]
+
+    def fit(
+        self,
+        x: Union[np.ndarray, List[np.ndarray], None] = None,
+        y: Optional[np.ndarray] = None,
+        batch_size: Optional[int] = None,
+        epochs: Optional[int] = None,
+        verbose: bool = True,
+    ):
+        assert self.executor is not None, "call compile() first"
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        bs = batch_size or self.config.batch_size
+        ep = epochs or self.config.epochs
+        n = xs[0].shape[0]
+        if n < bs:
+            raise ValueError(
+                f"dataset has {n} samples < batch_size {bs}; nothing to train on"
+            )
+        if n % bs != 0:
+            print(f"[flexflow_tpu] warning: dropping {n % bs} tail samples "
+                  f"(dataset {n} % batch {bs})")
+        step_fn = self.executor.build_train_step()
+        in_pts = self.executor.input_pts
+        label_dt = self.label_tensor.data_type.jnp_dtype
+        self.perf_metrics = PerfMetrics()
+        start = time.time()
+        num_samples = 0
+        for epoch in range(ep):
+            # per-epoch accumulator like the reference (PerfMetrics is reset
+            # each epoch, model.cc reset_metrics)
+            self.perf_metrics = PerfMetrics()
+            # Keep partials on device during the epoch so host dispatch stays
+            # ahead of the chip (no per-batch sync); fold once at epoch end.
+            device_partials = []
+            for batch in self._batches(list(xs) + [y], bs):
+                bx = [
+                    self.executor.shard_batch(pt, np.asarray(a, pt.data_type.np_dtype))
+                    for pt, a in zip(in_pts, batch[:-1])
+                ]
+                by = jnp.asarray(batch[-1], label_dt)
+                self._rng, sub = jax.random.split(self._rng)
+                self.state, partials = step_fn(self.state, bx, by, sub)
+                device_partials.append(partials)
+                num_samples += bs
+            folded = jax.tree_util.tree_map(
+                lambda *vs: sum(float(v) for v in vs), *device_partials
+            )
+            last_loss = float(device_partials[-1]["loss"])
+            folded.pop("loss", None)
+            self.perf_metrics.update(folded)
+            if verbose:
+                print(f"epoch {epoch}: loss={last_loss:.4f} "
+                      + self.perf_metrics.report())
+        jax.block_until_ready(self.state.params)
+        elapsed = time.time() - start
+        # reference: transformer.cc:208-211 throughput print
+        print(
+            f"ELAPSED TIME = {elapsed:.4f}s, "
+            f"THROUGHPUT = {num_samples / elapsed:.2f} samples/s"
+        )
+        return self.perf_metrics
+
+    def eval(self, x=None, y=None, batch_size: Optional[int] = None):
+        assert self.executor is not None
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        bs = batch_size or self.config.batch_size
+        step_fn = self.executor.build_eval_step()
+        in_pts = self.executor.input_pts
+        pm = PerfMetrics()
+        for batch in self._batches(list(xs) + [y], bs):
+            bx = [
+                self.executor.shard_batch(pt, np.asarray(a, pt.data_type.np_dtype))
+                for pt, a in zip(in_pts, batch[:-1])
+            ]
+            by = jnp.asarray(batch[-1], self.label_tensor.data_type.jnp_dtype)
+            _, partials = step_fn(self.state.params, bx, by)
+            pm.update({k: float(v) for k, v in partials.items()})
+        print(pm.report())
+        return pm
+
+    def predict(self, x, batch_size: Optional[int] = None):
+        assert self.executor is not None
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        fwd = self.executor.build_forward()
+        bs = batch_size or self.config.batch_size
+        outs = []
+        n = xs[0].shape[0]
+        for i in range(0, n, bs):
+            chunk = [a[i : i + bs] for a in xs]
+            pad = bs - chunk[0].shape[0]
+            if pad > 0:  # pad the tail batch to the compiled batch size
+                chunk = [
+                    np.concatenate([c, np.repeat(c[-1:], pad, axis=0)], axis=0)
+                    for c in chunk
+                ]
+            bx = [jnp.asarray(c) for c in chunk]
+            out = np.asarray(fwd(self.state.params, bx))
+            outs.append(out[: bs - pad] if pad > 0 else out)
+        return np.concatenate(outs, axis=0) if outs else np.empty((0,))
+
+    # -- stepwise API for cffi parity (reference: model.cc forward/backward/
+    #    update/zero_gradients driven from flexflow_cffi.fit) -------------
+    def set_iteration_batch(self, inputs: List[np.ndarray], label: np.ndarray):
+        self._current_batch = (inputs, label)
+
+    def forward(self, seq_length: int = -1):
+        assert self.executor is not None and self._current_batch is not None
+        inputs, _ = self._current_batch
+        fwd = self.executor.build_forward()
+        bx = [jnp.asarray(a) for a in inputs]
+        self._last_logits = fwd(self.state.params, bx)
+        return self._last_logits
+
+    def zero_gradients(self):
+        self._pending_grads = None
+
+    def backward(self, seq_length: int = -1):
+        assert self.executor is not None and self._current_batch is not None
+        inputs, label = self._current_batch
+        bx = [jnp.asarray(a) for a in inputs]
+        by = jnp.asarray(label, self.label_tensor.data_type.jnp_dtype)
+
+        ex = self.executor
+
+        def loss_of(params):
+            vals = ex.apply(params, ex._input_vals(bx), training=True, rng=None)
+            return ex.loss_fn(vals[ex.logits_pt.guid], by)
+
+        self._pending_grads = jax.grad(loss_of)(self.state.params)
+
+    def update(self):
+        assert self._pending_grads is not None, "call backward() first"
+        new_params, new_opt = self.optimizer.update(
+            self.state.params, self._pending_grads, self.state.opt_state
+        )
+        self.state = TrainState(
+            params=new_params, opt_state=new_opt, step=self.state.step + 1
+        )
+        self._pending_grads = None
+
+    def get_perf_metrics(self) -> PerfMetrics:
+        return self.perf_metrics
+
+    def get_layers(self) -> Dict[int, Layer]:
+        return dict(enumerate(self.layers))
+
+    def get_layer_by_id(self, idx: int) -> Layer:
+        return self.layers[idx]
+
+    def get_last_layer(self) -> Layer:
+        return self.layers[-1]
+
+    # ------------------------------------------------------------------
+    # weight access (reference: parallel_tensor.cc set_tensor/get_tensor)
+    # ------------------------------------------------------------------
+    def _find_weight_slot(self, t: Tensor):
+        layer = t.owner_layer
+        if layer is None or self.state is None:
+            return None
+        for i, wt in enumerate(layer.weights):
+            if wt.guid == t.guid:
+                # weight name from the lowered op
+                for op in self.graph.ops:
+                    if op.layer_guid == layer.guid:
+                        return op.name, op.weight_names[i]
+        return None
+
+    def _get_tensor_value(self, t: Tensor):
+        slot = self._find_weight_slot(t)
+        if slot is not None:
+            return np.asarray(self.state.params[slot[0]][slot[1]])
+        raise KeyError(f"tensor {t} is not a weight; activations are not retained")
+
+    def _set_tensor_value(self, t: Tensor, value: np.ndarray):
+        slot = self._find_weight_slot(t)
+        assert slot is not None, f"tensor {t} is not a weight"
+        op_name, w_name = slot
+        old = self.state.params[op_name][w_name]
+        assert tuple(value.shape) == tuple(old.shape), (
+            f"shape mismatch {value.shape} vs {old.shape}"
+        )
+        self.state.params[op_name][w_name] = jax.device_put(
+            value.astype(old.dtype), old.sharding
+        )
+
+    def create_data_loader(self, batch_tensor: Tensor, full_array: np.ndarray):
+        from .dataloader import SingleDataLoader
+
+        dl = SingleDataLoader(self, batch_tensor, full_array)
+        self._dataloaders.append(dl)
+        return dl
+
+
+def _to_dt(dt) -> DataType:
+    if isinstance(dt, DataType):
+        return dt
+    from ..ff_types import to_data_type
+
+    return to_data_type(dt)
+
+
+def _to_acti(a) -> ActiMode:
+    if isinstance(a, ActiMode):
+        return a
+    if a in (None, "none"):
+        return ActiMode.AC_MODE_NONE
+    return {
+        "relu": ActiMode.AC_MODE_RELU,
+        "sigmoid": ActiMode.AC_MODE_SIGMOID,
+        "tanh": ActiMode.AC_MODE_TANH,
+        "gelu": ActiMode.AC_MODE_GELU,
+    }[a]

@@ -1,0 +1,132 @@
+"""Optimizers: SGD (momentum/nesterov/weight-decay) and Adam.
+
+TPU-native equivalents of reference src/runtime/optimizer.cc (608 LoC) +
+optimizer_kernel.cu. The reference runs one Legion task per weight partition
+with an ncclAllReduce on the gradient first (optimizer_kernel.cu:88); here
+gradient reduction is a psum the XLA partitioner inserts from shardings, and
+the update is a pure pytree map fused into the train step.
+
+Semantics are matched to the CUDA kernels:
+  sgd_update (optimizer_kernel.cu): w += -lr * (Vation: momentum buffer) with
+    weight decay added to the raw gradient, nesterov applied as g + mu*v.
+  adam_update: bias-corrected alpha_t, eps OUTSIDE the sqrt like the
+    reference (w -= alpha_t * m_hat / (sqrt(v_hat) + eps)).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+class Optimizer:
+    """Base (reference: include/flexflow/optimizer.h:27-34)."""
+
+    def init_state(self, params) -> Any:
+        raise NotImplementedError
+
+    def next(self, state) -> Any:
+        """Advance per-step schedule (reference: Optimizer::next())."""
+        return state
+
+    def update(self, params, grads, state):
+        """Returns (new_params, new_state)."""
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class SGDOptimizer(Optimizer):
+    """reference: optimizer.h:36-60 SGDOptimizer."""
+
+    lr: float = 0.01
+    momentum: float = 0.0
+    nesterov: bool = False
+    weight_decay: float = 0.0
+
+    def init_state(self, params):
+        if self.momentum == 0.0:
+            return {"v": None}
+        return {"v": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+    def update(self, params, grads, state):
+        wd, mu, lr = self.weight_decay, self.momentum, self.lr
+
+        if mu == 0.0:
+            def upd(w, g):
+                g = g + wd * w
+                return w - lr * g
+
+            return jax.tree_util.tree_map(upd, params, grads), state
+
+        def upd_v(v, w, g):
+            g = g + wd * w
+            return mu * v + g
+
+        v_new = jax.tree_util.tree_map(upd_v, state["v"], params, grads)
+        if self.nesterov:
+            def upd_w(w, g, v):
+                g = g + wd * w
+                return w - lr * (g + mu * v)
+        else:
+            def upd_w(w, g, v):
+                return w - lr * v
+
+        new_params = jax.tree_util.tree_map(upd_w, params, grads, v_new)
+        return new_params, {"v": v_new}
+
+
+@dataclasses.dataclass
+class AdamOptimizer(Optimizer):
+    """reference: optimizer.h:62-117 AdamOptimizer (alpha_t bias correction
+    maintained step-to-step exactly like AdamOptimizer::next())."""
+
+    alpha: float = 0.001
+    beta1: float = 0.9
+    beta2: float = 0.999
+    weight_decay: float = 0.0
+    epsilon: float = 1e-8
+
+    def init_state(self, params):
+        zeros = lambda p: jax.tree_util.tree_map(jnp.zeros_like, p)  # noqa: E731
+        return {
+            "m": zeros(params),
+            "v": zeros(params),
+            "beta1_t": jnp.asarray(1.0, jnp.float32),
+            "beta2_t": jnp.asarray(1.0, jnp.float32),
+        }
+
+    def update(self, params, grads, state):
+        # reference AdamOptimizer::next(): beta_t *= beta, alpha_t = alpha *
+        # sqrt(1-beta2_t) / (1-beta1_t)
+        b1t = state["beta1_t"] * self.beta1
+        b2t = state["beta2_t"] * self.beta2
+        alpha_t = self.alpha * jnp.sqrt(1.0 - b2t) / (1.0 - b1t)
+        wd = self.weight_decay
+
+        def upd(w, g, m, v):
+            g = g + wd * w
+            m = self.beta1 * m + (1.0 - self.beta1) * g
+            v = self.beta2 * v + (1.0 - self.beta2) * g * g
+            return w - alpha_t * m / (jnp.sqrt(v) + self.epsilon), m, v
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state["m"])
+        flat_v = treedef.flatten_up_to(state["v"])
+        new_p, new_m, new_v = [], [], []
+        for w, g, m, v in zip(flat_p, flat_g, flat_m, flat_v):
+            wn, mn, vn = upd(w, g, m, v)
+            new_p.append(wn)
+            new_m.append(mn)
+            new_v.append(vn)
+        return (
+            jax.tree_util.tree_unflatten(treedef, new_p),
+            {
+                "m": jax.tree_util.tree_unflatten(treedef, new_m),
+                "v": jax.tree_util.tree_unflatten(treedef, new_v),
+                "beta1_t": b1t,
+                "beta2_t": b2t,
+            },
+        )

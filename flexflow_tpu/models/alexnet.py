@@ -1,0 +1,37 @@
+"""AlexNet model builder.
+
+Same network the reference trains for its CIFAR-10 bootcamp benchmark
+(reference: examples/cpp/AlexNet/alexnet.cc:70-83 and
+bootcamp_demo/ff_alexnet_cifar10.py), expressed through our FFModel API.
+"""
+from __future__ import annotations
+
+from ..core.model import FFModel
+from ..ff_types import ActiMode, DataType, PoolType
+
+
+def build_alexnet(
+    model: FFModel,
+    batch_size: int,
+    num_classes: int = 10,
+    height: int = 229,
+    width: int = 229,
+):
+    """reference topology: alexnet.cc:70-83 (conv 64k11s4p2 ... dense 4096)."""
+    input_t = model.create_tensor(
+        (batch_size, 3, height, width), DataType.DT_FLOAT, name="image"
+    )
+    t = model.conv2d(input_t, 64, 11, 11, 4, 4, 2, 2, ActiMode.AC_MODE_RELU)
+    t = model.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = model.conv2d(t, 192, 5, 5, 1, 1, 2, 2, ActiMode.AC_MODE_RELU)
+    t = model.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = model.conv2d(t, 384, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = model.conv2d(t, 256, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = model.conv2d(t, 256, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = model.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = model.flat(t)
+    t = model.dense(t, 4096, ActiMode.AC_MODE_RELU)
+    t = model.dense(t, 4096, ActiMode.AC_MODE_RELU)
+    t = model.dense(t, num_classes)
+    t = model.softmax(t)
+    return input_t, t

@@ -1,0 +1,13 @@
+"""Operator library (TPU-native equivalents of reference src/ops/)."""
+from .registry import (  # noqa: F401
+    FwdCtx,
+    OpDef,
+    WeightSpec,
+    all_op_types,
+    ensure_ops_loaded,
+    get_op_def,
+    has_op_def,
+    register_op,
+)
+
+ensure_ops_loaded()

@@ -1,0 +1,125 @@
+"""MultiHeadAttention operator.
+
+TPU-native equivalent of reference src/ops/attention.cc (926 LoC, cuDNN
+`cudnnMultiHeadAttnForward` with packed qkv weights). Here attention is
+expressed as einsum chains that XLA maps onto the MXU; a Pallas
+flash-attention kernel (kernels/flash_attention.py) is used for long
+sequences where the O(s^2) score tensor would blow HBM.
+
+Head-dim parallelism: the reference partitions weights per-head
+(attention.cc:214 — "attribute parallelism over heads"); our PCG carries that
+as a degree on the heads dim, which lowers to sharding the (num_heads,...)
+weight axes over the mesh's model axis.
+
+Inputs are (batch, seq, embed) like the reference's (N, L, E).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ff_types import DataType, OperatorType
+from .registry import WeightSpec, register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiHeadAttentionParams:
+    """reference: include/flexflow/ops/attention_params.h"""
+
+    embed_dim: int
+    num_heads: int
+    kdim: int = 0  # 0 = embed_dim
+    vdim: int = 0
+    dropout: float = 0.0
+    bias: bool = True
+    add_bias_kv: bool = False
+    add_zero_attn: bool = False
+    causal: bool = False  # TPU addition: causal masking for decoder models
+
+    # reference semantics (attention.cc:86): kdim/vdim are PER-HEAD
+    # projection sizes (qProjSize = kdim); 0 means embed_dim/num_heads.
+    @property
+    def qk_head_dim(self):
+        return self.kdim or self.embed_dim // self.num_heads
+
+    @property
+    def v_head_dim(self):
+        return self.vdim or self.embed_dim // self.num_heads
+
+    @property
+    def head_dim(self):
+        return self.qk_head_dim
+
+
+def _infer(params: MultiHeadAttentionParams, in_shapes, in_dtypes):
+    q, k, v = in_shapes
+    out = (q[0], q[1], params.embed_dim)
+    return [out], [in_dtypes[0]]
+
+
+def _weights(params: MultiHeadAttentionParams, in_shapes, in_dtypes):
+    q, k, v = in_shapes
+    h = params.num_heads
+    dqk, dv = params.qk_head_dim, params.v_head_dim
+    dt = in_dtypes[0]
+    ws = [
+        WeightSpec("wq", (q[-1], h, dqk), dt, "glorot_uniform", ("", "head", "")),
+        WeightSpec("wk", (k[-1], h, dqk), dt, "glorot_uniform", ("", "head", "")),
+        WeightSpec("wv", (v[-1], h, dv), dt, "glorot_uniform", ("", "head", "")),
+        WeightSpec("wo", (h, dv, params.embed_dim), dt, "glorot_uniform", ("head", "", "")),
+    ]
+    if params.bias:
+        ws.append(WeightSpec("bias_o", (params.embed_dim,), dt, "zero"))
+    return ws
+
+
+def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
+    q_in, k_in, v_in = inputs
+    cdt = ctx.compute_dtype
+    if cdt is not None:
+        q_in, k_in, v_in = (t.astype(cdt) for t in (q_in, k_in, v_in))
+    wq, wk, wv, wo = (
+        weights["wq"], weights["wk"], weights["wv"], weights["wo"],
+    )
+    if cdt is not None:
+        wq, wk, wv, wo = (w.astype(cdt) for w in (wq, wk, wv, wo))
+    # (b, s, e) @ (e, h, d) -> (b, s, h, d)
+    q = jnp.einsum("bse,ehd->bshd", q_in, wq, preferred_element_type=jnp.float32)
+    k = jnp.einsum("bse,ehd->bshd", k_in, wk, preferred_element_type=jnp.float32)
+    v = jnp.einsum("bse,ehd->bshd", v_in, wv, preferred_element_type=jnp.float32)
+    q = q.astype(q_in.dtype)
+    k = k.astype(q_in.dtype)
+    v = v.astype(q_in.dtype)
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(params.head_dim, jnp.float32))
+    scores = jnp.einsum("bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if params.causal:
+        s_len, t_len = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_len, t_len), bool))
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if params.dropout > 0.0 and ctx.training and ctx.rng is not None:
+        keep = 1.0 - params.dropout
+        mask = jax.random.bernoulli(ctx.rng, keep, probs.shape)
+        probs = jnp.where(mask, probs / keep, 0).astype(probs.dtype)
+    attn = jnp.einsum("bhst,bthd->bshd", probs, v, preferred_element_type=jnp.float32)
+    attn = attn.astype(q.dtype)
+    out = jnp.einsum("bshd,hde->bse", attn, wo, preferred_element_type=jnp.float32)
+    out = out.astype(q_in.dtype)
+    if params.bias:
+        out = out + weights["bias_o"].astype(out.dtype)
+    return [out]
+
+
+register_op(
+    OperatorType.OP_MULTIHEAD_ATTENTION,
+    "MultiHeadAttention",
+    infer=_infer,
+    weights=_weights,
+    forward=_forward,
+    num_inputs=3,
+)

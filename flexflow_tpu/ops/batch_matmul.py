@@ -1,0 +1,51 @@
+"""BatchMatmul operator.
+
+TPU-native equivalent of reference src/ops/batch_matmul.cc (711 LoC, strided
+cuBLAS batched GEMM): one lax.batch_matmul on the MXU. Supports the
+reference's seq-length truncation dims (model.h:481-485
+a_seq_length_dim/b_seq_length_dim) via ctx.seq_length slicing.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..ff_types import OperatorType
+from .registry import register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchMatmulParams:
+    """reference: include/flexflow/ops/batch_matmul_params.h"""
+
+    a_seq_length_dim: int = -1
+    b_seq_length_dim: int = -1
+
+
+def _infer(params, in_shapes, in_dtypes):
+    a, b = in_shapes  # (..., m, k) x (..., k, n)
+    assert a[-1] == b[-2], f"batch_matmul mismatch {a} x {b}"
+    out = tuple(a[:-1]) + (b[-1],)
+    return [out], [in_dtypes[0]]
+
+
+def _slice_seq(x, dim, seq_length):
+    if dim < 0 or seq_length < 0 or x.shape[dim] <= seq_length:
+        return x
+    return lax.slice_in_dim(x, 0, seq_length, axis=dim)
+
+
+def _forward(params: BatchMatmulParams, weights, inputs, ctx):
+    a, b = inputs
+    a = _slice_seq(a, params.a_seq_length_dim, ctx.seq_length)
+    b = _slice_seq(b, params.b_seq_length_dim, ctx.seq_length)
+    y = jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    return [y]
+
+
+register_op(
+    OperatorType.OP_BATCHMATMUL, "BatchMatmul", infer=_infer, forward=_forward,
+    num_inputs=2,
+)

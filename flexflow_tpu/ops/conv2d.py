@@ -1,0 +1,105 @@
+"""Conv2D operator.
+
+TPU-native equivalent of reference src/ops/conv_2d.cc (1198 LoC) +
+kernels/conv_2d_kernels.cu (cuDNN conv with algorithm search). Here the kernel
+is one lax.conv_general_dilated; XLA picks the TPU conv algorithm and fuses
+bias + activation into the epilogue, replacing cuDNN's fused conv-bias-act.
+
+Layout: the user-facing API is NCHW like the reference
+(FFModel::conv2d, src/runtime/model.cc); internally we hand XLA NCHW
+dimension numbers and let TPU layout assignment transpose to its preferred
+form once at parameter load, not per step.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..ff_types import ActiMode, DataType, OperatorType
+from .common import apply_activation
+from .registry import WeightSpec, register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class Conv2DParams:
+    """reference: include/flexflow/ops/conv_2d_params.h"""
+
+    out_channels: int
+    kernel_h: int
+    kernel_w: int
+    stride_h: int = 1
+    stride_w: int = 1
+    padding_h: int = 0
+    padding_w: int = 0
+    groups: int = 1
+    use_bias: bool = True
+    activation: ActiMode = ActiMode.AC_MODE_NONE
+    data_type: DataType = DataType.DT_FLOAT
+
+
+def _out_hw(params, h, w):
+    oh = (h + 2 * params.padding_h - params.kernel_h) // params.stride_h + 1
+    ow = (w + 2 * params.padding_w - params.kernel_w) // params.stride_w + 1
+    return oh, ow
+
+
+def _infer(params: Conv2DParams, in_shapes, in_dtypes):
+    (s,) = in_shapes  # (N, C, H, W)
+    assert len(s) == 4, f"conv2d expects NCHW, got {s}"
+    oh, ow = _out_hw(params, s[2], s[3])
+    return [(s[0], params.out_channels, oh, ow)], [in_dtypes[0]]
+
+
+def _weights(params: Conv2DParams, in_shapes, in_dtypes):
+    (s,) = in_shapes
+    cin = s[1]
+    ws = [
+        WeightSpec(
+            "kernel",
+            (params.out_channels, cin // params.groups, params.kernel_h, params.kernel_w),
+            in_dtypes[0],
+            "glorot_uniform",
+            parallel_dim_tags=("out_channel", "in_channel", "", ""),
+        )
+    ]
+    if params.use_bias:
+        ws.append(
+            WeightSpec(
+                "bias", (params.out_channels,), in_dtypes[0], "zero",
+                parallel_dim_tags=("out_channel",),
+            )
+        )
+    return ws
+
+
+def _forward(params: Conv2DParams, weights, inputs, ctx):
+    (x,) = inputs
+    kernel = weights["kernel"]
+    cdt = ctx.compute_dtype
+    if cdt is not None:
+        x = x.astype(cdt)
+        kernel = kernel.astype(cdt)
+    y = lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(params.stride_h, params.stride_w),
+        padding=[(params.padding_h, params.padding_h), (params.padding_w, params.padding_w)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=params.groups,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    if params.use_bias:
+        y = y + weights["bias"].astype(y.dtype)[None, :, None, None]
+    return [apply_activation(params.activation, y)]
+
+
+register_op(
+    OperatorType.OP_CONV2D,
+    "Conv2D",
+    infer=_infer,
+    weights=_weights,
+    forward=_forward,
+    num_inputs=1,
+)

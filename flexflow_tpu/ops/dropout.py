@@ -1,0 +1,39 @@
+"""Dropout operator.
+
+TPU-native equivalent of reference src/ops/dropout.cc (cuDNN dropout with
+persistent states): jax.random.bernoulli with a PRNGKey threaded through
+FwdCtx. The reference's per-device dropout state ≈ our per-step folded key.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..ff_types import OperatorType
+from .registry import register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class DropoutParams:
+    """reference: include/flexflow/ops/dropout_params.h"""
+
+    rate: float = 0.5
+    seed: int = 0
+
+
+def _infer(params, in_shapes, in_dtypes):
+    return [in_shapes[0]], [in_dtypes[0]]
+
+
+def _forward(params: DropoutParams, weights, inputs, ctx):
+    (x,) = inputs
+    if not ctx.training or params.rate <= 0.0 or ctx.rng is None:
+        return [x]
+    keep = 1.0 - params.rate
+    mask = jax.random.bernoulli(ctx.rng, keep, x.shape)
+    return [jnp.where(mask, x / keep, 0).astype(x.dtype)]
+
+
+register_op(OperatorType.OP_DROPOUT, "Dropout", infer=_infer, forward=_forward)

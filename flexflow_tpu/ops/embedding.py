@@ -1,0 +1,71 @@
+"""Embedding operator.
+
+TPU-native equivalent of reference src/ops/embedding.cc (1205 LoC) +
+embedding_kernels.cu (custom gather/scatter-add CUDA kernels). On TPU the
+lookup is jnp.take (XLA gather, MXU-free); the aggregation modes (sum/avg over
+the token dim — reference AggrMode, embedding.cc) are fused reductions.
+
+The reference shards the weight over vocab or channel (embedding.cc:132-200
+replica dims — DLRM parameter parallelism); in our PCG that is carried by the
+weight's ParallelTensor dims, and XLA turns a vocab-sharded gather into an
+all-to-all/collective-gather automatically under GSPMD.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from ..ff_types import AggrMode, DataType, OperatorType
+from .registry import WeightSpec, register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbeddingParams:
+    """reference: include/flexflow/ops/embedding_params.h"""
+
+    num_entries: int
+    out_channels: int
+    aggr: AggrMode = AggrMode.AGGR_MODE_NONE
+    data_type: DataType = DataType.DT_FLOAT
+
+
+def _infer(params: EmbeddingParams, in_shapes, in_dtypes):
+    (s,) = in_shapes  # (batch, seq) int ids
+    if params.aggr == AggrMode.AGGR_MODE_NONE:
+        out = tuple(s) + (params.out_channels,)
+    else:
+        out = tuple(s[:-1]) + (params.out_channels,)
+    return [out], [params.data_type]
+
+
+def _weights(params: EmbeddingParams, in_shapes, in_dtypes):
+    return [
+        WeightSpec(
+            "weight",
+            (params.num_entries, params.out_channels),
+            params.data_type,
+            "glorot_uniform",
+            parallel_dim_tags=("vocab", "out_channel"),
+        )
+    ]
+
+
+def _forward(params: EmbeddingParams, weights, inputs, ctx):
+    (ids,) = inputs
+    table = weights["weight"]
+    emb = jnp.take(table, ids.astype(jnp.int32), axis=0)
+    if params.aggr == AggrMode.AGGR_MODE_SUM:
+        emb = jnp.sum(emb, axis=-2)
+    elif params.aggr == AggrMode.AGGR_MODE_AVG:
+        emb = jnp.mean(emb, axis=-2)
+    return [emb]
+
+
+register_op(
+    OperatorType.OP_EMBEDDING,
+    "Embedding",
+    infer=_infer,
+    weights=_weights,
+    forward=_forward,
+)

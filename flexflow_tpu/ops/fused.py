@@ -1,0 +1,63 @@
+"""FusedOp: executes a chain of ops as one unit.
+
+TPU-native equivalent of reference src/ops/fused.cc (458 LoC + 922 LoC CUDA
+dispatch loop). The reference packs consecutive non-parallel ops into a single
+Legion task to amortize launch overhead (--fusion). Under XLA every jitted
+step is already one fused program, so this op exists for (a) PCG parity —
+the search/serializer can still produce OP_FUSED nodes — and (b) as the
+attachment point for hand-written Pallas mega-kernels where XLA's automatic
+fusion is insufficient (MoE routing chains).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+from ..ff_types import OperatorType
+from .registry import FwdCtx, get_op_def, register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedOpParams:
+    """Chain of (op_type, params, input_slot_indices) triples.
+
+    Slots: 0..len(inputs)-1 are fused-op inputs; len(inputs)+i is the output
+    of chain step i (mirrors the reference's slot encoding in fused.cc).
+    """
+
+    chain: Tuple[Tuple[OperatorType, object, Tuple[int, ...]], ...]
+    num_inputs: int
+    output_slots: Tuple[int, ...]
+
+
+def _fused_infer(params: FusedOpParams, in_shapes, in_dtypes):
+    slots_s = list(in_shapes)
+    slots_d = list(in_dtypes)
+    for op_type, p, in_slots in params.chain:
+        d = get_op_def(op_type)
+        outs, dts = d.infer(p, [slots_s[i] for i in in_slots], [slots_d[i] for i in in_slots])
+        slots_s.extend(outs)
+        slots_d.extend(dts)
+    return (
+        [slots_s[i] for i in params.output_slots],
+        [slots_d[i] for i in params.output_slots],
+    )
+
+
+def _fused_forward(params: FusedOpParams, weights, inputs, ctx: FwdCtx):
+    slots = list(inputs)
+    for step, (op_type, p, in_slots) in enumerate(params.chain):
+        d = get_op_def(op_type)
+        step_weights = weights.get(f"step{step}", {}) if weights else {}
+        outs = d.forward(p, step_weights, [slots[i] for i in in_slots], ctx)
+        slots.extend(outs)
+    return [slots[i] for i in params.output_slots]
+
+
+register_op(
+    OperatorType.OP_FUSED,
+    "FusedOp",
+    infer=_fused_infer,
+    forward=_fused_forward,
+    num_inputs=-1,
+)

@@ -1,0 +1,90 @@
+"""Linear / Dense operator.
+
+TPU-native equivalent of reference src/ops/linear.cc (1184 LoC) +
+src/ops/kernels/linear_kernels.cu (cuBLAS GemmEx): here the kernel is a single
+jnp.dot that XLA tiles onto the MXU, with the fused activation folded in
+(reference fuses activation via cudnnActivationForward).
+
+Weight layout is (in_channels, out_channels) so the MXU contraction is the
+natural last-dim dot; the reference stores (out, in) for cuBLAS column-major.
+Channel-parallel (tensor parallel) execution shards the out dim; replica-dim
+handling (linear.cc:132-200) is carried by the PCG's ParallelTensor dims, not
+by the kernel.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from ..ff_types import ActiMode, DataType, OperatorType
+from .common import apply_activation
+from .registry import WeightSpec, register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearParams:
+    """reference: include/flexflow/ops/linear_params.h"""
+
+    out_channels: int
+    use_bias: bool = True
+    activation: ActiMode = ActiMode.AC_MODE_NONE
+    data_type: DataType = DataType.DT_FLOAT
+    kernel_reg_lambda: float = 0.0
+
+
+def _infer(params: LinearParams, in_shapes, in_dtypes):
+    (s,) = in_shapes
+    out = tuple(s[:-1]) + (params.out_channels,)
+    return [out], [params.data_type if params.data_type else in_dtypes[0]]
+
+
+def _weights(params: LinearParams, in_shapes, in_dtypes):
+    (s,) = in_shapes
+    in_dim = s[-1]
+    ws = [
+        WeightSpec(
+            "kernel",
+            (in_dim, params.out_channels),
+            params.data_type,
+            "glorot_uniform",
+            parallel_dim_tags=("in_channel", "out_channel"),
+        )
+    ]
+    if params.use_bias:
+        ws.append(
+            WeightSpec(
+                "bias",
+                (params.out_channels,),
+                params.data_type,
+                "zero",
+                parallel_dim_tags=("out_channel",),
+            )
+        )
+    return ws
+
+
+def _forward(params: LinearParams, weights, inputs, ctx):
+    (x,) = inputs
+    kernel = weights["kernel"]
+    cdt = ctx.compute_dtype
+    if cdt is not None:
+        x = x.astype(cdt)
+        kernel = kernel.astype(cdt)
+    # preferred_element_type keeps the MXU accumulating in f32 even for bf16 in.
+    y = jnp.dot(x, kernel, preferred_element_type=jnp.float32)
+    y = y.astype(x.dtype)
+    if params.use_bias:
+        y = y + weights["bias"].astype(y.dtype)
+    return [apply_activation(params.activation, y)]
+
+
+register_op(
+    OperatorType.OP_LINEAR,
+    "Dense",
+    infer=_infer,
+    weights=_weights,
+    forward=_forward,
+    num_inputs=1,
+)

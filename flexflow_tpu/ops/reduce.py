@@ -1,0 +1,109 @@
+"""Reduction-family operators: reduce_sum/mean/max/min/prod/argmax/argmin,
+mean, and top-k.
+
+TPU-native equivalents of reference src/ops/reduce.cc (423 LoC),
+src/ops/mean.cc (114), src/ops/topk.cc (437 + 514 LoC custom CUDA top-k).
+XLA's reduce/sort/top_k lower straight to the VPU; no hand-written heap
+kernel needed (lax.top_k is a TPU builtin).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..ff_types import DataType, OperatorType
+from .registry import register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class ReduceParams:
+    """reference: include/flexflow/ops/reduce_params.h"""
+
+    axes: Tuple[int, ...]
+    keepdims: bool = False
+
+
+_REDUCE_FNS = {
+    OperatorType.OP_REDUCE_SUM: jnp.sum,
+    OperatorType.OP_REDUCE_MEAN: jnp.mean,
+    OperatorType.OP_REDUCE_MAX: jnp.max,
+    OperatorType.OP_REDUCE_MIN: jnp.min,
+    OperatorType.OP_REDUCE_PROD: jnp.prod,
+}
+
+
+def _reduce_infer(params: ReduceParams, in_shapes, in_dtypes):
+    (s,) = in_shapes
+    axes = tuple(a % len(s) for a in params.axes)
+    if params.keepdims:
+        out = tuple(1 if i in axes else d for i, d in enumerate(s))
+    else:
+        out = tuple(d for i, d in enumerate(s) if i not in axes)
+    return [out], [in_dtypes[0]]
+
+
+def _make_reduce_forward(fn):
+    def fwd(params, w, x, ctx):
+        return [fn(x[0], axis=params.axes, keepdims=params.keepdims)]
+
+    return fwd
+
+
+for _t, _fn in _REDUCE_FNS.items():
+    register_op(_t, _t.name, infer=_reduce_infer, forward=_make_reduce_forward(_fn))
+
+# OP_MEAN is reduce_mean over an axis list (reference: src/ops/mean.cc)
+register_op(
+    OperatorType.OP_MEAN,
+    "Mean",
+    infer=_reduce_infer,
+    forward=_make_reduce_forward(jnp.mean),
+)
+
+
+def _argminmax_infer(params: ReduceParams, in_shapes, in_dtypes):
+    shapes, _ = _reduce_infer(params, in_shapes, in_dtypes)
+    return shapes, [DataType.DT_INT32]
+
+
+register_op(
+    OperatorType.OP_REDUCE_ARGMAX,
+    "ArgMax",
+    infer=_argminmax_infer,
+    forward=lambda p, w, x, ctx: [
+        jnp.argmax(x[0], axis=p.axes[0], keepdims=p.keepdims).astype(jnp.int32)
+    ],
+)
+register_op(
+    OperatorType.OP_REDUCE_ARGMIN,
+    "ArgMin",
+    infer=_argminmax_infer,
+    forward=lambda p, w, x, ctx: [
+        jnp.argmin(x[0], axis=p.axes[0], keepdims=p.keepdims).astype(jnp.int32)
+    ],
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TopKParams:
+    """reference: include/flexflow/ops/topk_params.h"""
+
+    k: int
+    sorted: bool = True
+
+
+def _topk_infer(params: TopKParams, in_shapes, in_dtypes):
+    (s,) = in_shapes
+    out = tuple(s[:-1]) + (params.k,)
+    return [out, out], [in_dtypes[0], DataType.DT_INT32]
+
+
+def _topk_forward(params: TopKParams, w, x, ctx):
+    values, indices = lax.top_k(x[0], params.k)
+    return [values, indices.astype(jnp.int32)]
+
+
+register_op(OperatorType.OP_TOPK, "TopK", infer=_topk_infer, forward=_topk_forward)

@@ -1,0 +1,126 @@
+"""Operator definition registry.
+
+The reference implements each operator as a C++ class with Legion task
+plumbing (src/ops/*.cc) plus CUDA kernels (src/ops/kernels/*.cu). On TPU the
+per-device kernel IS the XLA program, so an operator definition reduces to:
+
+  * a hashable Params dataclass        (reference: include/flexflow/ops/*_params.h)
+  * shape inference                    (reference: each op's ctor computing output dims)
+  * weight specs                       (reference: each op's weight allocation)
+  * a pure forward function in jnp/lax (reference: src/ops/kernels/*.cu)
+
+Backward never needs hand-writing: jax.grad differentiates the whole train
+step (the reference writes a backward_task per op by hand).
+
+`measure_operator_cost` parity lives in search/cost_model.py, which times or
+analytically costs these same forward fns.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..ff_types import DataType, OperatorType
+
+
+@dataclasses.dataclass
+class WeightSpec:
+    """Declares one weight tensor of an op."""
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: DataType
+    initializer: str = "glorot_uniform"  # default per reference (model.cc dense/conv)
+    # Which logical op-dim each weight dim is tied to, for sharding propagation.
+    # e.g. Linear kernel (in,out): out follows the op's channel-parallel degree.
+    parallel_dim_tags: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass
+class OpDef:
+    op_type: OperatorType
+    name: str
+    # (params, input_shapes: List[Tuple[int,...]], input_dtypes) -> (out_shapes, out_dtypes)
+    infer: Callable
+    # (params, input_shapes, input_dtypes) -> List[WeightSpec]
+    weights: Callable
+    # (params, weights: Dict[str, Array], inputs: List[Array], ctx: FwdCtx) -> List[Array]
+    forward: Callable
+    # Number of inputs the op consumes (-1 = variadic)
+    num_inputs: int = 1
+
+
+_REGISTRY: Dict[OperatorType, OpDef] = {}
+
+
+def register_op(
+    op_type: OperatorType,
+    name: str,
+    *,
+    infer: Callable,
+    forward: Callable,
+    weights: Optional[Callable] = None,
+    num_inputs: int = 1,
+) -> OpDef:
+    d = OpDef(
+        op_type=op_type,
+        name=name,
+        infer=infer,
+        weights=weights or (lambda p, s, dt: []),
+        forward=forward,
+        num_inputs=num_inputs,
+    )
+    _REGISTRY[op_type] = d
+    return d
+
+
+def get_op_def(op_type: OperatorType) -> OpDef:
+    if op_type not in _REGISTRY:
+        raise NotImplementedError(f"operator {op_type.name} not registered")
+    return _REGISTRY[op_type]
+
+
+def has_op_def(op_type: OperatorType) -> bool:
+    return op_type in _REGISTRY
+
+
+def all_op_types() -> List[OperatorType]:
+    return list(_REGISTRY)
+
+
+@dataclasses.dataclass
+class FwdCtx:
+    """Per-call context threaded through op forwards."""
+
+    training: bool = True
+    rng: Optional[object] = None  # jax PRNGKey for dropout etc.
+    seq_length: int = -1  # FFIterationConfig.seq_length (reference: config.h:162)
+    compute_dtype: Optional[object] = None  # bf16 autocast target
+    # Differentiable auxiliary losses collected during the walk (MoE load
+    # balancing — reference folds these into gate grads in hand-written
+    # backwards, aggregate.cc; we add them to the scalar loss instead).
+    aux_losses: Optional[list] = None
+
+    def add_aux_loss(self, value):
+        if self.aux_losses is not None:
+            self.aux_losses.append(value)
+
+
+def ensure_ops_loaded():
+    """Import all op modules so their register_op calls run."""
+    from . import (  # noqa: F401
+        attention,
+        batch_matmul,
+        conv2d,
+        dropout,
+        elementwise,
+        embedding,
+        fused,
+        linear,
+        moe,
+        normalization,
+        pool2d,
+        reduce,
+        softmax,
+        tensor_ops,
+    )

@@ -1,0 +1,33 @@
+"""Softmax operator.
+
+TPU-native equivalent of reference src/ops/softmax.cc (cuDNN softmax with a
+`softmax_dim`): jax.nn.softmax, which XLA lowers to the standard
+max-subtract/exp/sum fusion on the VPU.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from ..ff_types import OperatorType
+from .registry import register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class SoftmaxParams:
+    """reference: include/flexflow/ops/softmax_params.h"""
+
+    dim: int = -1
+
+
+def _infer(params, in_shapes, in_dtypes):
+    return [in_shapes[0]], [in_dtypes[0]]
+
+
+def _forward(params: SoftmaxParams, weights, inputs, ctx):
+    (x,) = inputs
+    return [jax.nn.softmax(x, axis=params.dim)]
+
+
+register_op(OperatorType.OP_SOFTMAX, "Softmax", infer=_infer, forward=_forward)

@@ -1,0 +1,242 @@
+"""Shape/layout operators: reshape, flat, transpose, reverse, concat, split,
+cast, gather, pad, slice.
+
+TPU-native equivalents of reference src/ops/{reshape,flat,transpose,reverse,
+concat,split,cast,gather,pad}.cc. All of these are pure data-movement ops; on
+TPU they are XLA reshapes/transposes/gathers that the compiler folds into
+neighboring fusions (the reference needs a CUDA kernel + Legion task for
+each).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ff_types import DataType, OperatorType
+from .registry import register_op
+
+
+# -- Reshape (reference: src/ops/reshape.cc) --------------------------------
+@dataclasses.dataclass(frozen=True)
+class ReshapeParams:
+    shape: Tuple[int, ...]
+
+
+def _reshape_infer(params, in_shapes, in_dtypes):
+    (s,) = in_shapes
+    vol = int(np.prod(s))
+    out = list(params.shape)
+    if -1 in out:
+        i = out.index(-1)
+        rest = int(np.prod([d for d in out if d != -1]))
+        out[i] = vol // rest
+    assert int(np.prod(out)) == vol, f"reshape {s} -> {params.shape}"
+    return [tuple(out)], [in_dtypes[0]]
+
+
+register_op(
+    OperatorType.OP_RESHAPE,
+    "Reshape",
+    infer=_reshape_infer,
+    forward=lambda p, w, x, ctx: [jnp.reshape(x[0], _reshape_infer(p, [x[0].shape], [None])[0][0])],
+)
+
+
+# -- Flat (reference: src/ops/flat.cc — NCHW -> (N, C*H*W)) -----------------
+@dataclasses.dataclass(frozen=True)
+class FlatParams:
+    pass
+
+
+def _flat_infer(params, in_shapes, in_dtypes):
+    (s,) = in_shapes
+    return [(s[0], int(np.prod(s[1:])))], [in_dtypes[0]]
+
+
+register_op(
+    OperatorType.OP_FLAT,
+    "Flat",
+    infer=_flat_infer,
+    forward=lambda p, w, x, ctx: [jnp.reshape(x[0], (x[0].shape[0], -1))],
+)
+
+
+# -- Transpose (reference: src/ops/transpose.cc) ----------------------------
+@dataclasses.dataclass(frozen=True)
+class TransposeParams:
+    perm: Tuple[int, ...]
+
+
+def _transpose_infer(params, in_shapes, in_dtypes):
+    (s,) = in_shapes
+    return [tuple(s[p] for p in params.perm)], [in_dtypes[0]]
+
+
+register_op(
+    OperatorType.OP_TRANSPOSE,
+    "Transpose",
+    infer=_transpose_infer,
+    forward=lambda p, w, x, ctx: [jnp.transpose(x[0], p.perm)],
+)
+
+
+# -- Reverse (reference: src/ops/reverse.cc) --------------------------------
+@dataclasses.dataclass(frozen=True)
+class ReverseParams:
+    axis: int
+
+
+register_op(
+    OperatorType.OP_REVERSE,
+    "Reverse",
+    infer=lambda p, s, dt: ([s[0]], [dt[0]]),
+    forward=lambda p, w, x, ctx: [jnp.flip(x[0], axis=p.axis)],
+)
+
+
+# -- Concat (reference: src/ops/concat.cc) ----------------------------------
+@dataclasses.dataclass(frozen=True)
+class ConcatParams:
+    axis: int
+
+
+def _concat_infer(params, in_shapes, in_dtypes):
+    ax = params.axis % len(in_shapes[0])
+    out = list(in_shapes[0])
+    out[ax] = sum(s[ax] for s in in_shapes)
+    return [tuple(out)], [in_dtypes[0]]
+
+
+register_op(
+    OperatorType.OP_CONCAT,
+    "Concat",
+    infer=_concat_infer,
+    forward=lambda p, w, x, ctx: [jnp.concatenate(x, axis=p.axis)],
+    num_inputs=-1,
+)
+
+
+# -- Split (reference: src/ops/split.cc) ------------------------------------
+@dataclasses.dataclass(frozen=True)
+class SplitParams:
+    sizes: Tuple[int, ...]
+    axis: int
+
+
+def _split_infer(params, in_shapes, in_dtypes):
+    (s,) = in_shapes
+    ax = params.axis % len(s)
+    outs = []
+    for sz in params.sizes:
+        o = list(s)
+        o[ax] = sz
+        outs.append(tuple(o))
+    return outs, [in_dtypes[0]] * len(params.sizes)
+
+
+def _split_forward(params, w, x, ctx):
+    (t,) = x
+    idx = np.cumsum(params.sizes)[:-1].tolist()
+    return list(jnp.split(t, idx, axis=params.axis))
+
+
+register_op(OperatorType.OP_SPLIT, "Split", infer=_split_infer, forward=_split_forward)
+
+
+# -- Cast (reference: src/ops/cast.cc) --------------------------------------
+@dataclasses.dataclass(frozen=True)
+class CastParams:
+    dtype: DataType
+
+
+register_op(
+    OperatorType.OP_CAST,
+    "Cast",
+    infer=lambda p, s, dt: ([s[0]], [p.dtype]),
+    forward=lambda p, w, x, ctx: [x[0].astype(p.dtype.jnp_dtype)],
+)
+
+
+# -- Gather (reference: src/ops/gather.cc — torch.gather semantics) ---------
+@dataclasses.dataclass(frozen=True)
+class GatherParams:
+    dim: int
+
+
+def _gather_infer(params, in_shapes, in_dtypes):
+    data, index = in_shapes
+    return [tuple(index)], [in_dtypes[0]]
+
+
+def _gather_forward(params, w, x, ctx):
+    data, index = x
+    return [jnp.take_along_axis(data, index.astype(jnp.int32), axis=params.dim)]
+
+
+register_op(
+    OperatorType.OP_GATHER, "Gather", infer=_gather_infer, forward=_gather_forward,
+    num_inputs=2,
+)
+
+
+# -- Pad ---------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class PadParams:
+    pads: Tuple[Tuple[int, int], ...]
+    value: float = 0.0
+
+
+def _pad_infer(params, in_shapes, in_dtypes):
+    (s,) = in_shapes
+    out = tuple(d + lo + hi for d, (lo, hi) in zip(s, params.pads))
+    return [out], [in_dtypes[0]]
+
+
+register_op(
+    OperatorType.OP_PAD,
+    "Pad",
+    infer=_pad_infer,
+    forward=lambda p, w, x, ctx: [
+        jnp.pad(x[0], p.pads, constant_values=p.value)
+    ],
+)
+
+
+# -- Slice -------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class SliceParams:
+    starts: Tuple[int, ...]
+    ends: Tuple[int, ...]
+
+
+def _slice_infer(params, in_shapes, in_dtypes):
+    (s,) = in_shapes
+    out = tuple(e - b for b, e in zip(params.starts, params.ends))
+    return [out], [in_dtypes[0]]
+
+
+register_op(
+    OperatorType.OP_SLICE,
+    "Slice",
+    infer=_slice_infer,
+    forward=lambda p, w, x, ctx: [
+        x[0][tuple(slice(b, e) for b, e in zip(p.starts, p.ends))]
+    ],
+)
+
+
+# -- NoOp / Identity passthrough for PCG source nodes ------------------------
+@dataclasses.dataclass(frozen=True)
+class NoOpParams:
+    pass
+
+
+register_op(
+    OperatorType.OP_NOOP,
+    "NoOp",
+    infer=lambda p, s, dt: ([s[0]], [dt[0]]),
+    forward=lambda p, w, x, ctx: [x[0]],
+)

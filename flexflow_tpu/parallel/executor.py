@@ -1,0 +1,225 @@
+"""PCG executor: lowers a parallelized PCG to one jitted XLA program.
+
+This replaces the reference's entire execution stack — Legion IndexLaunchers
+per op (src/ops/*.cc forward()/backward()), FFMapper placement
+(src/mapper/mapper.cc), Realm data movement, and NCCL gradient allreduce
+(src/runtime/optimizer.cc nccl_update_task) — with a single SPMD program:
+
+  * op forwards run in topo order inside one traced function,
+  * ParallelTensor shardings become with_sharding_constraint, so the XLA
+    partitioner inserts the collectives the reference's parallel ops and
+    NCCL calls perform,
+  * jax.grad generates every backward task,
+  * the optimizer update is fused into the same program (the reference's
+    overlap_backward_update, config.h:133, is automatic here),
+  * Legion trace replay (begin/end_trace) ≈ the jit cache.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core import losses as losses_mod
+from ..core.initializers import get_initializer
+from ..core.metrics import Metrics
+from ..core.optimizers import Optimizer
+from ..ff_types import CompMode, DataType, LossType, OperatorType
+from ..ops.registry import FwdCtx, get_op_def
+from ..pcg.graph import Graph
+from ..pcg.op import PCGOp
+from .mesh import pspec_for_parallel_tensor, sharding_for_parallel_tensor
+from . import parallel_ops as par_ops
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    """All device-resident state of a compiled model."""
+
+    params: Dict[str, Dict[str, jax.Array]]
+    opt_state: Any
+    step: int = 0
+
+
+class PCGExecutor:
+    """Builds and caches the jitted step functions for a PCG."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        mesh: Mesh,
+        optimizer: Optimizer,
+        loss_type: LossType,
+        metrics: Metrics,
+        *,
+        compute_dtype=None,
+        seed: int = 0,
+        input_order: Optional[List] = None,
+    ):
+        self.graph = graph
+        self.mesh = mesh
+        self.optimizer = optimizer
+        self.loss_type = loss_type
+        self.loss_fn = losses_mod.get_loss_fn(loss_type)
+        self.metrics = metrics
+        self.compute_dtype = compute_dtype
+        self.seed = seed
+        self.topo = graph.topo_order()
+        # User-facing input order is tensor *creation* order (the order of
+        # FFModel.create_tensor calls), not graph consumption order —
+        # multi-input models (DLRM dense+sparse, enc-dec) depend on it.
+        self.input_pts = (
+            list(input_order) if input_order is not None else graph.input_tensors()
+        )
+        outs = graph.output_tensors()
+        assert outs, "graph has no output tensor"
+        self.logits_pt = outs[-1]
+        self._train_step = None
+        self._eval_step = None
+        self._fwd = None
+
+    # -- parameter init (reference: initializer Legion tasks per weight) ----
+    def init_params(self) -> Dict[str, Dict[str, jax.Array]]:
+        key = jax.random.PRNGKey(self.seed)
+        params: Dict[str, Dict[str, jax.Array]] = {}
+        with jax.default_device(jax.devices()[0]):
+            for op in self.topo:
+                if not op.weights:
+                    continue
+                wd: Dict[str, jax.Array] = {}
+                for name, wpt in zip(op.weight_names, op.weights):
+                    key, sub = jax.random.split(key)
+                    init = get_initializer(op.initializers.get(name, "glorot_uniform"))
+                    arr = init(sub, wpt.material_shape(), wpt.data_type.jnp_dtype)
+                    sharding = sharding_for_parallel_tensor(wpt, self.mesh)
+                    wd[name] = jax.device_put(arr, sharding)
+                params[op.name] = wd
+        return params
+
+    def init_state(self) -> TrainState:
+        params = self.init_params()
+        opt_state = self.optimizer.init_state(params)
+        return TrainState(params=params, opt_state=opt_state)
+
+    # -- forward ------------------------------------------------------------
+    def _constrain(self, val, pt):
+        spec = pspec_for_parallel_tensor(pt, self.mesh)
+        if any(s is not None for s in spec):
+            return jax.lax.with_sharding_constraint(
+                val, NamedSharding(self.mesh, spec)
+            )
+        return val
+
+    def apply(
+        self,
+        params,
+        inputs: Dict[int, jax.Array],
+        *,
+        training: bool,
+        rng: Optional[jax.Array],
+        seq_length: int = -1,
+        aux_out: Optional[list] = None,
+    ) -> Dict[int, jax.Array]:
+        """Walk the PCG and compute every tensor. Returns guid -> value.
+        Differentiable aux losses (MoE balance) are appended to aux_out."""
+        vals: Dict[int, jax.Array] = dict(inputs)
+        for op in self.topo:
+            ins = [vals[t.guid] for t in op.inputs]
+            if op.is_parallel_op:
+                outs = par_ops.execute(op, ins, self.mesh)
+            else:
+                opdef = get_op_def(op.op_type)
+                op_rng = (
+                    jax.random.fold_in(rng, op.guid) if rng is not None else None
+                )
+                ctx = FwdCtx(
+                    training=training,
+                    rng=op_rng,
+                    seq_length=seq_length,
+                    compute_dtype=self.compute_dtype,
+                    aux_losses=aux_out,
+                )
+                outs = opdef.forward(op.params, params.get(op.name, {}), ins, ctx)
+            for t, o in zip(op.outputs, outs):
+                vals[t.guid] = self._constrain(o, t)
+        return vals
+
+    # -- step functions -----------------------------------------------------
+    def _input_vals(self, batch_arrays: List[jax.Array]) -> Dict[int, jax.Array]:
+        assert len(batch_arrays) == len(self.input_pts), (
+            f"model takes {len(self.input_pts)} inputs, got {len(batch_arrays)}"
+        )
+        return {pt.guid: a for pt, a in zip(self.input_pts, batch_arrays)}
+
+    def build_train_step(self) -> Callable:
+        if self._train_step is not None:
+            return self._train_step
+
+        def step(state: TrainState, batch_inputs, labels, rng):
+            def loss_of(params):
+                aux: list = []
+                vals = self.apply(
+                    params, self._input_vals(batch_inputs), training=True, rng=rng,
+                    aux_out=aux,
+                )
+                logits = vals[self.logits_pt.guid]
+                loss = self.loss_fn(logits, labels)
+                for a in aux:
+                    loss = loss + a
+                return loss, logits
+
+            (loss, logits), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                state.params
+            )
+            new_params, new_opt = self.optimizer.update(
+                state.params, grads, state.opt_state
+            )
+            partials = self.metrics.compute(logits, labels)
+            partials["loss"] = loss
+            return (
+                TrainState(params=new_params, opt_state=new_opt, step=state.step + 1),
+                partials,
+            )
+
+        self._train_step = jax.jit(step, donate_argnums=(0,))
+        return self._train_step
+
+    def build_eval_step(self) -> Callable:
+        if self._eval_step is not None:
+            return self._eval_step
+
+        def step(params, batch_inputs, labels):
+            vals = self.apply(
+                params, self._input_vals(batch_inputs), training=False, rng=None
+            )
+            logits = vals[self.logits_pt.guid]
+            partials = self.metrics.compute(logits, labels)
+            partials["loss"] = self.loss_fn(logits, labels)
+            return logits, partials
+
+        self._eval_step = jax.jit(step)
+        return self._eval_step
+
+    def build_forward(self) -> Callable:
+        if self._fwd is not None:
+            return self._fwd
+
+        def fwd(params, batch_inputs):
+            vals = self.apply(
+                params, self._input_vals(batch_inputs), training=False, rng=None
+            )
+            return vals[self.logits_pt.guid]
+
+        self._fwd = jax.jit(fwd)
+        return self._fwd
+
+    # -- data placement -----------------------------------------------------
+    def shard_batch(self, pt, array) -> jax.Array:
+        sharding = sharding_for_parallel_tensor(pt, self.mesh)
+        return jax.device_put(array, sharding)

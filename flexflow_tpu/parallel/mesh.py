@@ -1,0 +1,88 @@
+"""Device mesh construction and ParallelTensor → sharding lowering.
+
+This is where the reference's MachineView/ParallelTensor machinery meets
+TPU hardware: a jax.sharding.Mesh plays the role of the reference's machine
+(all GPUs across nodes), NamedSharding plays the role of a ParallelTensor's
+Legion partition, and the XLA SPMD partitioner plays the role of FFMapper +
+Realm data movement (reference: src/mapper/mapper.cc slice_task routing each
+index point to its MachineView device).
+
+Axis convention: a mesh is built with an ordered dict of named axes. A
+ParallelDim with degree>1 carries `parallel_idx` = index into that axis list.
+Replica dims (is_replica_dim) mean the *other* dims' shards are replicated
+over that axis — for weights under DP this is exactly "replicated over the
+data axis".
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+# Canonical axis names in priority order. data = sample dim, model = tensor
+# parallel, seq = sequence/context parallel, expert = MoE experts,
+# pipe = pipeline stages.
+AXIS_NAMES = ("data", "model", "seq", "expert", "pipe")
+
+
+def build_mesh(
+    axis_sizes: Dict[str, int], devices: Optional[Sequence] = None
+) -> Mesh:
+    """Build a Mesh from {axis_name: size}. Total size must divide the
+    device count; leftover devices are left out (like a MachineView that
+    doesn't cover the whole machine)."""
+    if devices is None:
+        devices = jax.devices()
+    # keep size-1 axes so axis indices are stable across strategies
+    axes = list(axis_sizes.items()) or [("data", 1)]
+    n = int(np.prod([v for _, v in axes]))
+    assert n <= len(devices), f"mesh {axis_sizes} needs {n} devices, have {len(devices)}"
+    dev_array = np.asarray(devices[:n]).reshape([v for _, v in axes])
+    return Mesh(dev_array, tuple(k for k, _ in axes))
+
+
+def default_data_parallel_mesh(num_devices: Optional[int] = None) -> Mesh:
+    devices = jax.devices()
+    n = num_devices or len(devices)
+    return build_mesh({"data": n}, devices)
+
+
+def pspec_for_parallel_tensor(pt, mesh: Mesh) -> PartitionSpec:
+    """Lower ParallelTensor dims to a PartitionSpec over `mesh`.
+
+    Partitioned material dims map to their axis; replica dims are dropped
+    (replication is PartitionSpec's default for unmentioned axes)."""
+    names = mesh.axis_names
+    spec = []
+    for d in pt.dims:
+        if d.is_replica_dim:
+            continue
+        if d.degree > 1 and 0 <= d.parallel_idx < len(names):
+            spec.append(names[d.parallel_idx])
+        else:
+            spec.append(None)
+    # trim trailing Nones
+    while spec and spec[-1] is None:
+        spec.pop()
+    return PartitionSpec(*spec)
+
+
+def sharding_for_parallel_tensor(pt, mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, pspec_for_parallel_tensor(pt, mesh))
+
+
+def machine_view_to_axes(view, mesh: Mesh) -> Tuple[str, ...]:
+    """Map a MachineView's dims onto mesh axis names by size. Round-1
+    restriction: views must align with mesh axis sizes (the search's
+    enumerate_machine_views generates views that do)."""
+    out = []
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    for d in view.dim:
+        for name, sz in sizes.items():
+            if sz == d and name not in out:
+                out.append(name)
+                break
+    return tuple(out)

@@ -1,0 +1,114 @@
+"""Parallel operators: Repartition, Combine, Replicate, Reduction,
+FusedParallelOp, AllToAll.
+
+TPU-native equivalents of reference src/parallel_ops/{partition,combine,
+replicate,reduction,fused_parallel_op}.cc — the "parallelism vocabulary" the
+Unity search inserts into the PCG (SURVEY §2.3). The reference implements
+each as Legion partition plumbing + device-local copy kernels; under XLA SPMD
+each is a resharding annotation, and the partitioner emits the actual
+collective (all-gather / reduce-scatter / all-to-all / psum) over ICI.
+
+Semantics (training fwd; bwd is derived by jax.grad through the sharding
+constraint, which transposes to exactly the reference's backward):
+  Repartition dim,k : split dim into k shards           (bwd: gather)
+  Combine     dim,k : gather k shards of dim            (bwd: scatter)
+  Replicate   k     : broadcast k copies                (bwd: grad-sum)
+  Reduction   k     : sum k partial copies              (bwd: broadcast)
+  AllToAll    d1,d2 : reshard dim d1 -> d2 (Ulysses-style sequence<->head
+                      exchange; TPU addition, no reference equivalent)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..ff_types import OperatorType
+from ..pcg.op import PCGOp
+
+
+@dataclasses.dataclass(frozen=True)
+class RepartitionParams:
+    """reference: include/flexflow/parallel_ops/partition_params.h"""
+
+    repartition_dim: int
+    repartition_degree: int
+
+
+@dataclasses.dataclass(frozen=True)
+class CombineParams:
+    """reference: include/flexflow/parallel_ops/combine_params.h"""
+
+    combine_dim: int
+    combine_degree: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicateParams:
+    """reference: include/flexflow/parallel_ops/replicate_params.h"""
+
+    replicate_dim: int
+    replicate_degree: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ReductionParams:
+    """reference: include/flexflow/parallel_ops/reduction_params.h"""
+
+    reduction_dim: int
+    reduction_degree: int
+
+
+@dataclasses.dataclass(frozen=True)
+class AllToAllParams:
+    """TPU addition: Ulysses-style sequence parallelism exchange."""
+
+    scatter_dim: int
+    gather_dim: int
+    degree: int
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedParallelOpParams:
+    """reference: parallel_ops/fused_parallel_op.h ParallelOpInfo list"""
+
+    stages: Tuple[object, ...]  # sequence of the above param records
+
+
+def _out_spec(op: PCGOp, mesh: Mesh) -> PartitionSpec:
+    from .mesh import pspec_for_parallel_tensor
+
+    return pspec_for_parallel_tensor(op.outputs[0], mesh)
+
+
+def execute(op: PCGOp, inputs: List[jax.Array], mesh: Mesh) -> List[jax.Array]:
+    """Execute a parallel op under GSPMD: the op's *output* ParallelTensor
+    already carries the target sharding, so every flavor lowers to a
+    with_sharding_constraint and XLA inserts the matching collective.
+
+    Reduction additionally must sum over the vanishing replica dim when the
+    graph was built with explicit partial tensors (search-produced PCGs mark
+    that with a replica dim on the input)."""
+    (x,) = inputs
+    t = op.op_type
+    if t in (
+        OperatorType.OP_REPARTITION,
+        OperatorType.OP_COMBINE,
+        OperatorType.OP_REPLICATE,
+        OperatorType.OP_ALL_TO_ALL,
+        OperatorType.OP_FUSED_PARALLEL,
+    ):
+        spec = _out_spec(op, mesh)
+        return [jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))]
+    if t == OperatorType.OP_REDUCTION:
+        # Under GSPMD the partial-sum state is XLA-internal; annotating the
+        # output as fully materialized triggers the reduce. If the input
+        # carries an explicit leading replica/partial dim, sum it out.
+        in_pt = op.inputs[0]
+        if in_pt.num_dims == op.outputs[0].num_dims + 1:
+            x = x.sum(axis=op.params.reduction_dim)
+        spec = _out_spec(op, mesh)
+        return [jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))]
+    raise NotImplementedError(f"parallel op {t.name}")

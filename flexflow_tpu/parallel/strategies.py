@@ -1,0 +1,105 @@
+"""Built-in parallelization strategies applied to a PCG.
+
+The reference reaches a parallelized PCG either through the Unity search or
+through `--only-data-parallel` lowering (model.cc:2637-2642, which inserts a
+batch-dim Repartition). These passes are the no-search equivalents: they
+assign degrees/parallel_idx to ParallelTensor dims in place. The search
+(flexflow_tpu/search/) produces the same annotations via MachineViews.
+
+Axis indices refer to the mesh axis list (parallel/mesh.py AXIS_NAMES order
+as built for the run).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..ff_types import OperatorType
+from ..pcg.graph import Graph
+from ..pcg.op import PCGOp
+
+
+def apply_data_parallel(graph: Graph, degree: int, axis_idx: int = 0) -> None:
+    """Shard dim 0 (sample dim) of every activation tensor by `degree`.
+
+    reference: FFModel::get_basic_data_parallel_config (model.h:250) +
+    the OP_INPUT Repartition insertion (model.cc:2637)."""
+    if degree <= 1:
+        return
+    tensors = list(graph.input_tensors())
+    for op in graph.ops:
+        tensors.extend(op.outputs)
+    for t in tensors:
+        if t.num_dims == 0:
+            continue
+        d0 = t.dims[0]
+        if d0.size % degree == 0 and not d0.is_replica_dim:
+            d0.degree = degree
+            d0.parallel_idx = axis_idx
+    # weights stay replicated (degree 1) — XLA all-reduces their grads.
+
+
+def apply_tensor_parallel(graph: Graph, degree: int, axis_idx: int = 1) -> None:
+    """Megatron-style tensor/model parallelism via weight-dim sharding.
+
+    reference equivalents: Linear replica-dim model parallelism
+    (model.cc:1979 map_linear_weight + Replicate/Reduction pairs) and
+    attention attribute parallelism over heads (substitution.cc:1764-1770).
+    Here: shard weight dims tagged "out_channel"/"head"/"vocab" over the
+    model mesh axis; GSPMD inserts the Replicate/Reduction collectives the
+    reference materializes as parallel ops.
+
+    Activations: the hidden dim of LINEAR outputs is sharded to keep the
+    matmul local (column-parallel); attention output stays replicated (the
+    wo einsum contracts the head dim, producing the reduction)."""
+    if degree <= 1:
+        return
+    for op in graph.ops:
+        tags_list = getattr(op, "weight_tags", [])
+        shard_out = False
+        for wpt, tags in zip(op.weights, tags_list):
+            for i, tag in enumerate(tags):
+                if tag in ("out_channel", "head", "vocab") and (
+                    wpt.dims[i].size % degree == 0
+                ):
+                    wpt.dims[i].degree = degree
+                    wpt.dims[i].parallel_idx = axis_idx
+                    if tag == "out_channel":
+                        shard_out = True
+                    break  # one sharded dim per weight
+        if shard_out and op.op_type == OperatorType.OP_LINEAR:
+            for t in op.outputs:
+                last = t.dims[-1]
+                if last.size % degree == 0:
+                    last.degree = degree
+                    last.parallel_idx = axis_idx
+
+
+def apply_expert_parallel(graph: Graph, degree: int, axis_idx: int) -> None:
+    """Expert parallelism: distinct experts' dense ops run on distinct mesh
+    slots (reference: MoE ops get distinct MachineViews, SURVEY §2.3). Under
+    SPMD we shard the leading expert-capacity dim of group_by outputs."""
+    if degree <= 1:
+        return
+    for op in graph.ops:
+        if op.op_type == OperatorType.OP_GROUP_BY:
+            for t in op.outputs:
+                if t.dims[0].size % degree == 0:
+                    t.dims[0].degree = degree
+                    t.dims[0].parallel_idx = axis_idx
+
+
+def apply_sequence_parallel(
+    graph: Graph, degree: int, axis_idx: int, seq_dim: int = 1
+) -> None:
+    """Shard the sequence dim of 3-D activations (batch, seq, hidden).
+
+    No reference equivalent (SURVEY §5: sequence parallelism absent there);
+    this is the TPU build's first-class SP strategy. Attention ops handle the
+    resharding internally (ring attention / all-to-all in kernels/)."""
+    if degree <= 1:
+        return
+    for op in graph.ops:
+        for t in op.outputs:
+            if t.num_dims == 3 and t.dims[seq_dim].size % degree == 0:
+                t.dims[seq_dim].degree = degree
+                t.dims[seq_dim].parallel_idx = axis_idx

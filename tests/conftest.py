@@ -1,0 +1,23 @@
+"""Test config: run everything on a virtual 8-device CPU mesh so sharding
+paths are exercised without TPU hardware (SURVEY §4: substitutes for the
+reference's no-cluster gap; the reference needs real GPUs for most tests).
+
+The environment may auto-register a remote-TPU ("axon") jax backend at
+interpreter boot whose client init blocks on a tunnel; tests must never touch
+it. Deregistering the factory + forcing the cpu platform post-import is the
+reliable way since sitecustomize already imported jax.
+"""
+import os
+
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+try:
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,132 @@
+"""End-to-end training tests: the minimum slice of SURVEY §7 stage 1.
+
+Mirrors the reference's integration strategy (tests/multi_gpu_tests.sh runs
+example scripts and checks they train; examples/python/native/accuracy.py
+thresholds): build a model through the FFModel API, compile, fit, and assert
+the loss goes down / accuracy rises on a learnable synthetic task.
+"""
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    ActiMode,
+    AdamOptimizer,
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+
+
+def make_config(batch_size=32, epochs=1):
+    cfg = FFConfig()
+    cfg.batch_size = batch_size
+    cfg.epochs = epochs
+    return cfg
+
+
+def synthetic_classification(n, dims, num_classes, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, *dims).astype(np.float32)
+    w = rng.randn(int(np.prod(dims)), num_classes).astype(np.float32)
+    y = np.argmax(x.reshape(n, -1) @ w, axis=1).astype(np.int32)[:, None]
+    return x, y
+
+
+def test_mlp_trains():
+    cfg = make_config(batch_size=64, epochs=5)
+    model = FFModel(cfg)
+    x = model.create_tensor((64, 16), DataType.DT_FLOAT)
+    t = model.dense(x, 64, ActiMode.AC_MODE_RELU)
+    t = model.dense(t, 32, ActiMode.AC_MODE_RELU)
+    t = model.dense(t, 4)
+    t = model.softmax(t)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY],
+    )
+    xs, ys = synthetic_classification(1024, (16,), 4)
+    model.fit(xs, ys, batch_size=64, epochs=20, verbose=False)
+    pm = model.eval(xs, ys, batch_size=64)
+    assert pm.get_accuracy() > 60.0, f"accuracy {pm.get_accuracy()}"
+
+
+def test_cnn_trains():
+    cfg = make_config(batch_size=16, epochs=3)
+    model = FFModel(cfg)
+    x = model.create_tensor((16, 3, 16, 16), DataType.DT_FLOAT)
+    t = model.conv2d(x, 8, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = model.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = model.flat(t)
+    t = model.dense(t, 3)
+    t = model.softmax(t)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.02),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY],
+    )
+    xs, ys = synthetic_classification(256, (3, 16, 16), 3)
+    pm = model.fit(xs, ys, batch_size=16, epochs=3, verbose=False)
+    assert pm.train_all > 0
+
+
+def test_adam_mse_regression():
+    cfg = make_config(batch_size=32, epochs=10)
+    model = FFModel(cfg)
+    x = model.create_tensor((32, 8), DataType.DT_FLOAT)
+    t = model.dense(x, 16, ActiMode.AC_MODE_TANH)
+    t = model.dense(t, 2)
+    model.compile(
+        optimizer=AdamOptimizer(alpha=0.01),
+        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR],
+    )
+    rng = np.random.RandomState(1)
+    xs = rng.randn(512, 8).astype(np.float32)
+    w = rng.randn(8, 2).astype(np.float32)
+    ys = (xs @ w).astype(np.float32)
+    pm = model.fit(xs, ys, batch_size=32, epochs=10, verbose=False)
+    mse = pm.mse_loss / max(1, pm.train_all)
+    assert mse < 2.0, f"mse {mse}"
+
+
+def test_stepwise_api():
+    """cffi-parity: forward/zero_gradients/backward/update as separate calls
+    (reference: flexflow_cffi.py fit loop body)."""
+    model = FFModel(make_config())
+    x = model.create_tensor((8, 4), DataType.DT_FLOAT)
+    t = model.dense(x, 4)
+    t = model.softmax(t)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.1),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY],
+    )
+    xs, ys = synthetic_classification(8, (4,), 4)
+    model.set_iteration_batch([xs], ys)
+    before = model.forward()
+    model.zero_gradients()
+    model.backward()
+    model.update()
+    after = model.forward()
+    assert not np.allclose(np.asarray(before), np.asarray(after))
+
+
+def test_weight_get_set():
+    model = FFModel(make_config())
+    x = model.create_tensor((8, 4), DataType.DT_FLOAT)
+    t = model.dense(x, 3, use_bias=True)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.1),
+        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[],
+    )
+    layer = model.get_layer_by_id(0)
+    kernel = layer.weights[0].get_tensor(model)
+    assert kernel.shape == (4, 3)
+    new = np.ones((4, 3), np.float32)
+    layer.weights[0].set_tensor(model, new)
+    np.testing.assert_allclose(layer.weights[0].get_tensor(model), new)
