@@ -553,20 +553,34 @@ class FFModel:
         for t in self.graph.input_tensors():
             self._pt_by_guid[t.guid] = t
 
-        # 2. Parallelization strategy. Default: data parallel over remaining
-        #    devices after manual tp/sp/ep degrees (reference
-        #    --only-data-parallel path when all degrees are 1); the Unity
-        #    search replaces these annotations when budget >= 0.
+        # 2. Parallelization strategy.
+        #    - search_budget >= 0: Unity search (substitutions + DP view
+        #      assignment, reference model.cc:2826 GRAPH_OPTIMIZE path).
+        #    - else: manual degrees / pure data parallel (reference
+        #      --only-data-parallel lowering).
         ndev = min(self.config.numWorkers, len(jax.devices()))
-        tp = max(1, self.config.tensor_parallel_degree)
-        sp = max(1, self.config.sequence_parallel_degree)
-        ep = max(1, self.config.expert_parallel_degree)
-        dp = max(1, ndev // (tp * sp * ep))
-        mesh = build_mesh({"data": dp, "model": tp, "seq": sp, "expert": ep})
-        strategies.apply_data_parallel(self.graph, dp, axis_idx=0)
-        strategies.apply_tensor_parallel(self.graph, tp, axis_idx=1)
-        strategies.apply_sequence_parallel(self.graph, sp, axis_idx=2)
-        strategies.apply_expert_parallel(self.graph, ep, axis_idx=3)
+        # Record user input order positionally BEFORE any search rewrite
+        # (rewrites copy the graph with fresh tensor guids; graph input
+        # order is stable under copy, so positions survive).
+        pre_inputs = self.graph.input_tensors()
+        pre_pos = {pt.guid: i for i, pt in enumerate(pre_inputs)}
+        self._input_positions = [
+            pre_pos[self._tensor_map[t.guid]]
+            for t in self.input_tensors
+            if self._tensor_map.get(t.guid) in pre_pos
+        ]
+        if self.config.search_budget >= 0 and not self.config.only_data_parallel:
+            mesh = self._run_strategy_search(ndev)
+        else:
+            tp = max(1, self.config.tensor_parallel_degree)
+            sp = max(1, self.config.sequence_parallel_degree)
+            ep = max(1, self.config.expert_parallel_degree)
+            dp = max(1, ndev // (tp * sp * ep))
+            mesh = build_mesh({"data": dp, "model": tp, "seq": sp, "expert": ep})
+            strategies.apply_data_parallel(self.graph, dp, axis_idx=0)
+            strategies.apply_tensor_parallel(self.graph, tp, axis_idx=1)
+            strategies.apply_sequence_parallel(self.graph, sp, axis_idx=2)
+            strategies.apply_expert_parallel(self.graph, ep, axis_idx=3)
 
         # 3. Label tensor matched to final op's sharding (model.cc:3054)
         logits_pt = self.graph.output_tensors()[-1]
@@ -591,12 +605,8 @@ class FFModel:
         )
         # Map user input tensors (creation order) to their PCG tensors; only
         # those actually consumed by the graph become executor inputs.
-        graph_input_guids = {t.guid for t in self.graph.input_tensors()}
-        ordered_inputs = [
-            self._pt_by_guid[self._tensor_map[t.guid]]
-            for t in self.input_tensors
-            if self._tensor_map.get(t.guid) in graph_input_guids
-        ]
+        cur_inputs = self.graph.input_tensors()
+        ordered_inputs = [cur_inputs[i] for i in self._input_positions]
         self.executor = PCGExecutor(
             self.graph,
             mesh,
@@ -609,6 +619,71 @@ class FFModel:
         )
         self.state = self.executor.init_state()
         self.perf_metrics = PerfMetrics()
+
+    def _run_strategy_search(self, ndev: int):
+        """Unity search over the lowered PCG (reference: compile's
+        GRAPH_OPTIMIZE_TASK -> GraphSearchHelper::graph_optimize,
+        substitution.cc:1898). Returns the execution mesh."""
+        from ..pcg.machine_view import MachineResource
+        from ..search import (
+            CostModel,
+            GraphSearchHelper,
+            MachineModel,
+            SearchHelper,
+            generate_all_pcg_xfers,
+            parse_machine_config,
+        )
+
+        cfg = self.config
+        if cfg.machine_model_file:
+            machine = parse_machine_config(cfg.machine_model_file)
+        else:
+            nodes = cfg.search_num_nodes if cfg.search_num_nodes > 0 else cfg.numNodes
+            workers = (
+                cfg.search_num_workers
+                if cfg.search_num_workers > 0
+                else cfg.workersPerNode
+            )
+            machine = MachineModel(num_nodes=nodes, workers_per_node=workers)
+        cost_model = CostModel(machine, bf16=cfg.allow_mixed_precision)
+        sh = SearchHelper(cost_model)
+        degrees = []
+        d = 2
+        while d <= machine.num_workers:
+            degrees.append(d)
+            d *= 2
+        budget = cfg.search_budget if cfg.search_budget > 0 else 10
+        gsh = GraphSearchHelper(
+            sh,
+            generate_all_pcg_xfers(degrees or [1], cfg),
+            alpha=cfg.search_alpha,
+            budget=budget,
+        )
+        res = MachineResource(
+            num_nodes=machine.num_nodes,
+            all_procs_per_node=machine.workers_per_node,
+            available_procs_per_node=machine.workers_per_node,
+        )
+        best_graph, result = gsh.graph_optimize(self.graph, res)
+        self.graph = best_graph
+        self.searched_views = result.views
+        self.searched_cost = result.cost
+        # re-index pt lookup for the (possibly rewritten) graph
+        self._pt_by_guid = {}
+        for op in self.graph.ops:
+            for t in list(op.outputs) + list(op.weights):
+                self._pt_by_guid[t.guid] = t
+        for t in self.graph.input_tensors():
+            self._pt_by_guid[t.guid] = t
+        if cfg.export_strategy_file:
+            from ..runtime.strategy_io import export_strategy
+
+            export_strategy(self.graph, result, cfg.export_strategy_file)
+        if cfg.export_strategy_computation_graph_file:
+            with open(cfg.export_strategy_computation_graph_file, "w") as f:
+                f.write(self.graph.export_dot())
+        axis_sizes = strategies.assign_mesh_axes(self.graph, ndev)
+        return build_mesh(axis_sizes)
 
     # ------------------------------------------------------------------
     # training loop (reference: flexflow_cffi.py:2058 fit)

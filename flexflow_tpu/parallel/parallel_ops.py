@@ -103,11 +103,13 @@ def execute(op: PCGOp, inputs: List[jax.Array], mesh: Mesh) -> List[jax.Array]:
         spec = _out_spec(op, mesh)
         return [jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))]
     if t == OperatorType.OP_REDUCTION:
-        # Under GSPMD the partial-sum state is XLA-internal; annotating the
-        # output as fully materialized triggers the reduce. If the input
-        # carries an explicit leading replica/partial dim, sum it out.
-        in_pt = op.inputs[0]
-        if in_pt.num_dims == op.outputs[0].num_dims + 1:
+        # Under GSPMD the partial-sum state is XLA-internal (a sharded
+        # contraction yields the full result with an implicit psum), so the
+        # logical replica dim on the input ParallelTensor has no runtime
+        # axis. Only sum when the array actually carries the partial axis
+        # (shard_map execution path).
+        out_ndim = len(op.outputs[0].material_shape())
+        if x.ndim == out_ndim + 1:
             x = x.sum(axis=op.params.reduction_dim)
         spec = _out_spec(op, mesh)
         return [jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))]

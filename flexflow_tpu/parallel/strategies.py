@@ -38,6 +38,58 @@ def apply_data_parallel(graph: Graph, degree: int, axis_idx: int = 0) -> None:
     # weights stay replicated (degree 1) — XLA all-reduces their grads.
 
 
+def assign_mesh_axes(graph: Graph, max_devices: int) -> Dict[str, int]:
+    """Lower a searched PCG (tensor degrees set by substitutions, views by
+    the DP) to GSPMD mesh axes.
+
+    The reference executes heterogeneous per-op MachineViews via Legion task
+    placement; under one SPMD program we map degrees onto named mesh axes:
+    sample-dim degrees -> "data", channel/head/weight degrees -> "model".
+    A dim whose degree doesn't equal its axis size can't shard evenly under
+    NamedSharding and is demoted to replicated (round-1 lowering limit; the
+    reference's fully heterogeneous placements would need per-segment
+    programs)."""
+    data_deg, model_deg = 1, 1
+    tensors = list(graph.input_tensors())
+    for op in graph.ops:
+        tensors.extend(op.outputs)
+        tensors.extend(op.weights)
+    # classify: activation dim0 = data; everything else = model
+    weight_guids = {w.guid for op in graph.ops for w in op.weights}
+    for t in tensors:
+        is_weight = t.guid in weight_guids
+        for i, d in enumerate(t.dims):
+            if d.degree <= 1 or d.is_replica_dim:
+                continue
+            if i == 0 and not is_weight:
+                data_deg = max(data_deg, d.degree)
+            else:
+                model_deg = max(model_deg, d.degree)
+    while data_deg * model_deg > max_devices and data_deg > 1:
+        data_deg //= 2
+    while data_deg * model_deg > max_devices and model_deg > 1:
+        model_deg //= 2
+    for t in tensors:
+        is_weight = t.guid in weight_guids
+        for i, d in enumerate(t.dims):
+            if d.degree <= 1:
+                continue
+            if d.is_replica_dim:
+                d.parallel_idx = -1
+                continue
+            if i == 0 and not is_weight:
+                if d.degree == data_deg and data_deg > 1:
+                    d.parallel_idx = 0
+                else:
+                    d.degree, d.parallel_idx = 1, -1
+            else:
+                if d.degree == model_deg and model_deg > 1:
+                    d.parallel_idx = 1
+                else:
+                    d.degree, d.parallel_idx = 1, -1
+    return {"data": data_deg, "model": model_deg}
+
+
 def apply_tensor_parallel(graph: Graph, degree: int, axis_idx: int = 1) -> None:
     """Megatron-style tensor/model parallelism via weight-dim sharding.
 

@@ -166,6 +166,8 @@ class ParallelTensor:
             n *= d.degree
         return n
 
+    get_total_degree = get_total_num_parts
+
     def check_valid(self) -> bool:
         return all(d.is_valid() for d in self.dims)
 

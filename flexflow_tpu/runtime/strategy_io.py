@@ -1,0 +1,75 @@
+"""Strategy checkpoint: export/import a searched parallelization strategy.
+
+TPU-native equivalent of the reference's --export-strategy /
+--import-strategy files (README.md:76-77, config.h:141-142; the reference
+serializes per-op ParallelConfigs to a protobuf). Ours is JSON: per-op
+machine view + per-tensor degrees, enough to re-apply a strategy without
+re-searching.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict
+
+from ..pcg.graph import Graph
+from ..pcg.machine_view import MachineView
+
+
+def export_strategy(graph: Graph, result, path: str) -> None:
+    ops = []
+    for op in graph.topo_order():
+        view = result.views.get(op.guid) if result is not None else None
+        ops.append(
+            {
+                "name": op.name,
+                "op_type": op.op_type.name,
+                "layer_guid": op.layer_guid,
+                "machine_view": (
+                    {
+                        "start_device_id": view.start_device_id,
+                        "dim": list(view.dim),
+                        "stride": list(view.stride),
+                    }
+                    if view is not None
+                    else None
+                ),
+                "output_degrees": [
+                    [d.degree for d in t.dims] for t in op.outputs
+                ],
+                "weight_degrees": [
+                    [d.degree for d in t.dims] for t in op.weights
+                ],
+            }
+        )
+    blob = {"version": 1, "cost": getattr(result, "cost", None), "ops": ops}
+    with open(path, "w") as f:
+        json.dump(blob, f, indent=1)
+
+
+def import_strategy(path: str) -> Dict[str, dict]:
+    """Returns op name -> strategy record."""
+    with open(path) as f:
+        blob = json.load(f)
+    return {rec["name"]: rec for rec in blob["ops"]}
+
+
+def apply_imported_strategy(graph: Graph, strategy: Dict[str, dict]) -> None:
+    """Re-apply degrees/views from an imported strategy to a freshly lowered
+    PCG (ops matched by name, like the reference's config-file import)."""
+    for op in graph.ops:
+        rec = strategy.get(op.name)
+        if rec is None:
+            continue
+        mv = rec.get("machine_view")
+        if mv:
+            op.machine_view = MachineView(
+                start_device_id=mv["start_device_id"],
+                dim=tuple(mv["dim"]),
+                stride=tuple(mv["stride"]),
+            )
+        for t, degs in zip(op.outputs, rec.get("output_degrees", [])):
+            for d, deg in zip(t.dims, degs):
+                d.degree = deg
+        for w, degs in zip(op.weights, rec.get("weight_degrees", [])):
+            for d, deg in zip(w.dims, degs):
+                d.degree = deg

@@ -1,0 +1,12 @@
+"""Strategy search: cost model, DP machine-view assignment, substitution
+engine, MCMC fallback (TPU-native equivalents of reference
+src/runtime/{simulator,graph,substitution,model-mcmc}.cc)."""
+from .cost_model import CostMetrics, CostModel  # noqa: F401
+from .dp_search import GraphCostResult, SearchHelper  # noqa: F401
+from .machine_model import MachineModel, TPUChipSpec, parse_machine_config  # noqa: F401
+from .mcmc import MCMCSearch, simulate_runtime  # noqa: F401
+from .substitution import (  # noqa: F401
+    GraphSearchHelper,
+    Substitution,
+    generate_all_pcg_xfers,
+)

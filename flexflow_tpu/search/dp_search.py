@@ -1,0 +1,266 @@
+"""Unity's dynamic-programming machine-view assignment.
+
+TPU-native re-implementation of the reference SearchHelper
+(include/flexflow/graph.h:170-284, src/runtime/graph.cc:1803
+generic_optimal_cost): given a PCG (whose parallel *structure* — degrees and
+parallel ops — was fixed by substitutions), assign a MachineView to every op
+minimizing simulated step time, by recursively splitting the graph:
+
+  * sequence split at a bottleneck node (a node no edge jumps over in topo
+    order — the reference finds these via dominator analysis,
+    graph.cc:1631): enumerate the bottleneck's views; DP over
+    pre/post subgraphs with the boundary view fixed.
+  * horizontal (non-sequence) split of parallel branches
+    (graph.cc ~230-290 find_optimal_nonsequence_graph_time): independent
+    components run either on the full machine sequentially or on disjoint
+    halves concurrently (machine resource splitting).
+  * leaf: min over valid machine views of op cost + input reshard cost.
+
+Memoized by (subgraph, boundary views, resources) like the reference's
+dp_state_hash (graph.cc:1864).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+from ..pcg.graph import Graph
+from ..pcg.machine_view import MachineResource, MachineView, enumerate_machine_views
+from ..pcg.op import PCGOp
+from .cost_model import CostModel
+
+
+@dataclasses.dataclass
+class GraphCostResult:
+    """reference: graph.h GraphCostResult {cost, views}"""
+
+    cost: float
+    views: Dict[int, MachineView]  # op guid -> view
+
+    @staticmethod
+    def infinity():
+        return GraphCostResult(float("inf"), {})
+
+
+class SearchHelper:
+    def __init__(
+        self,
+        cost_model: CostModel,
+        *,
+        max_views_per_op: int = 32,
+    ):
+        self.cost_model = cost_model
+        self.machine = cost_model.machine
+        self.max_views_per_op = max_views_per_op
+        self._memo: Dict[Tuple, GraphCostResult] = {}
+        self._view_cache: Dict[Tuple, List[MachineView]] = {}
+
+    # -- machine view enumeration (reference: register_all_machine_views +
+    #    Op::get_valid_machine_views) -----------------------------------
+    def valid_views(self, op: PCGOp, res: MachineResource) -> List[MachineView]:
+        degree = 1
+        if op.outputs:
+            degree = op.outputs[0].get_total_degree()
+        key = (degree, res.hash())
+        if key in self._view_cache:
+            return self._view_cache[key]
+        views = [
+            v
+            for v in enumerate_machine_views(
+                self.machine.num_nodes, self.machine.workers_per_node
+            )
+            if v.num_parts() == degree and res.is_valid_machine_view(v)
+        ]
+        views = views[: self.max_views_per_op]
+        if not views and degree == 1:
+            views = [MachineView(start_device_id=res.start_gpu_id, dim=(1,), stride=(1,))]
+        self._view_cache[key] = views
+        return views
+
+    # -- cost of a single op under a view given producer views ----------
+    def node_cost(
+        self, op: PCGOp, view: MachineView, bounds: Dict[int, MachineView]
+    ) -> float:
+        cm = self.cost_model.measure_operator_cost(op, view)
+        total = cm.total_time
+        if op.is_parallel_op:
+            total += self.cost_model.parallel_op_cost(op)
+        for t in op.inputs:
+            src = bounds.get(t.guid)
+            total += self.cost_model.estimate_xfer_cost(t, src, view)
+        return total
+
+    # -- DP ---------------------------------------------------------------
+    def graph_cost(self, graph: Graph, res: MachineResource) -> GraphCostResult:
+        ops = graph.topo_order()
+        return self._cost_of(tuple(ops), {}, {}, res, graph)
+
+    def _memo_key(self, ops, bounds, fixed, res):
+        return (
+            tuple(o.guid for o in ops),
+            tuple(sorted((g, v.hash()) for g, v in bounds.items())),
+            tuple(sorted((g, v.hash()) for g, v in fixed.items())),
+            res.hash(),
+        )
+
+    def _cost_of(
+        self,
+        ops: Tuple[PCGOp, ...],
+        bounds: Dict[int, MachineView],  # external tensor guid -> producer view
+        fixed: Dict[int, MachineView],  # op guid -> forced view
+        res: MachineResource,
+        graph: Graph,
+    ) -> GraphCostResult:
+        key = self._memo_key(ops, bounds, fixed, res)
+        if key in self._memo:
+            return self._memo[key]
+        result = self._compute(ops, bounds, fixed, res, graph)
+        self._memo[key] = result
+        return result
+
+    def _compute(self, ops, bounds, fixed, res, graph) -> GraphCostResult:
+        if not ops:
+            return GraphCostResult(0.0, {})
+        if len(ops) == 1:
+            op = ops[0]
+            views = [fixed[op.guid]] if op.guid in fixed else self.valid_views(op, res)
+            best = GraphCostResult.infinity()
+            for v in views:
+                c = self.node_cost(op, v, bounds)
+                if c < best.cost:
+                    best = GraphCostResult(c, {op.guid: v})
+            return best
+
+        # 1. bottleneck sequence split (reference: find_split_node /
+        #    sequence_optimize). An op at topo index i is a bottleneck if no
+        #    edge jumps from [0, i) to (i, n).
+        idx_of = {o.guid: i for i, o in enumerate(ops)}
+        own_guids = set(idx_of)
+        max_reach = [0] * len(ops)  # furthest dst index of edges from prefix
+        for i, o in enumerate(ops):
+            for t in o.inputs:
+                # find producer among ops
+                prod = graph.producers().get(t.guid)
+                if prod and prod[0].guid in own_guids:
+                    j = idx_of[prod[0].guid]
+                    max_reach[j] = max(max_reach[j], i)
+        run_max = 0
+        bottleneck = -1
+        for i in range(len(ops) - 1):
+            run_max = max(run_max, max_reach[i])
+            if run_max <= i:
+                bottleneck = i
+                break  # first bottleneck — reference splits at the earliest
+        if bottleneck >= 0:
+            bn = ops[bottleneck]
+            pre, post = ops[: bottleneck + 1], ops[bottleneck + 1 :]
+            best = GraphCostResult.infinity()
+            views = [fixed[bn.guid]] if bn.guid in fixed else self.valid_views(bn, res)
+            for v in views:
+                pre_fixed = dict(fixed)
+                pre_fixed[bn.guid] = v
+                r1 = self._cost_of(pre, bounds, pre_fixed, res, graph)
+                if r1.cost == float("inf"):
+                    continue
+                post_bounds = dict(bounds)
+                for t in bn.outputs:
+                    post_bounds[t.guid] = v
+                r2 = self._cost_of(post, post_bounds, fixed, res, graph)
+                total = r1.cost + r2.cost
+                if total < best.cost:
+                    views_map = dict(r1.views)
+                    views_map.update(r2.views)
+                    best = GraphCostResult(total, views_map)
+            return best
+
+        # 2. horizontal split of weakly-connected components
+        comps = self._components(ops, graph)
+        if len(comps) > 1:
+            a, b = comps[0], [o for c in comps[1:] for o in c]
+            return self._nonsequence(tuple(a), tuple(b), bounds, fixed, res, graph)
+
+        # 3. fallback: greedy chain (connected, no bottleneck — rare diamond
+        #    patterns): pick views greedily in topo order.
+        views_map: Dict[int, MachineView] = {}
+        total = 0.0
+        cur_bounds = dict(bounds)
+        for op in ops:
+            vs = [fixed[op.guid]] if op.guid in fixed else self.valid_views(op, res)
+            best_v, best_c = None, float("inf")
+            for v in vs:
+                c = self.node_cost(op, v, cur_bounds)
+                if c < best_c:
+                    best_v, best_c = v, c
+            if best_v is None:
+                return GraphCostResult.infinity()
+            views_map[op.guid] = best_v
+            total += best_c
+            for t in op.outputs:
+                cur_bounds[t.guid] = best_v
+        return GraphCostResult(total, views_map)
+
+    def _nonsequence(self, a, b, bounds, fixed, res, graph) -> GraphCostResult:
+        """reference: find_optimal_nonsequence_graph_time (graph.cc ~230-290):
+        try sequential on full machine vs concurrent on split halves."""
+        # sequential: both use the full machine, times add
+        ra = self._cost_of(a, bounds, fixed, res, graph)
+        rb = self._cost_of(b, bounds, fixed, res, graph)
+        best_views = dict(ra.views)
+        best_views.update(rb.views)
+        best = GraphCostResult(ra.cost + rb.cost, best_views)
+        # vertical machine split: halves run concurrently, times max
+        if res.available_procs_per_node >= 2:
+            half = dataclasses.replace(
+                res, available_procs_per_node=res.available_procs_per_node // 2
+            )
+            other = dataclasses.replace(
+                half, start_gpu_id=res.start_gpu_id + half.available_procs_per_node
+            )
+            ra2 = self._cost_of(a, bounds, fixed, half, graph)
+            rb2 = self._cost_of(b, bounds, fixed, other, graph)
+            cost2 = max(ra2.cost, rb2.cost)
+            if cost2 < best.cost:
+                views = dict(ra2.views)
+                views.update(rb2.views)
+                best = GraphCostResult(cost2, views)
+        # horizontal (node) split for multi-node machines
+        if res.num_nodes >= 2:
+            top = dataclasses.replace(res, num_nodes=res.num_nodes // 2)
+            bot = dataclasses.replace(
+                top, start_node_id=res.start_node_id + top.num_nodes
+            )
+            ra3 = self._cost_of(a, bounds, fixed, top, graph)
+            rb3 = self._cost_of(b, bounds, fixed, bot, graph)
+            cost3 = max(ra3.cost, rb3.cost)
+            if cost3 < best.cost:
+                views = dict(ra3.views)
+                views.update(rb3.views)
+                best = GraphCostResult(cost3, views)
+        return best
+
+    def _components(self, ops, graph) -> List[List[PCGOp]]:
+        guids = {o.guid for o in ops}
+        parent = {o.guid: o.guid for o in ops}
+
+        def find(x):
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        def union(x, y):
+            rx, ry = find(x), find(y)
+            if rx != ry:
+                parent[rx] = ry
+
+        prod = graph.producers()
+        for o in ops:
+            for t in o.inputs:
+                p = prod.get(t.guid)
+                if p and p[0].guid in guids:
+                    union(o.guid, p[0].guid)
+        groups: Dict[int, List[PCGOp]] = {}
+        for o in ops:
+            groups.setdefault(find(o.guid), []).append(o)
+        return list(groups.values())

@@ -1,0 +1,154 @@
+"""Machine models for the strategy-search cost estimator.
+
+TPU-native re-design of the reference's machine models
+(src/runtime/machine_model.cc: SimpleMachineModel with flat intra/inter-node
+bandwidths, EnhancedMachineModel with sockets/UPI/NIC devices + congestion;
+simulator.h:212-376). A TPU slice has a much more regular structure than a
+GPU cluster, so our hierarchy is:
+
+  chip  --ICI-->  neighbors within a slice (torus; modelled as flat ICI BW)
+  slice --DCN-->  other slices (multi-slice / multi-host)
+
+The machine description file format keeps the same spirit as the reference's
+machine_config_example (key = value lines) with TPU terms; a parser accepts
+both spellings so reference configs port.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class TPUChipSpec:
+    """Per-chip peak numbers. Defaults are TPU v5e (public spec):
+    197 TFLOP/s bf16, 819 GB/s HBM BW, 16 GB HBM."""
+
+    peak_flops_bf16: float = 197e12
+    peak_flops_f32: float = 49e12
+    hbm_bandwidth: float = 819e9  # bytes/s
+    hbm_capacity: int = 16 * 1024**3
+    vmem_capacity: int = 128 * 1024**2
+
+
+@dataclasses.dataclass
+class MachineModel:
+    """The machine the search optimizes for (reference: SimpleMachineModel,
+    machine_model.cc). `num_nodes` = hosts/slices, `workers_per_node` =
+    chips per host. Bandwidths in bytes/s, latencies in seconds."""
+
+    num_nodes: int = 1
+    workers_per_node: int = 8
+    chip: TPUChipSpec = dataclasses.field(default_factory=TPUChipSpec)
+    # ICI: intra-slice interconnect (v5e: 1600 Gbps/chip aggregate over
+    # 4 links ≈ 200 GB/s; usable per-direction per-link ~50 GB/s)
+    ici_bandwidth: float = 90e9
+    ici_latency: float = 1e-6
+    # DCN: inter-slice / inter-host network
+    dcn_bandwidth: float = 25e9
+    dcn_latency: float = 10e-6
+    # effective utilization factors for analytic costs
+    mxu_efficiency: float = 0.55
+    hbm_efficiency: float = 0.8
+
+    @property
+    def num_workers(self) -> int:
+        return self.num_nodes * self.workers_per_node
+
+    def node_of(self, device_id: int) -> int:
+        return device_id // self.workers_per_node
+
+    def link_bandwidth(self, src: int, dst: int) -> float:
+        """Flat two-level model (reference: SimpleMachineModel's
+        inter/intra-node bandwidths)."""
+        if src == dst:
+            return self.chip.hbm_bandwidth * self.hbm_efficiency
+        if self.node_of(src) == self.node_of(dst):
+            return self.ici_bandwidth
+        return self.dcn_bandwidth
+
+    def link_latency(self, src: int, dst: int) -> float:
+        if src == dst:
+            return 0.0
+        if self.node_of(src) == self.node_of(dst):
+            return self.ici_latency
+        return self.dcn_latency
+
+    def xfer_cost(self, num_bytes: float, src: int, dst: int) -> float:
+        """Point-to-point transfer time (seconds)."""
+        if src == dst or num_bytes <= 0:
+            return 0.0
+        return self.link_latency(src, dst) + num_bytes / self.link_bandwidth(src, dst)
+
+    def allreduce_cost(self, num_bytes: float, device_ids) -> float:
+        """Ring allreduce over the given devices: 2(n-1)/n · bytes / BW on
+        the slowest link in the ring (the XLA psum the optimizer/Reduction
+        collectives compile to; replaces the reference's NCCL allreduce
+        cost, optimizer_kernel.cu:88)."""
+        ids = list(device_ids)
+        n = len(ids)
+        if n <= 1 or num_bytes <= 0:
+            return 0.0
+        slowest = min(
+            self.link_bandwidth(ids[i], ids[(i + 1) % n]) for i in range(n)
+        )
+        max_lat = max(self.link_latency(ids[i], ids[(i + 1) % n]) for i in range(n))
+        return 2 * (n - 1) / n * num_bytes / slowest + 2 * (n - 1) * max_lat
+
+    def compute_cost(
+        self, flops: float, mem_bytes: float, dtype_is_bf16: bool = True
+    ) -> float:
+        """Roofline: max of MXU time and HBM time (the TPU-native
+        replacement for the reference's on-device microbenchmarks,
+        simulator.cc measure_operator_cost — analytic because XLA's fusion
+        makes per-op on-device timing unrepresentative anyway)."""
+        peak = (
+            self.chip.peak_flops_bf16 if dtype_is_bf16 else self.chip.peak_flops_f32
+        )
+        t_flops = flops / (peak * self.mxu_efficiency)
+        t_mem = mem_bytes / (self.chip.hbm_bandwidth * self.hbm_efficiency)
+        return max(t_flops, t_mem)
+
+
+def parse_machine_config(path: str) -> MachineModel:
+    """Parse a key = value machine description file (same shape as the
+    reference's machine_config_example; accepts both GPU-era and TPU-era
+    key spellings)."""
+    kv: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            kv[k.strip().lower()] = v.strip()
+
+    def get_f(keys, default):
+        for k in keys:
+            if k in kv:
+                return float(kv[k])
+        return default
+
+    def get_i(keys, default):
+        return int(get_f(keys, default))
+
+    m = MachineModel()
+    m.num_nodes = get_i(["num_nodes"], m.num_nodes)
+    m.workers_per_node = get_i(
+        ["num_gpus_per_node", "num_chips_per_node", "workers_per_node"],
+        m.workers_per_node,
+    )
+    # reference uses MB/s-ish units in its config; ours are bytes/s. Accept
+    # plain numbers as bytes/s.
+    m.ici_bandwidth = get_f(
+        ["ici_bandwidth", "intra_node_bandwidth", "nvlink_bandwidth"],
+        m.ici_bandwidth,
+    )
+    m.dcn_bandwidth = get_f(
+        ["dcn_bandwidth", "inter_node_bandwidth", "nic_bandwidth"],
+        m.dcn_bandwidth,
+    )
+    m.chip.peak_flops_bf16 = get_f(["peak_flops_bf16"], m.chip.peak_flops_bf16)
+    m.chip.hbm_bandwidth = get_f(["hbm_bandwidth"], m.chip.hbm_bandwidth)
+    m.chip.hbm_capacity = get_i(["hbm_capacity", "device_mem"], m.chip.hbm_capacity)
+    return m

@@ -1,0 +1,169 @@
+"""MCMC (simulated annealing) strategy search + task-graph simulation.
+
+TPU-native re-implementation of the reference's legacy MLSys'19 search
+(FFModel::mcmc_optimize, src/runtime/model.cc:3285: random per-op
+ParallelConfig rewrites accepted with probability exp(-alpha·Δ)) and of the
+event-driven runtime simulation it scores with
+(Simulator::simulate_runtime, src/runtime/simulator.cc:815-1000: per-op-shard
+fwd+bwd SimTasks + comm tasks, list-scheduled onto per-device timelines).
+Kept for parity and as a fallback when the DP search's graph-split
+preconditions don't hold.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import Dict, List, Optional, Tuple
+
+from ..pcg.graph import Graph
+from ..pcg.machine_view import MachineResource, MachineView, enumerate_machine_views
+from ..pcg.op import PCGOp
+from .cost_model import CostModel
+
+
+def simulate_runtime(
+    graph: Graph,
+    views: Dict[int, MachineView],
+    cost_model: CostModel,
+    *,
+    overlap_backward_update: bool = False,
+) -> float:
+    """List-schedule fwd+bwd (+weight sync) task graph onto per-device
+    timelines (reference: simulator.cc:822 simulate_runtime).
+
+    Simplification vs the reference: one task per op per pass covering its
+    whole view (per-shard tasks run concurrently on their devices anyway
+    under SPMD), comm folded into task start via xfer estimates.
+    """
+    machine = cost_model.machine
+    dev_free: Dict[int, float] = {}
+    ready_fwd: Dict[int, float] = {}  # tensor guid -> time available
+
+    topo = graph.topo_order()
+    prod = graph.producers()
+
+    def run_task(view: MachineView, start_lb: float, duration: float) -> float:
+        ids = view.device_ids()
+        start = max([start_lb] + [dev_free.get(d, 0.0) for d in ids])
+        end = start + duration
+        for d in ids:
+            dev_free[d] = end
+        return end
+
+    # forward
+    fwd_end: Dict[int, float] = {}
+    for op in topo:
+        view = views[op.guid]
+        cm = cost_model.measure_operator_cost(op, view)
+        lb = 0.0
+        for t in op.inputs:
+            p = prod.get(t.guid)
+            if p is None:
+                continue
+            src_view = views[p[0].guid]
+            lb = max(
+                lb,
+                ready_fwd.get(t.guid, 0.0)
+                + cost_model.estimate_xfer_cost(t, src_view, view),
+            )
+        dur = cm.forward_time
+        if op.is_parallel_op:
+            dur += cost_model.parallel_op_cost(op)
+        end = run_task(view, lb, dur)
+        fwd_end[op.guid] = end
+        for t in op.outputs:
+            ready_fwd[t.guid] = end
+
+    # backward (reverse topo); grad of op ready when all consumers' bwd done
+    bwd_end: Dict[int, float] = {}
+    makespan = max(fwd_end.values()) if fwd_end else 0.0
+    consumers: Dict[int, List[PCGOp]] = {}
+    for op in topo:
+        for t in op.inputs:
+            p = prod.get(t.guid)
+            if p is not None:
+                consumers.setdefault(p[0].guid, []).append(op)
+    for op in reversed(topo):
+        view = views[op.guid]
+        cm = cost_model.measure_operator_cost(op, view)
+        lb = makespan if not consumers.get(op.guid) else 0.0
+        for c in consumers.get(op.guid, []):
+            lb = max(lb, bwd_end.get(c.guid, makespan))
+        dur = cm.backward_time
+        if op.is_parallel_op:
+            dur += cost_model.parallel_op_cost(op)
+        end = run_task(view, lb, dur)
+        # weight sync (allreduce) after wgrad unless overlapped
+        if cm.sync_time > 0 and not overlap_backward_update:
+            end = run_task(view, end, cm.sync_time)
+        bwd_end[op.guid] = end
+
+    total = max(dev_free.values()) if dev_free else 0.0
+    if overlap_backward_update:
+        # overlapped syncs ride behind compute; add the largest single sync
+        total += max(
+            (cost_model.measure_operator_cost(o, views[o.guid]).sync_time
+             for o in topo),
+            default=0.0,
+        )
+    return total
+
+
+class MCMCSearch:
+    """reference: model.cc:3285 mcmc_optimize / :3260 rewrite."""
+
+    def __init__(
+        self,
+        cost_model: CostModel,
+        *,
+        alpha: float = 0.05,
+        seed: int = 0,
+    ):
+        self.cost_model = cost_model
+        self.alpha = alpha
+        self.rng = random.Random(seed)
+
+    def _valid_views(self, op: PCGOp, machine) -> List[MachineView]:
+        degree = op.outputs[0].get_total_degree() if op.outputs else 1
+        views = [
+            v
+            for v in enumerate_machine_views(machine.num_nodes, machine.workers_per_node)
+            if v.num_parts() == degree
+        ]
+        return views or [MachineView(start_device_id=0, dim=(1,), stride=(1,))]
+
+    def data_parallel_start(self, graph: Graph) -> Dict[int, MachineView]:
+        """reference: start from data-parallel config
+        (get_basic_data_parallel_config, model.h:250)."""
+        machine = self.cost_model.machine
+        out = {}
+        for op in graph.ops:
+            vs = self._valid_views(op, machine)
+            out[op.guid] = vs[0]
+        return out
+
+    def optimize(
+        self,
+        graph: Graph,
+        budget: int = 100,
+        start: Optional[Dict[int, MachineView]] = None,
+    ) -> Tuple[Dict[int, MachineView], float]:
+        machine = self.cost_model.machine
+        views = dict(start) if start else self.data_parallel_start(graph)
+        cur = simulate_runtime(graph, views, self.cost_model)
+        best_views, best = dict(views), cur
+        ops = list(graph.ops)
+        for _ in range(budget):
+            # rewrite: random op -> random valid view (model.cc:3260)
+            op = self.rng.choice(ops)
+            cands = self._valid_views(op, machine)
+            nxt = dict(views)
+            nxt[op.guid] = self.rng.choice(cands)
+            c = simulate_runtime(graph, nxt, self.cost_model)
+            delta = c - cur
+            if delta < 0 or self.rng.random() < math.exp(-self.alpha * delta * 1e6):
+                views, cur = nxt, c
+                if cur < best:
+                    best_views, best = dict(views), cur
+        return best_views, best

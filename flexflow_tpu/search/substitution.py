@@ -1,0 +1,416 @@
+"""Graph-substitution candidate generation + best-first strategy search.
+
+TPU-native re-design of the reference substitution engine
+(src/runtime/substitution.cc, 3802 LoC): the reference pattern-matches
+OpX/TensorX templates and rewrites the PCG, generating parallelization
+candidates (GraphXfer::run, substitution.cc:596), then best-first-searches
+over candidate graphs ordered by DP-evaluated cost with pruning threshold
+alpha and a budget (GraphSearchHelper::base_optimize, substitution.cc:2229).
+
+Our xfers are direct PCG rewriters (the reference's
+generate_all_pcg_xfers, substitution.cc:1726, builds the same fixed family
+programmatically — parallel-degree-parameterized):
+
+  * partition_linear_combine   — Megatron column-parallel Linear:
+                                 Replicate(in) → Linear[out/k] → Combine
+  * reduce_linear_partition    — row-parallel Linear:
+                                 Repartition(in-channel) → Linear → Reduction
+  * partition_attention_combine— heads partitioned (attribute parallelism,
+                                 reference substitution.cc:1764-1770)
+  * partition_conv2d_combine   — conv out-channel partition
+  * partition_batch            — sample-dim partition (data parallelism)
+  * partition_seq_allgather    — TPU addition: sequence/context parallelism
+                                 (no reference equivalent; SURVEY §5)
+
+Rewrites mutate tensor degrees + insert explicit parallel-op nodes, so the
+DP search (dp_search.py) can place every op and the executor can lower the
+result to GSPMD sharding constraints.
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+import heapq
+import itertools
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from ..ff_types import OperatorType
+from ..parallel.parallel_ops import (
+    CombineParams,
+    ReductionParams,
+    ReplicateParams,
+    RepartitionParams,
+)
+from ..pcg.graph import Graph
+from ..pcg.machine_view import MachineResource
+from ..pcg.op import PCGOp
+from ..pcg.parallel_tensor import ParallelDim, ParallelTensor
+from .dp_search import GraphCostResult, SearchHelper
+
+
+# ---------------------------------------------------------------------------
+# graph copying (reference: Graph copy in GraphXfer::create_new_graph)
+# ---------------------------------------------------------------------------
+
+def copy_graph(graph: Graph) -> Tuple[Graph, Dict[int, ParallelTensor]]:
+    """Deep-copy a PCG. Returns (new_graph, old_tensor_guid -> new tensor).
+    New ops/tensors get fresh guids; params (frozen) are shared."""
+    tmap: Dict[int, ParallelTensor] = {}
+
+    def map_tensor(t: ParallelTensor) -> ParallelTensor:
+        if t.guid not in tmap:
+            nt = ParallelTensor(
+                dims=[dataclasses.replace(d) for d in t.dims],
+                data_type=t.data_type,
+            )
+            tmap[t.guid] = nt
+        return tmap[t.guid]
+
+    g2 = Graph()
+    for op in graph.topo_order():
+        op2 = PCGOp(
+            op.op_type,
+            op.params,
+            [map_tensor(t) for t in op.inputs],
+            name=op.name,
+            layer_guid=op.layer_guid,
+        )
+        for t in op.outputs:
+            nt = map_tensor(t)
+            nt.owner_op = op2
+            op2.outputs.append(nt)
+        for w in op.weights:
+            nw = map_tensor(w)
+            nw.owner_op = op2
+            op2.weights.append(nw)
+        op2.weight_names = list(op.weight_names)
+        op2.weight_tags = list(getattr(op, "weight_tags", []))
+        op2.initializers = dict(op.initializers)
+        op2.machine_view = op.machine_view
+        g2.add_op(op2)
+    return g2, tmap
+
+
+def _consumers(graph: Graph, tensor: ParallelTensor) -> List[Tuple[PCGOp, int]]:
+    out = []
+    for op in graph.ops:
+        for i, t in enumerate(op.inputs):
+            if t.guid == tensor.guid:
+                out.append((op, i))
+    return out
+
+
+def _insert_after(
+    graph: Graph, producer_out: ParallelTensor, par_op: PCGOp
+) -> ParallelTensor:
+    """Reroute all consumers of producer_out through par_op's output."""
+    new_t = par_op.outputs[0]
+    for op, i in _consumers(graph, producer_out):
+        if op is par_op:
+            continue
+        op.inputs[i] = new_t
+    graph.add_op(par_op)
+    return new_t
+
+
+def _make_parallel_op(
+    op_type: OperatorType, params, in_tensor: ParallelTensor, out_dims
+) -> PCGOp:
+    op = PCGOp(op_type, params, [in_tensor])
+    out = ParallelTensor(dims=out_dims, data_type=in_tensor.data_type)
+    out.owner_op = op
+    op.outputs.append(out)
+    return op
+
+
+# ---------------------------------------------------------------------------
+# xfers (reference: create_xfers / generate_all_pcg_xfers)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Substitution:
+    name: str
+    apply: Callable[[Graph], Iterator[Graph]]
+
+
+def _find_ops(graph: Graph, op_type: OperatorType) -> List[PCGOp]:
+    return [o for o in graph.ops if o.op_type == op_type]
+
+
+def partition_linear_combine(degree: int) -> Substitution:
+    """Column-parallel Linear (reference:
+    substitution.cc create_partition_linear_combine). Shard kernel
+    out-channel by `degree`; output channel dim partitioned; Combine
+    restores a full tensor for consumers."""
+
+    def apply(graph: Graph) -> Iterator[Graph]:
+        for idx, op in enumerate(_find_ops(graph, OperatorType.OP_LINEAR)):
+            if not op.outputs or op.outputs[0].dims[-1].degree > 1:
+                continue
+            if op.params.out_channels % degree != 0:
+                continue
+            g2, tmap = copy_graph(graph)
+            op2 = next(o for o in g2.ops if o.layer_guid == op.layer_guid
+                       and o.name == op.name)
+            out = op2.outputs[0]
+            # shard weight out dim + output channel dim
+            for w, tags in zip(op2.weights, op2.weight_tags):
+                for i, tag in enumerate(tags):
+                    if tag == "out_channel" and w.dims[i].size % degree == 0:
+                        w.dims[i].degree = degree
+            out.dims[-1].degree = degree
+            # Combine back to replicated-full for downstream consumers
+            comb_dims = [dataclasses.replace(d) for d in out.dims]
+            comb_dims[-1].degree = 1
+            comb = _make_parallel_op(
+                OperatorType.OP_COMBINE,
+                CombineParams(combine_dim=len(out.dims) - 1, combine_degree=degree),
+                out,
+                comb_dims,
+            )
+            _insert_after(g2, out, comb)
+            yield g2
+
+    return Substitution(f"partition_linear_combine_{degree}", apply)
+
+
+def reduce_linear_partition(degree: int) -> Substitution:
+    """Row-parallel Linear (reference: create_replicate_linear_combine's
+    dual): partition the contraction dim; partial outputs summed by a
+    Reduction node."""
+
+    def apply(graph: Graph) -> Iterator[Graph]:
+        for op in _find_ops(graph, OperatorType.OP_LINEAR):
+            in_t = op.inputs[0]
+            if in_t.dims[-1].size % degree != 0 or in_t.dims[-1].degree > 1:
+                continue
+            g2, tmap = copy_graph(graph)
+            op2 = next(o for o in g2.ops if o.layer_guid == op.layer_guid
+                       and o.name == op.name)
+            in2 = op2.inputs[0]
+            # Repartition input channel dim
+            rep_dims = [dataclasses.replace(d) for d in in2.dims]
+            rep_dims[-1].degree = degree
+            rep = _make_parallel_op(
+                OperatorType.OP_REPARTITION,
+                RepartitionParams(
+                    repartition_dim=len(in2.dims) - 1, repartition_degree=degree
+                ),
+                in2,
+                rep_dims,
+            )
+            # insert before op2 only (not all consumers)
+            g2.add_op(rep)
+            op2.inputs[0] = rep.outputs[0]
+            # weight sharded on in-channel
+            for w, tags in zip(op2.weights, op2.weight_tags):
+                for i, tag in enumerate(tags):
+                    if tag == "in_channel" and w.dims[i].size % degree == 0:
+                        w.dims[i].degree = degree
+            # output becomes partial over a replica dim; Reduction sums it
+            out = op2.outputs[0]
+            partial_dims = [ParallelDim(size=degree, degree=degree, is_replica_dim=True)]
+            partial_dims += [dataclasses.replace(d) for d in out.dims]
+            out.dims = partial_dims
+            red_dims = [dataclasses.replace(d) for d in out.dims[1:]]
+            red = _make_parallel_op(
+                OperatorType.OP_REDUCTION,
+                ReductionParams(reduction_dim=0, reduction_degree=degree),
+                out,
+                red_dims,
+            )
+            _insert_after(g2, out, red)
+            yield g2
+
+    return Substitution(f"reduce_linear_partition_{degree}", apply)
+
+
+def partition_attention_combine(degree: int) -> Substitution:
+    """Attribute parallelism over attention heads (reference:
+    substitution.cc:1764 create_partition_attention_combine)."""
+
+    def apply(graph: Graph) -> Iterator[Graph]:
+        for op in _find_ops(graph, OperatorType.OP_MULTIHEAD_ATTENTION):
+            if op.params.num_heads % degree != 0:
+                continue
+            already = any(
+                w.dims[i].degree > 1
+                for w, tags in zip(op.weights, getattr(op, "weight_tags", []))
+                for i, tag in enumerate(tags)
+                if tag == "head"
+            )
+            if already:
+                continue
+            g2, _ = copy_graph(graph)
+            op2 = next(o for o in g2.ops if o.layer_guid == op.layer_guid
+                       and o.name == op.name)
+            for w, tags in zip(op2.weights, op2.weight_tags):
+                for i, tag in enumerate(tags):
+                    if tag == "head":
+                        w.dims[i].degree = degree
+            yield g2
+
+    return Substitution(f"partition_attention_combine_{degree}", apply)
+
+
+def partition_conv2d_combine(degree: int) -> Substitution:
+    """Conv out-channel partition (reference: conv mapping xfers)."""
+
+    def apply(graph: Graph) -> Iterator[Graph]:
+        for op in _find_ops(graph, OperatorType.OP_CONV2D):
+            out = op.outputs[0]
+            if out.dims[1].degree > 1 or out.dims[1].size % degree != 0:
+                continue
+            g2, _ = copy_graph(graph)
+            op2 = next(o for o in g2.ops if o.layer_guid == op.layer_guid
+                       and o.name == op.name)
+            out2 = op2.outputs[0]
+            for w, tags in zip(op2.weights, op2.weight_tags):
+                for i, tag in enumerate(tags):
+                    if tag == "out_channel" and w.dims[i].size % degree == 0:
+                        w.dims[i].degree = degree
+            out2.dims[1].degree = degree
+            comb_dims = [dataclasses.replace(d) for d in out2.dims]
+            comb_dims[1].degree = 1
+            comb = _make_parallel_op(
+                OperatorType.OP_COMBINE,
+                CombineParams(combine_dim=1, combine_degree=degree),
+                out2,
+                comb_dims,
+            )
+            _insert_after(g2, out2, comb)
+            yield g2
+
+    return Substitution(f"partition_conv2d_combine_{degree}", apply)
+
+
+def partition_batch(degree: int) -> Substitution:
+    """Sample-dim (data) parallelism across the whole graph (reference:
+    the --only-data-parallel lowering, model.cc:2637, as a searchable
+    xfer)."""
+
+    def apply(graph: Graph) -> Iterator[Graph]:
+        # applicable if any activation batch dim is unpartitioned
+        needs = any(
+            op.outputs and op.outputs[0].dims
+            and op.outputs[0].dims[0].degree == 1
+            and not op.outputs[0].dims[0].is_replica_dim
+            and op.outputs[0].dims[0].size % degree == 0
+            for op in graph.ops
+            if not op.is_parallel_op
+        )
+        if not needs:
+            return
+        g2, _ = copy_graph(graph)
+        for t in g2.input_tensors():
+            if t.dims and t.dims[0].size % degree == 0:
+                t.dims[0].degree = degree
+        for op in g2.ops:
+            if op.is_parallel_op:
+                continue
+            for t in op.outputs:
+                if (
+                    t.dims
+                    and not t.dims[0].is_replica_dim
+                    and t.dims[0].degree == 1
+                    and t.dims[0].size % degree == 0
+                ):
+                    t.dims[0].degree = degree
+        yield g2
+
+    return Substitution(f"partition_batch_{degree}", apply)
+
+
+def partition_seq_allgather(degree: int) -> Substitution:
+    """Sequence/context parallelism for 3-D activations (TPU addition —
+    the reference has no sequence-dim xfer, SURVEY §5)."""
+
+    def apply(graph: Graph) -> Iterator[Graph]:
+        has_seq = any(
+            op.outputs and len(op.outputs[0].dims) == 3
+            and op.outputs[0].dims[1].degree == 1
+            and op.outputs[0].dims[1].size % degree == 0
+            for op in graph.ops
+            if op.op_type != OperatorType.OP_MULTIHEAD_ATTENTION
+            and not op.is_parallel_op
+        )
+        if not has_seq:
+            return
+        g2, _ = copy_graph(graph)
+        for op in g2.ops:
+            if op.is_parallel_op:
+                continue
+            if op.op_type == OperatorType.OP_MULTIHEAD_ATTENTION:
+                continue  # attention needs full seq; executor all-gathers
+            for t in op.outputs:
+                if len(t.dims) == 3 and t.dims[1].size % degree == 0:
+                    t.dims[1].degree = degree
+        yield g2
+
+    return Substitution(f"partition_seq_allgather_{degree}", apply)
+
+
+def generate_all_pcg_xfers(degrees: List[int], config=None) -> List[Substitution]:
+    """reference: GraphSearchHelper::generate_all_pcg_xfers
+    (substitution.cc:1726) — one xfer per (kind, degree)."""
+    xfers: List[Substitution] = []
+    for d in degrees:
+        xfers.append(partition_batch(d))
+        xfers.append(partition_linear_combine(d))
+        xfers.append(reduce_linear_partition(d))
+        xfers.append(partition_attention_combine(d))
+        xfers.append(partition_conv2d_combine(d))
+        if config is None or getattr(config, "enable_sequence_parallel", False):
+            xfers.append(partition_seq_allgather(d))
+    return xfers
+
+
+# ---------------------------------------------------------------------------
+# best-first search (reference: GraphSearchHelper::base_optimize,
+# substitution.cc:2229)
+# ---------------------------------------------------------------------------
+
+class GraphSearchHelper:
+    def __init__(
+        self,
+        search: SearchHelper,
+        xfers: List[Substitution],
+        *,
+        alpha: float = 1.2,
+        budget: int = 20,
+    ):
+        self.search = search
+        self.xfers = xfers
+        self.alpha = alpha
+        self.budget = budget
+
+    def graph_optimize(
+        self, graph: Graph, res: MachineResource
+    ) -> Tuple[Graph, GraphCostResult]:
+        """Best-first search over rewrite candidates, each evaluated by the
+        DP machine-view assignment."""
+        best_graph = graph
+        best_result = self.search.graph_cost(graph, res)
+        counter = itertools.count()
+        pq: List[Tuple[float, int, Graph]] = [(best_result.cost, next(counter), graph)]
+        seen = {graph.hash()}
+        expansions = 0
+        while pq and expansions < max(1, self.budget):
+            cost, _, g = heapq.heappop(pq)
+            if cost > best_result.cost * self.alpha:
+                break  # pruned (reference: best_cost * alpha threshold)
+            expansions += 1
+            for xfer in self.xfers:
+                for cand in xfer.apply(g):
+                    h = cand.hash()
+                    if h in seen:
+                        continue
+                    seen.add(h)
+                    if not cand.check_correctness():
+                        continue
+                    r = self.search.graph_cost(cand, res)
+                    if r.cost < best_result.cost:
+                        best_graph, best_result = cand, r
+                    if r.cost <= best_result.cost * self.alpha:
+                        heapq.heappush(pq, (r.cost, next(counter), cand))
+        return best_graph, best_result
