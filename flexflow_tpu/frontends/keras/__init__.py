@@ -1,0 +1,25 @@
+"""Keras-compatible frontend (reference: python/flexflow/keras/)."""
+from . import callbacks, layers, optimizers  # noqa: F401
+from .layers import (  # noqa: F401
+    Activation,
+    Add,
+    AveragePooling2D,
+    BatchNormalization,
+    Concatenate,
+    Conv2D,
+    Dense,
+    Dropout,
+    Embedding,
+    Flatten,
+    Input,
+    LayerNormalization,
+    Maximum,
+    MaxPooling2D,
+    Minimum,
+    MultiHeadAttention,
+    Multiply,
+    Reshape,
+    Subtract,
+)
+from .models import Model, Sequential  # noqa: F401
+from .optimizers import SGD, Adam  # noqa: F401

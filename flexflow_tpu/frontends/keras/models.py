@@ -1,0 +1,174 @@
+"""Keras-compatible Model / Sequential.
+
+TPU-native equivalent of the reference's keras model classes
+(python/flexflow/keras/models/base_model.py:128 compile, :198 fit;
+sequential.py, model.py): traverse the deferred Keras layer graph, replay it
+through FFModel, then delegate compile/fit/evaluate/predict to the core.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ...config import FFConfig
+from ...core.model import FFModel
+from ...ff_types import DataType, LossType, MetricsType
+from .layers import Input, KerasTensor, Layer
+from .optimizers import Optimizer as KerasOptimizer, SGD
+
+
+_LOSS_MAP = {
+    "categorical_crossentropy": LossType.LOSS_CATEGORICAL_CROSSENTROPY,
+    "sparse_categorical_crossentropy": LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+    "mse": LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+}
+
+_METRIC_MAP = {
+    "accuracy": MetricsType.METRICS_ACCURACY,
+    "sparse_categorical_crossentropy": MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY,
+    "categorical_crossentropy": MetricsType.METRICS_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": MetricsType.METRICS_MEAN_SQUARED_ERROR,
+    "root_mean_squared_error": MetricsType.METRICS_ROOT_MEAN_SQUARED_ERROR,
+    "mean_absolute_error": MetricsType.METRICS_MEAN_ABSOLUTE_ERROR,
+}
+
+
+class Model:
+    """Functional-API model (reference: keras/models/model.py)."""
+
+    def __init__(self, inputs=None, outputs=None, name: str = "model"):
+        self.name = name
+        self.inputs: List[KerasTensor] = (
+            list(inputs) if isinstance(inputs, (list, tuple)) else ([inputs] if inputs else [])
+        )
+        self.outputs: List[KerasTensor] = (
+            list(outputs) if isinstance(outputs, (list, tuple)) else ([outputs] if outputs else [])
+        )
+        self.ffmodel: Optional[FFModel] = None
+        self.ffconfig = FFConfig()
+        self._callbacks = []
+
+    # -- graph replay ----------------------------------------------------
+    def _toposort_layers(self) -> List[Layer]:
+        order: List[Layer] = []
+        visited = set()
+
+        def visit(t: KerasTensor):
+            layer = t.source_layer
+            if layer is None or id(layer) in visited:
+                return
+            visited.add(id(layer))
+            for it in layer.inbound:
+                visit(it)
+            order.append(layer)
+
+        for out in self.outputs:
+            visit(out)
+        return order
+
+    def _build_ff(self, batch_size: int):
+        self.ffconfig.batch_size = batch_size
+        ffmodel = FFModel(self.ffconfig)
+        tensor_of = {}
+        for kt in self.inputs:
+            dtype = getattr(kt, "dtype", DataType.DT_FLOAT)
+            tensor_of[id(kt)] = ffmodel.create_tensor(
+                (batch_size,) + kt.shape, dtype
+            )
+        for layer in self._toposort_layers():
+            ff_ins = [tensor_of[id(t)] for t in layer.inbound]
+            outs = layer.build_ff(ffmodel, ff_ins)
+            for kt, ft in zip(layer.outputs, outs):
+                tensor_of[id(kt)] = ft
+        self.ffmodel = ffmodel
+        return ffmodel
+
+    # -- keras API -------------------------------------------------------
+    def compile(self, optimizer="sgd", loss=None, metrics=(), batch_size=None, **kw):
+        """reference: base_model.py:128"""
+        bs = batch_size or self.ffconfig.batch_size
+        ffmodel = self._build_ff(bs)
+        if isinstance(optimizer, str):
+            optimizer = {"sgd": SGD(), "adam": __import__(
+                "flexflow_tpu.frontends.keras.optimizers", fromlist=["Adam"]
+            ).Adam()}[optimizer.lower()]
+        core_opt = (
+            optimizer.to_core() if isinstance(optimizer, KerasOptimizer) else optimizer
+        )
+        loss_type = _LOSS_MAP[loss] if isinstance(loss, str) else loss
+        ms = [(_METRIC_MAP[m] if isinstance(m, str) else m) for m in metrics]
+        ffmodel.compile(optimizer=core_opt, loss_type=loss_type, metrics=ms)
+        return self
+
+    def fit(self, x=None, y=None, batch_size=None, epochs=1, verbose=True,
+            callbacks=None, **kw):
+        """reference: base_model.py:198"""
+        assert self.ffmodel is not None, "call compile() first"
+        cbs = list(callbacks or [])
+        for cb in cbs:
+            cb.set_model(self)
+            cb.on_train_begin()
+        pm = None
+        for epoch in range(epochs):
+            for cb in cbs:
+                cb.on_epoch_begin(epoch)
+            pm = self.ffmodel.fit(x, y, batch_size=batch_size, epochs=1,
+                                  verbose=verbose)
+            logs = {
+                "accuracy": pm.get_accuracy(),
+                "loss": pm.sparse_cce_loss or pm.cce_loss or pm.mse_loss,
+            }
+            for cb in cbs:
+                cb.on_epoch_end(epoch, logs)
+        for cb in cbs:
+            cb.on_train_end()
+        return pm
+
+    def evaluate(self, x=None, y=None, batch_size=None, **kw):
+        return self.ffmodel.eval(x, y, batch_size=batch_size)
+
+    def predict(self, x, batch_size=None, **kw):
+        return self.ffmodel.predict(x, batch_size=batch_size)
+
+    def summary(self) -> str:
+        lines = [f'Model: "{self.name}"', "_" * 60]
+        for layer in self._toposort_layers():
+            shapes = [t.shape for t in layer.outputs]
+            lines.append(f"{layer.name:<30}{type(layer).__name__:<18}{shapes}")
+        text = "\n".join(lines)
+        print(text)
+        return text
+
+    @property
+    def layers(self) -> List[Layer]:
+        return self._toposort_layers()
+
+
+class Sequential(Model):
+    """reference: keras/models/sequential.py"""
+
+    def __init__(self, layers: Optional[Sequence[Layer]] = None, name="sequential"):
+        super().__init__(name=name)
+        self._stack: List[Layer] = []
+        for l in layers or []:
+            self.add(l)
+
+    def add(self, layer_or_input):
+        if isinstance(layer_or_input, KerasTensor):
+            self.inputs = [layer_or_input]
+            self._last = layer_or_input
+            return
+        if not self.inputs:
+            # first layer must declare input_shape
+            shape = getattr(layer_or_input, "input_shape", None)
+            assert shape is not None, (
+                "first Sequential layer needs input_shape= or add(Input(...))"
+            )
+            inp = Input(shape)
+            self.inputs = [inp]
+            self._last = inp
+        self._stack.append(layer_or_input)
+        self._last = layer_or_input(self._last)
+        self.outputs = [self._last]

@@ -1,0 +1,2 @@
+"""ONNX frontend (reference: python/flexflow/onnx/)."""
+from .model import HAS_ONNX, ONNXModel  # noqa: F401

@@ -1,0 +1,239 @@
+"""ONNX frontend: import an ONNX graph into FFModel.
+
+TPU-native equivalent of reference python/flexflow/onnx/model.py:56
+(`ONNXModel(path).apply(ffmodel, input_dict)` walking graph.node and
+dispatching per op_type to handle_<Op> methods). The `onnx` package is not
+part of this image, so the loader is gated: any protobuf-compatible object
+with .graph.node/.graph.initializer works (covers onnx.ModelProto when the
+package is present, and our lightweight test doubles when not).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...ff_types import ActiMode, AggrMode, DataType, PoolType
+
+try:  # pragma: no cover - optional dependency
+    import onnx
+    from onnx import numpy_helper
+
+    HAS_ONNX = True
+except Exception:
+    onnx = None
+    numpy_helper = None
+    HAS_ONNX = False
+
+
+def _attrs(node) -> Dict[str, object]:
+    out = {}
+    for a in node.attribute:
+        # minimal AttributeProto decoding (reference: onnx/model.py uses
+        # helper.get_attribute_value)
+        for field in ("i", "f", "s", "ints", "floats"):
+            v = getattr(a, field, None)
+            if v not in (None, "", b"", []) or (
+                field in ("i", "f") and v == 0 and a.type in (1, 2)
+            ):
+                out[a.name] = list(v) if field in ("ints", "floats") else v
+                break
+    return out
+
+
+class ONNXTensor:
+    """reference: onnx/model.py ONNXTensor"""
+
+    def __init__(self, name, dims):
+        self.name = name
+        self.dims = list(dims)
+
+
+class ONNXModel:
+    """reference: onnx/model.py:56"""
+
+    def __init__(self, model):
+        if isinstance(model, (str, bytes)):
+            assert HAS_ONNX, "onnx package not available to load from file"
+            model = onnx.load(model)
+        self.model = model
+        self.initializers: Dict[str, np.ndarray] = {}
+        for init in model.graph.initializer:
+            if numpy_helper is not None:
+                self.initializers[init.name] = numpy_helper.to_array(init)
+            else:
+                self.initializers[init.name] = np.asarray(init.data)
+        self._weight_loads = []
+
+    def apply(self, ffmodel, input_tensors: Dict[str, object]):
+        """Walk graph.node, building FFModel ops. input_tensors maps graph
+        input names to FFModel tensors."""
+        env: Dict[str, object] = dict(input_tensors)
+        outputs = []
+        for node in self.model.graph.node:
+            handler = getattr(self, f"handle_{node.op_type}", None)
+            if handler is None:
+                raise NotImplementedError(f"ONNX op {node.op_type}")
+            result = handler(ffmodel, node, env)
+            outs = list(node.output)
+            if not isinstance(result, (list, tuple)):
+                result = [result]
+            for name, t in zip(outs, result):
+                env[name] = t
+        for out in self.model.graph.output:
+            if out.name in env:
+                outputs.append(env[out.name])
+        self._ffmodel = ffmodel
+        return outputs[0] if len(outputs) == 1 else outputs
+
+    def load_weights(self, ffmodel=None):
+        for layer, arrays in self._weight_loads:
+            for wt, arr in zip(layer.weights, arrays):
+                wt.set_tensor(self._ffmodel, np.asarray(arr))
+
+    # -- handlers (reference: onnx/model.py handle_* methods) -----------
+    def handle_Conv(self, ff, node, env):
+        x = env[node.input[0]]
+        w = self.initializers[node.input[1]]
+        a = _attrs(node)
+        pads = a.get("pads", [0, 0, 0, 0])
+        strides = a.get("strides", [1, 1])
+        group = int(a.get("group", 1))
+        out = ff.conv2d(
+            x, w.shape[0], w.shape[2], w.shape[3],
+            int(strides[0]), int(strides[1]), int(pads[0]), int(pads[1]),
+            groups=group, use_bias=len(node.input) > 2,
+        )
+        arrays = [w] + ([self.initializers[node.input[2]]] if len(node.input) > 2 else [])
+        self._weight_loads.append((ff.layers[-1], arrays))
+        return out
+
+    def handle_Gemm(self, ff, node, env):
+        x = env[node.input[0]]
+        w = self.initializers[node.input[1]]
+        a = _attrs(node)
+        trans_b = int(a.get("transB", 0))
+        kernel = w.T if trans_b else w
+        out_dim = kernel.shape[1]
+        out = ff.dense(x, out_dim, use_bias=len(node.input) > 2)
+        arrays = [kernel] + (
+            [self.initializers[node.input[2]]] if len(node.input) > 2 else []
+        )
+        self._weight_loads.append((ff.layers[-1], arrays))
+        return out
+
+    def handle_MatMul(self, ff, node, env):
+        x = env[node.input[0]]
+        if node.input[1] in self.initializers:
+            w = self.initializers[node.input[1]]
+            out = ff.dense(x, w.shape[1], use_bias=False)
+            self._weight_loads.append((ff.layers[-1], [w]))
+            return out
+        return ff.batch_matmul(x, env[node.input[1]])
+
+    def handle_MaxPool(self, ff, node, env):
+        a = _attrs(node)
+        k = a.get("kernel_shape", [2, 2])
+        s = a.get("strides", k)
+        p = a.get("pads", [0, 0, 0, 0])
+        return ff.pool2d(env[node.input[0]], int(k[0]), int(k[1]),
+                         int(s[0]), int(s[1]), int(p[0]), int(p[1]),
+                         PoolType.POOL_MAX)
+
+    def handle_AveragePool(self, ff, node, env):
+        a = _attrs(node)
+        k = a.get("kernel_shape", [2, 2])
+        s = a.get("strides", k)
+        p = a.get("pads", [0, 0, 0, 0])
+        return ff.pool2d(env[node.input[0]], int(k[0]), int(k[1]),
+                         int(s[0]), int(s[1]), int(p[0]), int(p[1]),
+                         PoolType.POOL_AVG)
+
+    def handle_GlobalAveragePool(self, ff, node, env):
+        x = env[node.input[0]]
+        return ff.pool2d(x, x.dims[2], x.dims[3], 1, 1, 0, 0, PoolType.POOL_AVG)
+
+    def handle_Flatten(self, ff, node, env):
+        return ff.flat(env[node.input[0]])
+
+    def handle_Relu(self, ff, node, env):
+        return ff.relu(env[node.input[0]])
+
+    def handle_Gelu(self, ff, node, env):
+        return ff.gelu(env[node.input[0]])
+
+    def handle_Sigmoid(self, ff, node, env):
+        return ff.sigmoid(env[node.input[0]])
+
+    def handle_Tanh(self, ff, node, env):
+        return ff.tanh(env[node.input[0]])
+
+    def handle_Softmax(self, ff, node, env):
+        a = _attrs(node)
+        return ff.softmax(env[node.input[0]], axis=int(a.get("axis", -1)))
+
+    def handle_Add(self, ff, node, env):
+        return self._binary(ff, node, env, "add")
+
+    def handle_Sub(self, ff, node, env):
+        return self._binary(ff, node, env, "subtract")
+
+    def handle_Mul(self, ff, node, env):
+        return self._binary(ff, node, env, "multiply")
+
+    def handle_Div(self, ff, node, env):
+        return self._binary(ff, node, env, "divide")
+
+    def _binary(self, ff, node, env, opname):
+        a, b = env.get(node.input[0]), env.get(node.input[1])
+        assert a is not None and b is not None, (
+            f"ONNX {opname} with constant operand not yet supported"
+        )
+        return getattr(ff, opname)(a, b)
+
+    def handle_Concat(self, ff, node, env):
+        a = _attrs(node)
+        return ff.concat([env[i] for i in node.input], int(a.get("axis", 1)))
+
+    def handle_Split(self, ff, node, env):
+        a = _attrs(node)
+        sizes = [int(s) for s in a.get("split", [])]
+        axis = int(a.get("axis", 0))
+        x = env[node.input[0]]
+        if not sizes:
+            sizes = len(node.output)
+        return ff.split(x, sizes, axis)
+
+    def handle_Reshape(self, ff, node, env):
+        shape = self.initializers.get(node.input[1])
+        assert shape is not None, "dynamic Reshape unsupported"
+        return ff.reshape(env[node.input[0]], [int(s) for s in shape])
+
+    def handle_Transpose(self, ff, node, env):
+        a = _attrs(node)
+        return ff.transpose(env[node.input[0]], [int(p) for p in a["perm"]])
+
+    def handle_Dropout(self, ff, node, env):
+        a = _attrs(node)
+        return ff.dropout(env[node.input[0]], float(a.get("ratio", 0.5)))
+
+    def handle_Cast(self, ff, node, env):
+        # ONNX TensorProto dtypes: 1=float32, 6=int32, 7=int64, 10=f16, 16=bf16
+        a = _attrs(node)
+        to = {1: DataType.DT_FLOAT, 6: DataType.DT_INT32, 7: DataType.DT_INT64,
+              10: DataType.DT_HALF, 16: DataType.DT_BF16}[int(a.get("to", 1))]
+        return ff.cast(env[node.input[0]], to)
+
+    def handle_ReduceMean(self, ff, node, env):
+        a = _attrs(node)
+        axes = [int(x) for x in a.get("axes", [-1])]
+        return ff.mean(env[node.input[0]], axes, bool(a.get("keepdims", 1)))
+
+    def handle_BatchNormalization(self, ff, node, env):
+        out = ff.batch_norm(env[node.input[0]], relu=False)
+        arrays = [self.initializers[node.input[1]], self.initializers[node.input[2]]]
+        self._weight_loads.append((ff.layers[-1], arrays))
+        return out
+
+    def handle_Identity(self, ff, node, env):
+        return ff.identity(env[node.input[0]])

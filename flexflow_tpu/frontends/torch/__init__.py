@@ -1,0 +1,2 @@
+"""PyTorch-FX frontend (reference: python/flexflow/torch/)."""
+from .model import PyTorchModel, torch_to_flexflow  # noqa: F401

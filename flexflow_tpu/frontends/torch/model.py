@@ -1,0 +1,301 @@
+"""PyTorch-FX frontend: import a torch.nn.Module into FFModel.
+
+TPU-native equivalent of reference python/flexflow/torch/model.py (2607 LoC):
+`PyTorchModel(torch_module).torch_to_ff(ffmodel, input_tensors)` traces the
+module with torch.fx.symbolic_trace (model.py:2427 _trace_model) and maps
+each fx node onto FFModel ops (per-node `to_ff`, model.py:2496). Weights are
+transferred from the torch module so imported models start from the same
+parameters (the reference does this via set_tensor after compile; we stage
+them and FFModel applies at compile).
+"""
+from __future__ import annotations
+
+import operator
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...ff_types import ActiMode, AggrMode, DataType, PoolType
+
+try:
+    import torch
+    import torch.fx
+
+    HAS_TORCH = True
+except Exception:  # pragma: no cover
+    HAS_TORCH = False
+
+
+class PyTorchModel:
+    """reference: torch/model.py:2408 PyTorchModel"""
+
+    def __init__(self, module, is_hf_model: bool = False, batch_size: int = 1):
+        assert HAS_TORCH, "torch is not available"
+        self.module = module
+        self.is_hf_model = is_hf_model
+        self.batch_size = batch_size
+        self._weight_loads = []  # (ff_layer, [np arrays]) applied post-compile
+
+    def _trace(self):
+        """reference: model.py:2427 _trace_model (HF variant uses
+        transformers.utils.fx; plain variant torch.fx)."""
+        if self.is_hf_model:
+            from transformers.utils import fx as hf_fx
+
+            return hf_fx.symbolic_trace(self.module)
+        return torch.fx.symbolic_trace(self.module)
+
+    # ------------------------------------------------------------------
+    def torch_to_ff(self, ffmodel, input_tensors: List) -> List:
+        """Map the traced graph onto ffmodel; returns output tensors."""
+        traced = self._trace()
+        modules = dict(traced.named_modules())
+        env: Dict[str, object] = {}
+        inputs = list(input_tensors)
+        outputs: List = []
+
+        for node in traced.graph.nodes:
+            if node.op == "placeholder":
+                env[node.name] = inputs.pop(0)
+            elif node.op == "call_module":
+                mod = modules[node.target]
+                args = [env[a.name] if isinstance(a, torch.fx.Node) else a
+                        for a in node.args]
+                env[node.name] = self._module_to_ff(ffmodel, mod, args, node)
+            elif node.op == "call_function":
+                env[node.name] = self._function_to_ff(ffmodel, node, env)
+            elif node.op == "call_method":
+                env[node.name] = self._method_to_ff(ffmodel, node, env)
+            elif node.op == "get_attr":
+                env[node.name] = self._fetch_attr(node.target)
+            elif node.op == "output":
+                def collect(a):
+                    if isinstance(a, torch.fx.Node):
+                        outputs.append(env[a.name])
+                    elif isinstance(a, (tuple, list)):
+                        for x in a:
+                            collect(x)
+                collect(node.args[0])
+        self._ffmodel = ffmodel
+        return outputs
+
+    def _fetch_attr(self, target: str):
+        obj = self.module
+        for part in target.split("."):
+            obj = getattr(obj, part)
+        return obj
+
+    # -- modules ---------------------------------------------------------
+    def _module_to_ff(self, ff, mod, args, node):
+        nn = torch.nn
+        x = args[0]
+        name = node.name
+        if isinstance(mod, nn.Linear):
+            out = ff.dense(x, mod.out_features, use_bias=mod.bias is not None,
+                           name=name)
+            w = [mod.weight.detach().numpy().T]  # torch (out,in) -> ours (in,out)
+            if mod.bias is not None:
+                w.append(mod.bias.detach().numpy())
+            self._weight_loads.append((ff.layers[-1], w))
+            return out
+        if isinstance(mod, nn.Conv2d):
+            out = ff.conv2d(
+                x, mod.out_channels, mod.kernel_size[0], mod.kernel_size[1],
+                mod.stride[0], mod.stride[1], mod.padding[0], mod.padding[1],
+                groups=mod.groups, use_bias=mod.bias is not None, name=name,
+            )
+            w = [mod.weight.detach().numpy()]
+            if mod.bias is not None:
+                w.append(mod.bias.detach().numpy())
+            self._weight_loads.append((ff.layers[-1], w))
+            return out
+        if isinstance(mod, nn.MaxPool2d):
+            k = mod.kernel_size if isinstance(mod.kernel_size, tuple) else (mod.kernel_size,) * 2
+            s = mod.stride if isinstance(mod.stride, tuple) else (mod.stride or k[0],) * 2
+            p = mod.padding if isinstance(mod.padding, tuple) else (mod.padding,) * 2
+            return ff.pool2d(x, k[0], k[1], s[0], s[1], p[0], p[1],
+                             PoolType.POOL_MAX, name=name)
+        if isinstance(mod, nn.AvgPool2d):
+            k = mod.kernel_size if isinstance(mod.kernel_size, tuple) else (mod.kernel_size,) * 2
+            s = mod.stride if isinstance(mod.stride, tuple) else (mod.stride or k[0],) * 2
+            p = mod.padding if isinstance(mod.padding, tuple) else (mod.padding,) * 2
+            return ff.pool2d(x, k[0], k[1], s[0], s[1], p[0], p[1],
+                             PoolType.POOL_AVG, name=name)
+        if isinstance(mod, nn.AdaptiveAvgPool2d):
+            # only output_size (1,1) or same-size supported, like reference
+            h, w_ = x.dims[2], x.dims[3]
+            osz = mod.output_size if isinstance(mod.output_size, tuple) else (mod.output_size,) * 2
+            if osz == (1, 1):
+                return ff.pool2d(x, h, w_, 1, 1, 0, 0, PoolType.POOL_AVG, name=name)
+            assert (h, w_) == osz, "unsupported AdaptiveAvgPool2d size"
+            return x
+        if isinstance(mod, nn.BatchNorm2d):
+            out = ff.batch_norm(x, relu=False, name=name)
+            self._weight_loads.append((
+                ff.layers[-1],
+                [mod.weight.detach().numpy(), mod.bias.detach().numpy()],
+            ))
+            return out
+        if isinstance(mod, nn.LayerNorm):
+            out = ff.layer_norm(
+                x, axes=tuple(range(-len(mod.normalized_shape), 0)),
+                eps=mod.eps, name=name,
+            )
+            if mod.elementwise_affine:
+                self._weight_loads.append((
+                    ff.layers[-1],
+                    [mod.weight.detach().numpy(), mod.bias.detach().numpy()],
+                ))
+            return out
+        if isinstance(mod, nn.Embedding):
+            out = ff.embedding(x, mod.num_embeddings, mod.embedding_dim,
+                               AggrMode.AGGR_MODE_NONE, name=name)
+            self._weight_loads.append(
+                (ff.layers[-1], [mod.weight.detach().numpy()])
+            )
+            return out
+        if isinstance(mod, nn.ReLU):
+            return ff.relu(x, name=name)
+        if isinstance(mod, nn.GELU):
+            return ff.gelu(x, name=name)
+        if isinstance(mod, nn.Sigmoid):
+            return ff.sigmoid(x, name=name)
+        if isinstance(mod, nn.Tanh):
+            return ff.tanh(x, name=name)
+        if isinstance(mod, nn.ELU):
+            return ff.elu(x, name=name)
+        if isinstance(mod, nn.Softmax):
+            return ff.softmax(x, axis=mod.dim if mod.dim is not None else -1, name=name)
+        if isinstance(mod, nn.Dropout):
+            return ff.dropout(x, mod.p, name=name)
+        if isinstance(mod, nn.Flatten):
+            return ff.flat(x, name=name)
+        if isinstance(mod, nn.Identity):
+            return ff.identity(x, name=name)
+        if isinstance(mod, nn.MultiheadAttention):
+            q, k, v = args[0], args[1], args[2]
+            out = ff.multihead_attention(
+                q, k, v, mod.embed_dim, mod.num_heads,
+                dropout=mod.dropout, bias=mod.in_proj_bias is not None,
+                name=name,
+            )
+            return out
+        raise NotImplementedError(f"torch module {type(mod).__name__}")
+
+    # -- functions -------------------------------------------------------
+    def _function_to_ff(self, ff, node, env):
+        def val(a):
+            return env[a.name] if isinstance(a, torch.fx.Node) else a
+
+        args = [val(a) for a in node.args]
+        fn = node.target
+        if fn in (operator.add, torch.add):
+            if _is_scalar(args[1]):
+                return ff.scalar_add(args[0], float(args[1]))
+            return ff.add(args[0], args[1])
+        if fn in (operator.sub, torch.sub):
+            if _is_scalar(args[1]):
+                return ff.scalar_sub(args[0], float(args[1]))
+            return ff.subtract(args[0], args[1])
+        if fn in (operator.mul, torch.mul):
+            if _is_scalar(args[1]):
+                return ff.scalar_multiply(args[0], float(args[1]))
+            return ff.multiply(args[0], args[1])
+        if fn in (operator.truediv, torch.div):
+            if _is_scalar(args[1]):
+                return ff.scalar_true_divide(args[0], float(args[1]))
+            return ff.divide(args[0], args[1])
+        if fn in (torch.relu, torch.nn.functional.relu):
+            return ff.relu(args[0])
+        if fn is torch.nn.functional.gelu:
+            return ff.gelu(args[0])
+        if fn in (torch.sigmoid, torch.nn.functional.sigmoid):
+            return ff.sigmoid(args[0])
+        if fn in (torch.tanh, torch.nn.functional.tanh):
+            return ff.tanh(args[0])
+        if fn in (torch.softmax, torch.nn.functional.softmax):
+            dim = node.kwargs.get("dim", args[1] if len(args) > 1 else -1)
+            return ff.softmax(args[0], axis=dim if dim is not None else -1)
+        if fn in (torch.cat, torch.concat):
+            dim = node.kwargs.get("dim", args[1] if len(args) > 1 else 0)
+            return ff.concat(list(args[0]), dim)
+        if fn in (torch.flatten,):
+            return ff.flat(args[0])
+        if fn in (torch.matmul, torch.bmm):
+            return ff.batch_matmul(args[0], args[1])
+        if fn is operator.getitem:
+            return args[0][args[1]]
+        if fn in (torch.exp,):
+            return ff.exp(args[0])
+        if fn in (torch.pow, operator.pow):
+            return ff.pow(args[0], float(args[1]))
+        if fn in (torch.mean,):
+            dims = node.kwargs.get("dim", args[1] if len(args) > 1 else None)
+            keep = node.kwargs.get("keepdim", False)
+            dims = [dims] if isinstance(dims, int) else list(dims)
+            return ff.mean(args[0], dims, keep)
+        if fn in (torch.transpose,):
+            d0, d1 = args[1], args[2]
+            perm = list(range(len(args[0].dims)))
+            perm[d0], perm[d1] = perm[d1], perm[d0]
+            return ff.transpose(args[0], perm)
+        raise NotImplementedError(f"torch function {fn}")
+
+    def _method_to_ff(self, ff, node, env):
+        def val(a):
+            return env[a.name] if isinstance(a, torch.fx.Node) else a
+
+        args = [val(a) for a in node.args]
+        m = node.target
+        x = args[0]
+        if m in ("view", "reshape"):
+            shape = [int(s) if not isinstance(s, str) else -1 for s in args[1:]]
+            if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+                shape = list(shape[0])
+            return ff.reshape(x, shape)
+        if m == "flatten":
+            return ff.flat(x)
+        if m == "permute":
+            perm = args[1] if isinstance(args[1], (list, tuple)) else args[1:]
+            return ff.transpose(x, list(perm))
+        if m == "transpose":
+            d0, d1 = args[1], args[2]
+            perm = list(range(len(x.dims)))
+            perm[d0], perm[d1] = perm[d1], perm[d0]
+            return ff.transpose(x, perm)
+        if m == "relu":
+            return ff.relu(x)
+        if m == "softmax":
+            return ff.softmax(x, axis=node.kwargs.get("dim", -1))
+        if m == "contiguous" or m == "detach" or m == "clone":
+            return x
+        if m == "size":
+            return x.dims if len(args) == 1 else x.dims[args[1]]
+        if m == "mean":
+            dims = args[1] if len(args) > 1 else node.kwargs.get("dim")
+            keep = node.kwargs.get("keepdim", False)
+            dims = [dims] if isinstance(dims, int) else list(dims)
+            return ff.mean(x, dims, keep)
+        raise NotImplementedError(f"torch method {m}")
+
+    # ------------------------------------------------------------------
+    def load_weights(self, ffmodel=None):
+        """Copy the torch module's parameters into the compiled model
+        (reference: torch weight transfer via set_tensor)."""
+        for layer, arrays in self._weight_loads:
+            for wt, arr in zip(layer.weights, arrays):
+                wt.set_tensor(self._ffmodel, arr)
+
+
+def _is_scalar(v) -> bool:
+    return isinstance(v, (int, float))
+
+
+def torch_to_flexflow(module, path: str, batch_size: int = 1):
+    """File-format export stub for parity with reference
+    torch/model.py torch_to_flexflow (serializes the fx graph)."""
+    traced = torch.fx.symbolic_trace(module)
+    with open(path, "w") as f:
+        for node in traced.graph.nodes:
+            f.write(f"{node.op}\t{node.name}\t{node.target}\t{node.args}\n")
+    return path

@@ -1,0 +1,73 @@
+"""Keras frontend tests (reference: examples/python/keras mnist_mlp/cnn
+patterns + keras callbacks)."""
+import numpy as np
+import pytest
+
+from flexflow_tpu.frontends import keras
+
+
+def synth(n, shape, classes, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, *shape).astype(np.float32)
+    w = rng.randn(int(np.prod(shape)), classes).astype(np.float32)
+    y = np.argmax(x.reshape(n, -1) @ w, 1).astype(np.int32)[:, None]
+    return x, y
+
+
+def test_functional_mlp():
+    inp = keras.Input(shape=(16,))
+    t = keras.Dense(64, activation="relu")(inp)
+    t = keras.Dense(4, activation="softmax")(t)
+    model = keras.Model(inputs=inp, outputs=t)
+    model.compile(optimizer=keras.SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], batch_size=32)
+    x, y = synth(256, (16,), 4)
+    model.fit(x, y, batch_size=32, epochs=8, verbose=False)
+    pm = model.evaluate(x, y, batch_size=32)
+    assert pm.get_accuracy() > 40.0
+
+
+def test_sequential_cnn():
+    model = keras.Sequential()
+    model.add(keras.Input(shape=(1, 8, 8)))
+    model.add(keras.Conv2D(4, 3, padding="same", activation="relu"))
+    model.add(keras.MaxPooling2D(2))
+    model.add(keras.Flatten())
+    model.add(keras.Dense(3, activation="softmax"))
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], batch_size=16)
+    x, y = synth(64, (1, 8, 8), 3)
+    pm = model.fit(x, y, batch_size=16, epochs=2, verbose=False)
+    assert pm.train_all == 64
+
+
+def test_merge_and_callbacks():
+    calls = []
+    inp = keras.Input(shape=(8,))
+    a = keras.Dense(8)(inp)
+    b = keras.Dense(8)(inp)
+    t = keras.Add()([a, b])
+    t = keras.Dense(2, activation="softmax")(t)
+    model = keras.Model(inputs=inp, outputs=t)
+    model.compile(optimizer=keras.Adam(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], batch_size=16)
+    cb = keras.callbacks.LambdaCallback(
+        on_epoch_end=lambda e, logs: calls.append(e))
+    x, y = synth(64, (8,), 2)
+    model.fit(x, y, batch_size=16, epochs=3, verbose=False, callbacks=[cb])
+    assert calls == [0, 1, 2]
+
+
+def test_get_set_weights():
+    inp = keras.Input(shape=(4,))
+    layer = keras.Dense(3)
+    t = layer(inp)
+    model = keras.Model(inputs=inp, outputs=t)
+    model.compile(optimizer="sgd", loss="mse",
+                  metrics=[], batch_size=8)
+    w = layer.get_weights()
+    assert w[0].shape == (4, 3)
+    layer.set_weights([np.ones((4, 3), np.float32), np.zeros(3, np.float32)])
+    np.testing.assert_allclose(layer.get_weights()[0], 1.0)

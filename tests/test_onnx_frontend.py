@@ -1,0 +1,84 @@
+"""ONNX frontend tests using lightweight protobuf test-doubles (the onnx
+package is not in this image; the importer is duck-typed over .graph)."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import DataType, FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.frontends.onnx import ONNXModel
+
+
+class Attr:
+    def __init__(self, name, **kw):
+        self.name = name
+        self.type = kw.pop("type", 0)
+        self.i = kw.get("i", 0)
+        self.f = kw.get("f", 0.0)
+        self.s = kw.get("s", b"")
+        self.ints = kw.get("ints", [])
+        self.floats = kw.get("floats", [])
+
+
+class Node:
+    def __init__(self, op_type, inputs, outputs, attrs=()):
+        self.op_type = op_type
+        self.input = list(inputs)
+        self.output = list(outputs)
+        self.attribute = list(attrs)
+
+
+class Value:
+    def __init__(self, name):
+        self.name = name
+
+
+class Init:
+    def __init__(self, name, array):
+        self.name = name
+        self.data = array
+
+
+class GraphDouble:
+    def __init__(self, nodes, initializers, outputs):
+        self.node = nodes
+        self.initializer = initializers
+        self.output = [Value(o) for o in outputs]
+
+
+class ModelDouble:
+    def __init__(self, graph):
+        self.graph = graph
+
+
+def test_onnx_mlp_import():
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(16, 32).astype(np.float32)
+    b1 = rng.randn(32).astype(np.float32)
+    w2 = rng.randn(32, 4).astype(np.float32)
+    graph = GraphDouble(
+        nodes=[
+            Node("Gemm", ["x", "w1", "b1"], ["h"]),
+            Node("Relu", ["h"], ["hr"]),
+            Node("MatMul", ["hr", "w2"], ["logits"]),
+            Node("Softmax", ["logits"], ["probs"],
+                 attrs=[Attr("axis", i=-1, type=1)]),
+        ],
+        initializers=[Init("w1", w1), Init("b1", b1), Init("w2", w2)],
+        outputs=["probs"],
+    )
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 16), DataType.DT_FLOAT)
+    om = ONNXModel(ModelDouble(graph))
+    out = om.apply(ff, {"x": x})
+    ff.compile(optimizer=SGDOptimizer(lr=0.0),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[])
+    om.load_weights(ff)
+    xv = rng.randn(8, 16).astype(np.float32)
+    ours = ff.predict(xv, batch_size=8)
+    # numpy reference
+    ref = np.maximum(xv @ w1 + b1, 0) @ w2
+    e = np.exp(ref - ref.max(-1, keepdims=True))
+    ref = e / e.sum(-1, keepdims=True)
+    np.testing.assert_allclose(ours, ref, atol=1e-5)

@@ -1,0 +1,72 @@
+"""PyTorch-FX import tests: numerical alignment vs CPU torch — the
+reference's correctness oracle pattern (tests/align/align_test.py)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from flexflow_tpu import DataType, FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.frontends.torch import PyTorchModel
+
+
+class MLP(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(16, 32)
+        self.relu = torch.nn.ReLU()
+        self.fc2 = torch.nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+
+class SmallCNN(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv = torch.nn.Conv2d(3, 8, 3, padding=1)
+        self.pool = torch.nn.MaxPool2d(2)
+        self.flat = torch.nn.Flatten()
+        self.fc = torch.nn.Linear(8 * 4 * 4, 5)
+
+    def forward(self, x):
+        return self.fc(self.flat(self.pool(torch.relu(self.conv(x)))))
+
+
+def _import_and_compare(torch_module, input_shape, atol=1e-4):
+    cfg = FFConfig()
+    cfg.batch_size = input_shape[0]
+    ff = FFModel(cfg)
+    x = ff.create_tensor(input_shape, DataType.DT_FLOAT)
+    pt = PyTorchModel(torch_module)
+    (out,) = pt.torch_to_ff(ff, [x])
+    ff.compile(optimizer=SGDOptimizer(lr=0.0),
+               loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               metrics=[])
+    pt.load_weights(ff)
+    rng = np.random.RandomState(0)
+    xv = rng.randn(*input_shape).astype(np.float32)
+    ours = ff.predict(xv, batch_size=input_shape[0])
+    with torch.no_grad():
+        theirs = torch_module(torch.from_numpy(xv)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=atol, rtol=1e-4)
+
+
+def test_torch_mlp_alignment():
+    _import_and_compare(MLP(), (8, 16))
+
+
+def test_torch_cnn_alignment():
+    _import_and_compare(SmallCNN(), (4, 3, 8, 8))
+
+
+def test_torch_functional_ops():
+    class Funky(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = torch.nn.Linear(8, 8)
+
+        def forward(self, x):
+            a = self.fc(x)
+            return torch.softmax(a + x * 2.0, dim=-1)
+
+    _import_and_compare(Funky(), (4, 8))
