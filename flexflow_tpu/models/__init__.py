@@ -1,0 +1,13 @@
+"""Model zoo (TPU-native equivalents of reference examples/, SURVEY §2.5)."""
+from .alexnet import build_alexnet  # noqa: F401
+from .dlrm import build_dlrm  # noqa: F401
+from .inception import build_inception_v3  # noqa: F401
+from .misc import (  # noqa: F401
+    build_bert_proxy,
+    build_candle_uno,
+    build_mlp_unify,
+    build_moe,
+    build_xdl,
+)
+from .resnet import build_resnet, build_resnext50  # noqa: F401
+from .transformer import build_transformer  # noqa: F401

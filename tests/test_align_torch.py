@@ -1,0 +1,245 @@
+"""Per-operator numerical alignment vs CPU PyTorch — forward AND backward.
+
+The reference's correctness oracle (tests/align/: align_create_tensor_ff.py
+runs each op in FlexFlow and torch, align_test.py asserts closeness for ~20
+operators fwd+bwd). Here each case runs the registered op forward under
+jax (CPU), and gradients via jax.grad, against the torch equivalent.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ff_types import ActiMode, AggrMode, DataType, OperatorType, PoolType
+from flexflow_tpu.ops import FwdCtx, get_op_def
+from flexflow_tpu.ops.attention import MultiHeadAttentionParams
+from flexflow_tpu.ops.batch_matmul import BatchMatmulParams
+from flexflow_tpu.ops.conv2d import Conv2DParams
+from flexflow_tpu.ops.elementwise import ElementBinaryParams, ElementUnaryParams
+from flexflow_tpu.ops.embedding import EmbeddingParams
+from flexflow_tpu.ops.linear import LinearParams
+from flexflow_tpu.ops.normalization import LayerNormParams
+from flexflow_tpu.ops.pool2d import Pool2DParams
+from flexflow_tpu.ops.reduce import ReduceParams, TopKParams
+from flexflow_tpu.ops.softmax import SoftmaxParams
+from flexflow_tpu.ops.tensor_ops import (
+    ConcatParams,
+    GatherParams,
+    ReshapeParams,
+    TransposeParams,
+)
+
+RNG = np.random.RandomState(0)
+CTX = FwdCtx(training=False, rng=None)
+
+
+def run_op(op_type, params, weights, inputs):
+    d = get_op_def(op_type)
+    outs = d.forward(params, weights, [jnp.asarray(x) for x in inputs], CTX)
+    return [np.asarray(o) for o in outs]
+
+
+def grads_of(op_type, params, weights, inputs, cotangent):
+    """d(sum(out * cotangent))/d(inputs[0])"""
+    d = get_op_def(op_type)
+
+    def f(x0):
+        out = d.forward(params, weights, [x0] + [jnp.asarray(x) for x in inputs[1:]], CTX)[0]
+        return jnp.sum(out * jnp.asarray(cotangent))
+
+    return np.asarray(jax.grad(f)(jnp.asarray(inputs[0])))
+
+
+def torch_grad(fn, x, cotangent):
+    t = torch.from_numpy(x).requires_grad_(True)
+    out = fn(t)
+    out.backward(torch.from_numpy(cotangent))
+    return t.grad.numpy()
+
+
+def assert_close(a, b, atol=1e-4):
+    np.testing.assert_allclose(a, b, atol=atol, rtol=1e-4)
+
+
+def test_linear_fwd_bwd():
+    x = RNG.randn(4, 8).astype(np.float32)
+    w = RNG.randn(8, 6).astype(np.float32)
+    b = RNG.randn(6).astype(np.float32)
+    p = LinearParams(out_channels=6)
+    (ours,) = run_op(OperatorType.OP_LINEAR, p, {"kernel": jnp.asarray(w), "bias": jnp.asarray(b)}, [x])
+    theirs = x @ w + b
+    assert_close(ours, theirs)
+    ct = RNG.randn(4, 6).astype(np.float32)
+    g = grads_of(OperatorType.OP_LINEAR, p, {"kernel": jnp.asarray(w), "bias": jnp.asarray(b)}, [x], ct)
+    tg = torch_grad(lambda t: t @ torch.from_numpy(w) + torch.from_numpy(b), x, ct)
+    assert_close(g, tg)
+
+
+def test_conv2d_fwd_bwd():
+    x = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    w = RNG.randn(5, 3, 3, 3).astype(np.float32)
+    p = Conv2DParams(out_channels=5, kernel_h=3, kernel_w=3, padding_h=1, padding_w=1,
+                     use_bias=False)
+    (ours,) = run_op(OperatorType.OP_CONV2D, p, {"kernel": jnp.asarray(w)}, [x])
+    theirs = torch.nn.functional.conv2d(
+        torch.from_numpy(x), torch.from_numpy(w), padding=1
+    ).numpy()
+    assert_close(ours, theirs)
+    ct = RNG.randn(*ours.shape).astype(np.float32)
+    g = grads_of(OperatorType.OP_CONV2D, p, {"kernel": jnp.asarray(w)}, [x], ct)
+    tg = torch_grad(
+        lambda t: torch.nn.functional.conv2d(t, torch.from_numpy(w), padding=1), x, ct
+    )
+    assert_close(g, tg)
+
+
+def test_pool2d_max_avg():
+    x = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    for ptype, tfn in [
+        (PoolType.POOL_MAX, torch.nn.functional.max_pool2d),
+        (PoolType.POOL_AVG, torch.nn.functional.avg_pool2d),
+    ]:
+        p = Pool2DParams(kernel_h=2, kernel_w=2, stride_h=2, stride_w=2,
+                         pool_type=ptype)
+        (ours,) = run_op(OperatorType.OP_POOL2D, p, {}, [x])
+        theirs = tfn(torch.from_numpy(x), 2, 2).numpy()
+        assert_close(ours, theirs)
+
+
+def test_layernorm_fwd_bwd():
+    x = RNG.randn(4, 6, 16).astype(np.float32)
+    scale = RNG.randn(16).astype(np.float32)
+    bias = RNG.randn(16).astype(np.float32)
+    p = LayerNormParams(axes=(-1,))
+    w = {"scale": jnp.asarray(scale), "bias": jnp.asarray(bias)}
+    (ours,) = run_op(OperatorType.OP_LAYERNORM, p, w, [x])
+    theirs = torch.nn.functional.layer_norm(
+        torch.from_numpy(x), (16,), torch.from_numpy(scale), torch.from_numpy(bias)
+    ).numpy()
+    assert_close(ours, theirs)
+    ct = RNG.randn(*x.shape).astype(np.float32)
+    g = grads_of(OperatorType.OP_LAYERNORM, p, w, [x], ct)
+    tg = torch_grad(
+        lambda t: torch.nn.functional.layer_norm(
+            t, (16,), torch.from_numpy(scale), torch.from_numpy(bias)
+        ), x, ct,
+    )
+    assert_close(g, tg, atol=1e-3)
+
+
+def test_softmax_fwd_bwd():
+    x = RNG.randn(4, 10).astype(np.float32)
+    p = SoftmaxParams(dim=-1)
+    (ours,) = run_op(OperatorType.OP_SOFTMAX, p, {}, [x])
+    assert_close(ours, torch.softmax(torch.from_numpy(x), -1).numpy())
+    ct = RNG.randn(4, 10).astype(np.float32)
+    g = grads_of(OperatorType.OP_SOFTMAX, p, {}, [x], ct)
+    tg = torch_grad(lambda t: torch.softmax(t, -1), x, ct)
+    assert_close(g, tg)
+
+
+def test_batch_matmul_fwd_bwd():
+    a = RNG.randn(3, 4, 5).astype(np.float32)
+    b = RNG.randn(3, 5, 6).astype(np.float32)
+    p = BatchMatmulParams()
+    (ours,) = run_op(OperatorType.OP_BATCHMATMUL, p, {}, [a, b])
+    assert_close(ours, np.matmul(a, b))
+    ct = RNG.randn(3, 4, 6).astype(np.float32)
+    g = grads_of(OperatorType.OP_BATCHMATMUL, p, {}, [a, b], ct)
+    tg = torch_grad(lambda t: torch.bmm(t, torch.from_numpy(b)), a, ct)
+    assert_close(g, tg)
+
+
+def test_embedding_fwd():
+    ids = RNG.randint(0, 20, (4, 3)).astype(np.int32)
+    table = RNG.randn(20, 8).astype(np.float32)
+    p = EmbeddingParams(num_entries=20, out_channels=8, aggr=AggrMode.AGGR_MODE_SUM)
+    (ours,) = run_op(OperatorType.OP_EMBEDDING, p, {"weight": jnp.asarray(table)}, [ids])
+    theirs = torch.nn.functional.embedding_bag(
+        torch.from_numpy(ids.astype(np.int64)), torch.from_numpy(table), mode="sum"
+    ).numpy()
+    assert_close(ours, theirs)
+
+
+@pytest.mark.parametrize("op_type,tfn", [
+    (OperatorType.OP_RELU, torch.relu),
+    (OperatorType.OP_SIGMOID, torch.sigmoid),
+    (OperatorType.OP_TANH, torch.tanh),
+    (OperatorType.OP_EXP, torch.exp),
+    (OperatorType.OP_GELU, lambda t: torch.nn.functional.gelu(t)),
+    (OperatorType.OP_RSQRT, torch.rsqrt),
+])
+def test_unary_ops(op_type, tfn):
+    x = (RNG.rand(4, 8).astype(np.float32) + 0.5)
+    p = ElementUnaryParams(op_type=op_type)
+    (ours,) = run_op(op_type, p, {}, [x])
+    assert_close(ours, tfn(torch.from_numpy(x)).numpy(), atol=2e-3)
+
+
+@pytest.mark.parametrize("op_type,tfn", [
+    (OperatorType.OP_EW_ADD, torch.add),
+    (OperatorType.OP_EW_SUB, torch.sub),
+    (OperatorType.OP_EW_MUL, torch.mul),
+    (OperatorType.OP_EW_DIV, torch.div),
+    (OperatorType.OP_EW_MAX, torch.maximum),
+    (OperatorType.OP_EW_MIN, torch.minimum),
+])
+def test_binary_ops(op_type, tfn):
+    a = RNG.randn(4, 8).astype(np.float32)
+    b = RNG.randn(4, 8).astype(np.float32) + 2.0
+    p = ElementBinaryParams(op_type=op_type)
+    (ours,) = run_op(op_type, p, {}, [a, b])
+    assert_close(ours, tfn(torch.from_numpy(a), torch.from_numpy(b)).numpy())
+
+
+def test_shape_ops():
+    x = RNG.randn(4, 6, 8).astype(np.float32)
+    (r,) = run_op(OperatorType.OP_RESHAPE, ReshapeParams((4, 48)), {}, [x])
+    assert r.shape == (4, 48)
+    (t,) = run_op(OperatorType.OP_TRANSPOSE, TransposeParams((0, 2, 1)), {}, [x])
+    assert_close(t, np.transpose(x, (0, 2, 1)))
+    (c,) = run_op(OperatorType.OP_CONCAT, ConcatParams(axis=1), {}, [x, x])
+    assert c.shape == (4, 12, 8)
+
+
+def test_gather_topk():
+    x = RNG.randn(4, 10).astype(np.float32)
+    idx = RNG.randint(0, 10, (4, 3)).astype(np.int32)
+    (g,) = run_op(OperatorType.OP_GATHER, GatherParams(dim=1), {}, [x, idx])
+    tg = torch.gather(torch.from_numpy(x), 1, torch.from_numpy(idx.astype(np.int64)))
+    assert_close(g, tg.numpy())
+    vals, inds = run_op(OperatorType.OP_TOPK, TopKParams(k=3), {}, [x])
+    tv, ti = torch.topk(torch.from_numpy(x), 3)
+    assert_close(vals, tv.numpy())
+
+
+def test_reduce_ops():
+    x = RNG.randn(4, 6, 8).astype(np.float32)
+    (s,) = run_op(OperatorType.OP_REDUCE_SUM, ReduceParams(axes=(1,)), {}, [x])
+    assert_close(s, x.sum(1), atol=1e-4)
+    (mn,) = run_op(OperatorType.OP_REDUCE_MEAN, ReduceParams(axes=(2,), keepdims=True), {}, [x])
+    assert_close(mn, x.mean(2, keepdims=True))
+
+
+def test_mha_shapes_and_grad():
+    """Attention: check shape + finite grads (torch's cuDNN-style packed MHA
+    differs in weight layout, so exact alignment is covered by the
+    end-to-end torch-frontend test instead)."""
+    b, s, e, h = 2, 6, 16, 4
+    q = RNG.randn(b, s, e).astype(np.float32)
+    p = MultiHeadAttentionParams(embed_dim=e, num_heads=h)
+    d = get_op_def(OperatorType.OP_MULTIHEAD_ATTENTION)
+    wq = RNG.randn(e, h, 4).astype(np.float32)
+    wo = RNG.randn(h, 4, e).astype(np.float32)
+    weights = {
+        "wq": jnp.asarray(wq), "wk": jnp.asarray(wq), "wv": jnp.asarray(wq),
+        "wo": jnp.asarray(wo), "bias_o": jnp.zeros(e),
+    }
+    (out,) = d.forward(p, weights, [jnp.asarray(q)] * 3, CTX)
+    assert out.shape == (b, s, e)
+    g = jax.grad(
+        lambda x: jnp.sum(d.forward(p, weights, [x, x, x], CTX)[0])
+    )(jnp.asarray(q))
+    assert np.isfinite(np.asarray(g)).all()

@@ -1,0 +1,155 @@
+"""Model-zoo smoke tests: every reference example model builds, compiles,
+and takes one training step on the 8-device CPU mesh (reference:
+tests/cpp_gpu_tests.sh runs every C++ example; pass = trains without
+crashing)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    AdamOptimizer,
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu import models as zoo
+
+
+def one_step(model, int_inputs=()):
+    ex = model.executor
+    step = ex.build_train_step()
+    rng = np.random.RandomState(0)
+    bx = []
+    for i, pt in enumerate(ex.input_pts):
+        shape = pt.material_shape()
+        if pt.data_type == DataType.DT_INT32:
+            arr = rng.randint(0, int_inputs[i] if i < len(int_inputs) and int_inputs[i] else 10,
+                              shape).astype(np.int32)
+        else:
+            arr = rng.randn(*shape).astype(np.float32)
+        bx.append(ex.shard_batch(pt, arr))
+    logits_shape = ex.logits_pt.material_shape()
+    if model.label_tensor.data_type == DataType.DT_INT32:
+        y = jnp.asarray(rng.randint(0, logits_shape[-1], (logits_shape[0], 1)), jnp.int32)
+    else:
+        y = jnp.asarray(rng.randn(*logits_shape).astype(np.float32))
+    state, partials = step(model.state, bx, y, jax.random.PRNGKey(0))
+    loss = float(partials["loss"])
+    assert np.isfinite(loss), f"loss {loss}"
+    return loss
+
+
+def make(batch):
+    cfg = FFConfig()
+    cfg.batch_size = batch
+    return FFModel(cfg)
+
+
+def test_alexnet_small():
+    m = make(8)
+    zoo.build_alexnet(m, 8, num_classes=10, height=67, width=67)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    one_step(m)
+
+
+def test_resnet_tiny():
+    m = make(8)
+    zoo.build_resnet(m, 8, num_classes=4, height=32, width=32,
+                     blocks_per_stage=(1, 1))
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    one_step(m)
+
+
+def test_resnext_tiny():
+    m = make(8)
+    inp = m.create_tensor((8, 64, 16, 16), DataType.DT_FLOAT)
+    from flexflow_tpu.models.resnet import resnext_block
+    t = resnext_block(m, inp, 1, 64, groups=32, projection=True)
+    t = m.flat(t)
+    t = m.dense(t, 4)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    one_step(m)
+
+
+def test_inception_tiny():
+    m = make(4)
+    from flexflow_tpu.models.inception import conv_bn, inception_a
+    inp = m.create_tensor((4, 3, 75, 75), DataType.DT_FLOAT)
+    t = conv_bn(m, inp, 32, 3, 3, 2, 2)
+    t = inception_a(m, t, 32)
+    t = m.pool2d(t, t.dims[2], t.dims[3], 1, 1, 0, 0)
+    t = m.flat(t)
+    t = m.dense(t, 4)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    one_step(m)
+
+
+def test_dlrm():
+    m = make(16)
+    zoo.build_dlrm(m, 16, embedding_sizes=(1000, 1000, 1000, 1000))
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    one_step(m, int_inputs=(1000, 1000, 1000, 1000))
+
+
+def test_xdl():
+    m = make(16)
+    zoo.build_xdl(m, 16, embedding_sizes=(500,) * 4)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    one_step(m, int_inputs=(500, 500, 500, 500))
+
+
+def test_mlp_unify():
+    m = make(16)
+    zoo.build_mlp_unify(m, 16, input_dims=(64, 64), hidden_dims=(128, 128))
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    one_step(m)
+
+
+def test_candle_uno():
+    m = make(8)
+    zoo.build_candle_uno(m, 8, feature_shapes=(32, 48),
+                         dense_feature_layers=(64,), dense_layers=(64, 32))
+    m.compile(AdamOptimizer(alpha=1e-3),
+              LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+              [MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    one_step(m)
+
+
+def test_moe_model():
+    m = make(16)
+    zoo.build_moe(m, 16, input_dim=32, num_classes=4, num_exp=4,
+                  num_select=2, hidden=16)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    one_step(m)
+
+
+def test_bert_proxy_tiny():
+    m = make(4)
+    zoo.build_bert_proxy(m, 4, seq_length=16, hidden_size=64,
+                         num_heads=4, num_layers=2)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+              [MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    one_step(m)
