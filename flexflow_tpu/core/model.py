@@ -113,6 +113,10 @@ class FFModel:
         name: str = "",
         initializers: Optional[Dict[str, object]] = None,
     ) -> Union[Tensor, List[Tensor]]:
+        # deterministic per-model names so checkpoints/strategies match
+        # across processes (guid-based names differ run to run)
+        if not name:
+            name = f"{op_type.name.lower()}_{len(self.layers)}"
         layer = Layer(op_type, params, inputs, name=name)
         if initializers:
             layer.initializers.update(

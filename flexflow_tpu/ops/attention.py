@@ -94,20 +94,37 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
     k = k.astype(q_in.dtype)
     v = v.astype(q_in.dtype)
 
-    scale = 1.0 / jnp.sqrt(jnp.asarray(params.head_dim, jnp.float32))
-    scores = jnp.einsum("bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32)
-    scores = scores * scale
-    if params.causal:
-        s_len, t_len = scores.shape[-2], scores.shape[-1]
-        mask = jnp.tril(jnp.ones((s_len, t_len), bool))
-        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    if params.dropout > 0.0 and ctx.training and ctx.rng is not None:
-        keep = 1.0 - params.dropout
-        mask = jax.random.bernoulli(ctx.rng, keep, probs.shape)
-        probs = jnp.where(mask, probs / keep, 0).astype(probs.dtype)
-    attn = jnp.einsum("bhst,bthd->bshd", probs, v, preferred_element_type=jnp.float32)
-    attn = attn.astype(q.dtype)
+    seq_len = q.shape[1]
+    use_dropout = params.dropout > 0.0 and ctx.training and ctx.rng is not None
+    if seq_len >= 512 and not use_dropout:
+        # Long sequences: O(seq) memory kernels instead of the s×s score
+        # tensor — Pallas flash attention on TPU, chunked scan elsewhere
+        # (kernels/attention.py; replaces cuDNN MHA's internal algorithm).
+        from ..kernels.attention import chunked_attention, flash_attention
+
+        if jax.default_backend() == "tpu":
+            attn = flash_attention(q, k, v, params.causal)
+        else:
+            attn = chunked_attention(q, k, v, causal=params.causal)
+    else:
+        scale = 1.0 / jnp.sqrt(jnp.asarray(params.head_dim, jnp.float32))
+        scores = jnp.einsum(
+            "bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32
+        )
+        scores = scores * scale
+        if params.causal:
+            s_len, t_len = scores.shape[-2], scores.shape[-1]
+            mask = jnp.tril(jnp.ones((s_len, t_len), bool))
+            scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        if use_dropout:
+            keep = 1.0 - params.dropout
+            mask = jax.random.bernoulli(ctx.rng, keep, probs.shape)
+            probs = jnp.where(mask, probs / keep, 0).astype(probs.dtype)
+        attn = jnp.einsum(
+            "bhst,bthd->bshd", probs, v, preferred_element_type=jnp.float32
+        )
+        attn = attn.astype(q.dtype)
     out = jnp.einsum("bshd,hde->bse", attn, wo, preferred_element_type=jnp.float32)
     out = out.astype(q_in.dtype)
     if params.bias:

@@ -1,0 +1,10 @@
+"""Runtime services: checkpointing, recompile triggers, profiling,
+strategy IO (TPU-native equivalents of reference src/runtime/ services +
+the checkpoint upgrade SURVEY §5 calls for)."""
+from .checkpoint import restore_checkpoint, save_checkpoint  # noqa: F401
+from .recompile import RecompileState, recompile_on_condition  # noqa: F401
+from .strategy_io import (  # noqa: F401
+    apply_imported_strategy,
+    export_strategy,
+    import_strategy,
+)

