@@ -1,0 +1,110 @@
+"""Native (C++) runtime components with ctypes bindings.
+
+The reference's runtime core is C++ (SURVEY §2 language note); this package
+holds the pieces where native code genuinely pays on TPU hosts: the
+prefetching data loader (src/dataloader.cc — GIL-free shuffled batch
+gather, reference python/flexflow_dataloader.cc) and the task-graph
+simulator + MCMC annealing loop (src/simulator.cc — reference
+src/runtime/simulator.cc + model.cc mcmc_optimize).
+
+The shared library is built on first use with g++ (cached next to the
+sources); every consumer has a pure-Python fallback so the framework works
+without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src")
+_LIB_PATH = os.path.join(_HERE, "libffnative.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _sources():
+    return sorted(
+        os.path.join(_SRC, f) for f in os.listdir(_SRC) if f.endswith(".cc")
+    )
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(os.path.getmtime(s) > lib_mtime for s in _sources())
+
+
+def build(force: bool = False) -> Optional[str]:
+    """Compile the native library. Returns its path or None on failure."""
+    global _build_failed
+    with _lock:
+        if not force and not _needs_build():
+            return _LIB_PATH
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+            "-o", _LIB_PATH, *_sources(),
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            _build_failed = False
+            return _LIB_PATH
+        except Exception:
+            _build_failed = True
+            return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    path = build()
+    if path is None:
+        return None
+    with _lock:
+        if _lib is None:
+            lib = ctypes.CDLL(path)
+            _configure(lib)
+            _lib = lib
+    return _lib
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    i64 = ctypes.c_int64
+    u64 = ctypes.c_uint64
+    dbl = ctypes.c_double
+    ptr = ctypes.c_void_p
+    # dataloader
+    lib.ffdl_create.restype = ptr
+    lib.ffdl_create.argtypes = [ptr, i64, i64, i64, ctypes.c_int, u64, i64]
+    lib.ffdl_next.restype = i64
+    lib.ffdl_next.argtypes = [ptr, ptr]
+    lib.ffdl_reset.argtypes = [ptr]
+    lib.ffdl_batches_per_epoch.restype = i64
+    lib.ffdl_batches_per_epoch.argtypes = [ptr]
+    lib.ffdl_destroy.argtypes = [ptr]
+    # simulator
+    I64P = ctypes.POINTER(i64)
+    DP = ctypes.POINTER(dbl)
+    lib.ffsim_create.restype = ptr
+    lib.ffsim_create.argtypes = [
+        i64, i64, I64P, I64P, I64P, i64, I64P, I64P, i64, I64P, I64P, I64P,
+        i64, DP, DP, DP, dbl, dbl,
+    ]
+    lib.ffsim_simulate.restype = dbl
+    lib.ffsim_simulate.argtypes = [ptr, I64P]
+    lib.ffsim_mcmc.restype = dbl
+    lib.ffsim_mcmc.argtypes = [ptr, I64P, i64, dbl, u64]
+    lib.ffsim_destroy.argtypes = [ptr]
+
+
+def available() -> bool:
+    return get_lib() is not None

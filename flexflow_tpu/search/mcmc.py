@@ -148,8 +148,13 @@ class MCMCSearch:
         graph: Graph,
         budget: int = 100,
         start: Optional[Dict[int, MachineView]] = None,
+        use_native: bool = True,
     ) -> Tuple[Dict[int, MachineView], float]:
         machine = self.cost_model.machine
+        if use_native:
+            result = self._optimize_native(graph, budget, start)
+            if result is not None:
+                return result
         views = dict(start) if start else self.data_parallel_start(graph)
         cur = simulate_runtime(graph, views, self.cost_model)
         best_views, best = dict(views), cur
@@ -167,3 +172,32 @@ class MCMCSearch:
                 if cur < best:
                     best_views, best = dict(views), cur
         return best_views, best
+
+    def _optimize_native(self, graph, budget, start):
+        """C++ fast path (native/src/simulator.cc): flatten once, anneal in
+        native code. Returns None when the native lib is unavailable."""
+        try:
+            from .. import native
+
+            if not native.available():
+                return None
+            from ..native.simulator import NativeSimulator
+        except Exception:
+            return None
+        machine = self.cost_model.machine
+        ops = graph.topo_order()
+        views_per_op = {op.guid: self._valid_views(op, machine) for op in ops}
+        sim = NativeSimulator(graph, self.cost_model, views_per_op)
+        slots = []
+        for op in ops:
+            if start and op.guid in start:
+                cands = views_per_op[op.guid]
+                h = start[op.guid].hash()
+                slot = next((i for i, v in enumerate(cands) if v.hash() == h), 0)
+            else:
+                slot = 0
+            slots.append(slot)
+        views, cost = sim.mcmc(
+            slots, budget, alpha=self.alpha, seed=self.rng.randrange(1 << 30)
+        )
+        return views, cost
