@@ -1,0 +1,42 @@
+"""AlexNet on CIFAR-10-shaped data — the reference bootcamp benchmark
+(reference: bootcamp_demo/ff_alexnet_cifar10.py; BASELINE.md config #1).
+
+Usage: python examples/python/alexnet_cifar10.py -e 2 -b 64
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu import (
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.models.alexnet import build_alexnet
+
+
+def main():
+    ffconfig = FFConfig()
+    model = FFModel(ffconfig)
+    build_alexnet(model, ffconfig.batch_size, num_classes=10)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01, momentum=0.9, weight_decay=1e-4),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY,
+                 MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    # synthetic CIFAR-10 upscaled to the AlexNet input size, like the
+    # reference's generated data path when no dataset file is given
+    n = ffconfig.batch_size * 8
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 3, 229, 229).astype(np.float32)
+    y = rng.randint(0, 10, (n, 1)).astype(np.int32)
+    model.fit(x, y, epochs=ffconfig.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""DLRM training (reference: examples/cpp/DLRM/dlrm.cc defaults;
+scripts/osdi22ae/dlrm.sh benchmark config)."""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models.dlrm import build_dlrm
+
+
+def main():
+    ffconfig = FFConfig()
+    model = FFModel(ffconfig)
+    emb_sizes = (100000,) * 4
+    build_dlrm(model, ffconfig.batch_size, embedding_sizes=emb_sizes)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY],
+    )
+    n = ffconfig.batch_size * 8
+    rng = np.random.RandomState(0)
+    sparse = [rng.randint(0, v, (n, 1)).astype(np.int32) for v in emb_sizes]
+    dense = rng.randn(n, 4).astype(np.float32)
+    y = rng.randint(0, 2, (n, 1)).astype(np.int32)
+    model.fit(sparse + [dense], y, epochs=ffconfig.epochs)
+
+
+if __name__ == "__main__":
+    main()

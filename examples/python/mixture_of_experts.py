@@ -1,0 +1,40 @@
+"""Mixture-of-Experts with load balancing and dynamic recompilation
+(reference: examples/cpp/mixture_of_experts/moe.cc, incl. the
+recompile-based expert rebalancing at moe.cc:65-98)."""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models.misc import build_moe
+from flexflow_tpu.runtime import RecompileState, recompile_on_condition
+
+
+def main():
+    ffconfig = FFConfig()
+    model = FFModel(ffconfig)
+    build_moe(model, ffconfig.batch_size, input_dim=784, num_classes=10,
+              num_exp=5, num_select=2, hidden=64, lambda_bal=0.04)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY],
+    )
+    n = ffconfig.batch_size * 8
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, 784).astype(np.float32)
+    y = rng.randint(0, 10, (n, 1)).astype(np.int32)
+
+    # reference moe.cc: trigger checked each epoch; here it fires once at
+    # epoch boundary and re-jits the (possibly altered) model
+    r = RecompileState(trigger_func=lambda m: m.state.step >= 8)
+    for epoch in range(ffconfig.epochs):
+        model.fit(x, y, epochs=1)
+        if recompile_on_condition(model, r):
+            print(f"[moe] recompiled after epoch {epoch}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""MNIST MLP via the Keras frontend (reference:
+examples/python/keras/seq_mnist_mlp.py; accuracy gate like
+examples/python/native/accuracy.py ModelAccuracy.MNIST_MLP).
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu.frontends import keras
+
+
+def load_mnist_like(n=4096, seed=0):
+    """Synthetic MNIST-shaped separable data (no dataset download in this
+    environment)."""
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)[:, None]
+    return x, y
+
+
+def main():
+    x, y = load_mnist_like()
+    model = keras.Sequential()
+    model.add(keras.Input(shape=(784,)))
+    model.add(keras.Dense(512, activation="relu"))
+    model.add(keras.Dense(512, activation="relu"))
+    model.add(keras.Dense(10, activation="softmax"))
+    model.compile(
+        optimizer=keras.SGD(learning_rate=0.05),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+        batch_size=64,
+    )
+    model.fit(
+        x, y, batch_size=64, epochs=5,
+        callbacks=[keras.callbacks.EpochVerifyMetrics(60.0)],
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Transformer encoder benchmark — the reference OSDI'22 headline config
+(reference: examples/cpp/Transformer/transformer.cc; scripts/osdi22ae/bert.sh:
+batch 8, hidden 1024, 16 heads, 12 layers, seq 512).
+
+Usage:
+  python examples/python/transformer.py -b 8                 # data parallel
+  python examples/python/transformer.py -b 8 --budget 20     # Unity search
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models.transformer import build_transformer
+
+
+def main():
+    ffconfig = FFConfig()
+    model = FFModel(ffconfig)
+    build_transformer(
+        model,
+        batch_size=ffconfig.batch_size,
+        seq_length=512,
+        hidden_size=1024,
+        num_heads=16,
+        num_layers=12,
+    )
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR],
+    )
+    n = ffconfig.batch_size * max(1, ffconfig.iterations)
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 512, 1024).astype(np.float32)
+    y = rng.randn(n, 512, 1024).astype(np.float32)
+    model.fit(x, y, epochs=ffconfig.epochs)
+
+
+if __name__ == "__main__":
+    main()

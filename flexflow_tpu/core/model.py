@@ -479,6 +479,19 @@ class FFModel:
         )
         return out
 
+    def lstm(self, input: Tensor, hidden_size: int, return_sequences: bool = True,
+             name="") -> Tensor:
+        """reference: nmt/ standalone LSTM (SURVEY §1 row 12), promoted to a
+        first-class op here."""
+        from ..ops.lstm import LSTMParams
+
+        return self._add_layer(
+            OperatorType.OP_LSTM,
+            LSTMParams(hidden_size=hidden_size, return_sequences=return_sequences),
+            [input],
+            name,
+        )
+
     # MoE family (reference: moe.cc:20-44 FFModel::moe composite)
     def group_by(self, input: Tensor, assign: Tensor, n: int, alpha: float, name=""):
         return self._add_layer(
@@ -550,6 +563,13 @@ class FFModel:
 
         # 1. Layer graph -> PCG (reference: create_operators_from_layers)
         self.graph, self._tensor_map = layers_to_pcg(self.layers)
+        if self.config.perform_fusion:
+            # reference: apply_fusion (model.cc:2495, --fusion). Note:
+            # per-layer weight get/set for non-head chain members is not
+            # available on fused graphs (weights move under the fused op).
+            from ..pcg.fusion import apply_fusion
+
+            self.graph = apply_fusion(self.graph)
         self._pt_by_guid = {}
         for op in self.graph.ops:
             for t in list(op.outputs) + list(op.weights):

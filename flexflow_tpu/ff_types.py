@@ -229,6 +229,8 @@ class OperatorType(enum.IntEnum):
     OP_FUSED_PARALLEL = 1115
     # TPU-native additions (first-class sequence/context parallelism, SURVEY §7)
     OP_ALL_TO_ALL = 1120
+    # recurrence (reference implements LSTM only in the standalone nmt/)
+    OP_LSTM = 1130
 
 
 PARALLEL_OP_TYPES = frozenset(

@@ -1,0 +1,43 @@
+"""NMT seq2seq model (embedding + stacked LSTM encoder/decoder + projection).
+
+Same capability as the reference's standalone NMT example (nmt/nmt.cc,
+~3.3k LoC of custom CUDA LSTM/embed/linear/softmax predating FFModel —
+SURVEY §1 row 12), built on the framework's first-class ops instead.
+"""
+from __future__ import annotations
+
+from ..core.model import FFModel
+from ..ff_types import AggrMode, DataType
+
+
+def build_nmt(
+    model: FFModel,
+    batch_size: int,
+    src_vocab: int = 32000,
+    tgt_vocab: int = 32000,
+    src_len: int = 32,
+    tgt_len: int = 32,
+    embed_dim: int = 256,
+    hidden: int = 512,
+    num_layers: int = 2,
+):
+    """reference: nmt.cc top_level_task — encoder LSTM stack over source
+    embeddings, decoder LSTM stack (teacher-forced), vocab projection +
+    softmax."""
+    src = model.create_tensor((batch_size, src_len), DataType.DT_INT32, name="src")
+    tgt = model.create_tensor((batch_size, tgt_len), DataType.DT_INT32, name="tgt")
+    enc = model.embedding(src, src_vocab, embed_dim, AggrMode.AGGR_MODE_NONE)
+    for _ in range(num_layers):
+        enc = model.lstm(enc, hidden, return_sequences=True)
+    # final encoder state broadcast to the decoder via concat conditioning
+    enc_last = model.lstm(enc, hidden, return_sequences=False)  # (b, h)
+    dec = model.embedding(tgt, tgt_vocab, embed_dim, AggrMode.AGGR_MODE_NONE)
+    for _ in range(num_layers):
+        dec = model.lstm(dec, hidden, return_sequences=True)
+    # condition decoder states on the encoder summary
+    enc_cond = model.reshape(enc_last, (batch_size, 1, hidden))
+    # broadcast add over target positions
+    dec = model.add(dec, enc_cond)
+    logits = model.dense(dec, tgt_vocab)
+    probs = model.softmax(logits)
+    return [src, tgt], probs

@@ -48,7 +48,14 @@ def _fused_forward(params: FusedOpParams, weights, inputs, ctx: FwdCtx):
     slots = list(inputs)
     for step, (op_type, p, in_slots) in enumerate(params.chain):
         d = get_op_def(op_type)
-        step_weights = weights.get(f"step{step}", {}) if weights else {}
+        step_weights = {}
+        if weights:
+            # nested {"step0": {...}} or flat {"step0/kernel": ...} layouts
+            step_weights = dict(weights.get(f"step{step}", {}))
+            prefix = f"step{step}/"
+            for k, v in weights.items():
+                if isinstance(k, str) and k.startswith(prefix):
+                    step_weights[k[len(prefix):]] = v
         outs = d.forward(p, step_weights, [slots[i] for i in in_slots], ctx)
         slots.extend(outs)
     return [slots[i] for i in params.output_slots]

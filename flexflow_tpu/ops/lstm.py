@@ -1,0 +1,82 @@
+"""LSTM operator.
+
+TPU-native equivalent of the reference's standalone NMT LSTM
+(nmt/lstm.cc + CUDA kernels, SURVEY §1 row 12 — the reference implements a
+hand-written LSTM cell for its legacy seq2seq example). Here the recurrence
+is a lax.scan whose per-step cell is one fused gate matmul on the MXU; XLA
+pipelines the scan. Gate math matches the standard cuDNN/torch LSTM cell.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ff_types import DataType, OperatorType
+from .registry import WeightSpec, register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class LSTMParams:
+    hidden_size: int
+    return_sequences: bool = True
+
+
+def _infer(params: LSTMParams, in_shapes, in_dtypes):
+    (s,) = in_shapes  # (batch, seq, features)
+    if params.return_sequences:
+        out = (s[0], s[1], params.hidden_size)
+    else:
+        out = (s[0], params.hidden_size)
+    return [out], [in_dtypes[0]]
+
+
+def _weights(params: LSTMParams, in_shapes, in_dtypes):
+    (s,) = in_shapes
+    h, f = params.hidden_size, s[-1]
+    dt = in_dtypes[0]
+    return [
+        WeightSpec("wx", (f, 4 * h), dt, "glorot_uniform", ("", "out_channel")),
+        WeightSpec("wh", (h, 4 * h), dt, "glorot_uniform", ("", "out_channel")),
+        WeightSpec("bias", (4 * h,), dt, "zero", ("out_channel",)),
+    ]
+
+
+def _forward(params: LSTMParams, weights, inputs, ctx):
+    (x,) = inputs  # (b, s, f)
+    h_dim = params.hidden_size
+    wx, wh, bias = weights["wx"], weights["wh"], weights["bias"]
+    cdt = ctx.compute_dtype
+    if cdt is not None:
+        x, wx, wh = x.astype(cdt), wx.astype(cdt), wh.astype(cdt)
+    b = x.shape[0]
+    # pre-compute input projections for the whole sequence in one matmul
+    xg = jnp.einsum("bsf,fg->bsg", x, wx, preferred_element_type=jnp.float32)
+    xg = xg + bias.astype(jnp.float32)
+
+    def cell(carry, xg_t):
+        h_prev, c_prev = carry
+        gates = xg_t + jnp.dot(
+            h_prev, wh, preferred_element_type=jnp.float32
+        ).astype(jnp.float32)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c_prev + i * g
+        h = o * jnp.tanh(c)
+        return (h.astype(x.dtype), c), h
+
+    h0 = jnp.zeros((b, h_dim), x.dtype)
+    c0 = jnp.zeros((b, h_dim), jnp.float32)
+    (_, _), hs = lax.scan(cell, (h0, c0), xg.transpose(1, 0, 2))
+    hs = hs.transpose(1, 0, 2).astype(x.dtype)  # (b, s, h)
+    if params.return_sequences:
+        return [hs]
+    return [hs[:, -1, :]]
+
+
+register_op(
+    OperatorType.OP_LSTM, "LSTM", infer=_infer, weights=_weights, forward=_forward
+)

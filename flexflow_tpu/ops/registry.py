@@ -117,6 +117,7 @@ def ensure_ops_loaded():
         embedding,
         fused,
         linear,
+        lstm,
         moe,
         normalization,
         pool2d,

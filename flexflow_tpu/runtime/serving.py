@@ -1,0 +1,104 @@
+"""Inference serving: a batching scheduler over a compiled model.
+
+TPU-native counterpart to the reference's Triton prototype (triton/src/,
+~8k LoC "incomplete prototype" serving ONNX models on Legion — SURVEY §2.6).
+Instead of a Triton backend we provide the piece that matters on TPU: a
+request queue + dynamic batcher that pads/packs incoming requests to the
+compiled batch size, runs the jitted forward, and fans results back out.
+Models arrive through any frontend (ONNX importer included, matching the
+prototype's ONNX surface).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class InferenceRequest:
+    def __init__(self, inputs: List[np.ndarray]):
+        self.id = uuid.uuid4().hex
+        self.inputs = inputs
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+
+
+class BatchScheduler:
+    """Dynamic batcher (reference: triton/src/instance.cc lifecycle +
+    per-request execution, re-thought as a batch queue).
+
+    `max_delay_s`: how long to wait to fill a batch before running partial.
+    """
+
+    def __init__(self, model, *, max_delay_s: float = 0.005):
+        assert model.executor is not None, "compile() the model first"
+        self.model = model
+        self.batch_size = model.executor.input_pts[0].material_shape()[0]
+        self.max_delay_s = max_delay_s
+        self._q: "queue.Queue[InferenceRequest]" = queue.Queue()
+        self._fwd = model.executor.build_forward()
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._started = False
+        self.stats = {"requests": 0, "batches": 0, "padded_slots": 0}
+
+    # -- client API ------------------------------------------------------
+    def start(self):
+        if not self._started:
+            self._worker.start()
+            self._started = True
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._started:
+            self._worker.join(timeout=5)
+
+    def submit(self, inputs: List[np.ndarray]) -> InferenceRequest:
+        """Each request carries ONE sample per model input (no batch dim)."""
+        req = InferenceRequest([np.asarray(a) for a in inputs])
+        self._q.put(req)
+        return req
+
+    def infer(self, inputs: List[np.ndarray], timeout: float = 30.0) -> np.ndarray:
+        req = self.submit(inputs)
+        assert req.event.wait(timeout), "inference timed out"
+        return req.result
+
+    # -- batching loop ---------------------------------------------------
+    def _loop(self):
+        import jax.numpy as jnp
+
+        n_inputs = len(self.model.executor.input_pts)
+        while not self._stop.is_set():
+            batch: List[InferenceRequest] = []
+            try:
+                batch.append(self._q.get(timeout=0.05))
+            except queue.Empty:
+                continue
+            deadline = time.monotonic() + self.max_delay_s
+            while len(batch) < self.batch_size:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            pad = self.batch_size - len(batch)
+            arrays = []
+            for i in range(n_inputs):
+                rows = [r.inputs[i] for r in batch]
+                stacked = np.stack(rows + [rows[-1]] * pad, axis=0)
+                arrays.append(jnp.asarray(stacked))
+            out = np.asarray(self._fwd(self.model.state.params, arrays))
+            for j, r in enumerate(batch):
+                r.result = out[j]
+                r.event.set()
+            self.stats["requests"] += len(batch)
+            self.stats["batches"] += 1
+            self.stats["padded_slots"] += pad

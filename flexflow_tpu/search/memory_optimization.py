@@ -1,0 +1,119 @@
+"""Memory-aware multi-objective strategy search.
+
+TPU-native equivalent of the reference's memory-aware search
+(src/runtime/memory_optimization.cc + the lambda binary-search loop in
+Graph::graph_optimize_task, graph.cc:2060-2130): instead of optimizing pure
+run time, optimize `run_time + lambda * per_device_memory` and binary-search
+lambda until the best strategy fits the per-chip HBM budget
+(`--memory-search`, `FFConfig.device_mem`).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+from ..pcg.graph import Graph
+from ..pcg.machine_view import MachineResource, MachineView
+from .cost_model import CostModel
+from .dp_search import GraphCostResult, SearchHelper
+from .substitution import GraphSearchHelper
+
+
+@dataclasses.dataclass
+class MemoryUsage:
+    """reference: memory_optimization.h:45-100 MemoryUsage"""
+
+    num_devices: int
+    per_device_bytes: Dict[int, int]
+
+    @property
+    def max_bytes(self) -> int:
+        return max(self.per_device_bytes.values(), default=0)
+
+
+def measure_memory(
+    graph: Graph, views: Dict[int, MachineView], cost_model: CostModel
+) -> MemoryUsage:
+    """Per-device memory of a placed strategy: each op's shard memory
+    (inputs+outputs+weights, CostMetrics) lands on its view's devices
+    (reference: Simulator's memory accounting per device)."""
+    per_dev: Dict[int, int] = {}
+    for op in graph.ops:
+        view = views.get(op.guid)
+        if view is None:
+            continue
+        cm = cost_model.measure_operator_cost(op, view)
+        share = cm.total_memory  # already per-shard
+        for d in view.device_ids():
+            per_dev[d] = per_dev.get(d, 0) + share
+    return MemoryUsage(num_devices=len(per_dev), per_device_bytes=per_dev)
+
+
+class MemorySearchHelper(SearchHelper):
+    """SearchHelper whose node cost includes lambda * memory (reference:
+    GraphCostResultWithMemory, graph.h:121)."""
+
+    def __init__(self, cost_model: CostModel, mem_lambda: float = 0.0, **kw):
+        super().__init__(cost_model, **kw)
+        self.mem_lambda = mem_lambda
+
+    def node_cost(self, op, view, bounds) -> float:
+        base = super().node_cost(op, view, bounds)
+        if self.mem_lambda <= 0.0:
+            return base
+        cm = self.cost_model.measure_operator_cost(op, view)
+        return base + self.mem_lambda * cm.total_memory
+
+
+def graph_optimize_with_memory(
+    graph: Graph,
+    cost_model: CostModel,
+    res: MachineResource,
+    xfers,
+    *,
+    device_mem_budget: int,
+    alpha: float = 1.2,
+    budget: int = 10,
+    lambda_iters: int = 8,
+) -> Tuple[Graph, GraphCostResult, MemoryUsage, float]:
+    """Binary search over lambda (reference: graph.cc:2071-2128
+    try_one_lambda loop): lambda=0 gives the fastest strategy; if it
+    overflows the budget, raise lambda until memory fits, then tighten."""
+
+    from .mcmc import simulate_runtime
+
+    def run(lam: float):
+        sh = MemorySearchHelper(cost_model, mem_lambda=lam)
+        gsh = GraphSearchHelper(sh, xfers, alpha=alpha, budget=budget)
+        g, r = gsh.graph_optimize(graph, res)
+        mem = measure_memory(g, r.views, cost_model)
+        # r.cost is lambda-weighted — recompute the comparable pure runtime
+        real = simulate_runtime(g, r.views, cost_model)
+        return g, GraphCostResult(real, r.views), mem
+
+    best = run(0.0)
+    if best[2].max_bytes <= device_mem_budget:
+        return (*best, 0.0)
+
+    lo, hi = 0.0, 1e-6  # seconds per byte; grow hi until feasible
+    feasible = None
+    for _ in range(lambda_iters):
+        cand = run(hi)
+        if cand[2].max_bytes <= device_mem_budget:
+            feasible = (cand, hi)
+            break
+        hi *= 16.0
+    if feasible is None:
+        return (*best, 0.0)  # infeasible — return fastest (caller warns)
+    # tighten between lo (infeasible) and hi (feasible)
+    best_feasible, best_lambda = feasible
+    for _ in range(lambda_iters):
+        mid = (lo + hi) / 2.0
+        cand = run(mid)
+        if cand[2].max_bytes <= device_mem_budget:
+            hi = mid
+            if cand[1].cost <= best_feasible[1].cost:
+                best_feasible, best_lambda = cand, mid
+        else:
+            lo = mid
+    return (*best_feasible, best_lambda)

@@ -1,0 +1,115 @@
+"""Graph utilities: disjoint set, dominators, transitive reduction.
+
+TPU-native equivalents of the reference's utility headers used by the
+search: include/flexflow/utils/disjoint_set.h, include/flexflow/dominators.h
+(dominator analysis drives the DP's split-node discovery), and
+Graph::transitive_reduction. Pure Python; unit-tested like the reference's
+tests/unit/test_dominators.cc and test_disjoint_set.cc.
+"""
+from __future__ import annotations
+
+from typing import Dict, Hashable, Iterable, List, Optional, Set, Tuple
+
+
+class DisjointSet:
+    """Union-find with path compression (reference: disjoint_set.h)."""
+
+    def __init__(self):
+        self._parent: Dict[Hashable, Hashable] = {}
+
+    def find(self, x: Hashable) -> Hashable:
+        p = self._parent.setdefault(x, x)
+        if p != x:
+            self._parent[x] = self.find(p)
+        return self._parent[x]
+
+    def union(self, a: Hashable, b: Hashable) -> None:
+        ra, rb = self.find(a), self.find(b)
+        if ra != rb:
+            self._parent[ra] = rb
+
+    def same(self, a: Hashable, b: Hashable) -> bool:
+        return self.find(a) == self.find(b)
+
+    def groups(self) -> List[Set[Hashable]]:
+        by_root: Dict[Hashable, Set[Hashable]] = {}
+        for x in self._parent:
+            by_root.setdefault(self.find(x), set()).add(x)
+        return list(by_root.values())
+
+
+def dominators(
+    nodes: Iterable[Hashable], edges: Dict[Hashable, List[Hashable]],
+    source: Hashable,
+) -> Dict[Hashable, Set[Hashable]]:
+    """Dominator sets: dom(n) = nodes on every path source→n (reference:
+    dominators.h; iterative dataflow formulation)."""
+    nodes = list(nodes)
+    preds: Dict[Hashable, List[Hashable]] = {n: [] for n in nodes}
+    for u, vs in edges.items():
+        for v in vs:
+            preds[v].append(u)
+    dom: Dict[Hashable, Set[Hashable]] = {
+        n: ({n} if n == source else set(nodes)) for n in nodes
+    }
+    changed = True
+    while changed:
+        changed = False
+        for n in nodes:
+            if n == source:
+                continue
+            ps = [dom[p] for p in preds[n]]
+            new = ({n} | set.intersection(*ps)) if ps else {n}
+            if new != dom[n]:
+                dom[n] = new
+                changed = True
+    return dom
+
+
+def post_dominators(
+    nodes: Iterable[Hashable], edges: Dict[Hashable, List[Hashable]],
+    sink: Hashable,
+) -> Dict[Hashable, Set[Hashable]]:
+    """reference: dominators.h post_dominators — dominators on the reversed
+    graph."""
+    rev: Dict[Hashable, List[Hashable]] = {n: [] for n in nodes}
+    for u, vs in edges.items():
+        for v in vs:
+            rev[v].append(u)
+    return dominators(nodes, rev, sink)
+
+
+def imm_dominator(dom: Dict[Hashable, Set[Hashable]], n: Hashable,
+                  topo_index: Dict[Hashable, int]) -> Optional[Hashable]:
+    """Immediate dominator: the dominator of n (≠ n) with the highest topo
+    index (reference: dominators.h imm_dominators)."""
+    cands = [d for d in dom[n] if d != n]
+    if not cands:
+        return None
+    return max(cands, key=lambda d: topo_index[d])
+
+
+def transitive_reduction(
+    nodes: List[Hashable], edges: Set[Tuple[Hashable, Hashable]]
+) -> Set[Tuple[Hashable, Hashable]]:
+    """Remove edges implied by longer paths (reference:
+    Graph::transitive_reduction in graph.cc)."""
+    adj: Dict[Hashable, Set[Hashable]] = {n: set() for n in nodes}
+    for u, v in edges:
+        adj[u].add(v)
+
+    def reachable_excluding(u, v) -> bool:
+        # is v reachable from u without the direct edge u->v?
+        stack = [w for w in adj[u] if w != v]
+        seen = set(stack)
+        while stack:
+            w = stack.pop()
+            if w == v:
+                return True
+            for x in adj[w]:
+                if x not in seen:
+                    seen.add(x)
+                    stack.append(x)
+        return False
+
+    return {(u, v) for (u, v) in edges if not reachable_excluding(u, v)}

@@ -1,4 +1,5 @@
 """Regression tests for review findings."""
+import jax
 import numpy as np
 import pytest
 
@@ -110,3 +111,60 @@ def test_moe_trains_with_balance_loss():
     assert float(jnp.sum(jnp.abs(gate_grad))) > 0.0, "lambda_bal has no gradient"
     state, partials = step(model.state, [xv], yv, jax.random.PRNGKey(0))
     assert np.isfinite(float(partials["loss"]))
+
+
+def test_fusion_pass_trains():
+    """--fusion packs chains into OP_FUSED and the model still trains
+    (reference: model.cc apply_fusion)."""
+    from flexflow_tpu.ff_types import OperatorType
+
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    cfg.perform_fusion = True
+    model = FFModel(cfg)
+    x = model.create_tensor((16, 8), DataType.DT_FLOAT)
+    t = model.dense(x, 32, ActiMode.AC_MODE_RELU)
+    t = model.relu(t)
+    t = model.scalar_multiply(t, 0.5)
+    t = model.dense(t, 4)
+    t = model.softmax(t)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    fused_ops = [o for o in model.graph.ops
+                 if o.op_type == OperatorType.OP_FUSED]
+    assert fused_ops, "no fusion happened"
+    assert len(model.graph.ops) < 5
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 8).astype(np.float32)
+    ys = rng.randint(0, 4, (64, 1)).astype(np.int32)
+    pm = model.fit(xs, ys, batch_size=16, epochs=2, verbose=False)
+    assert pm.train_all == 64
+
+    # unfused model computes the same function given the same weights
+    cfg2 = FFConfig()
+    cfg2.batch_size = 16
+    m2 = FFModel(cfg2)
+    x2 = m2.create_tensor((16, 8), DataType.DT_FLOAT)
+    t2 = m2.dense(x2, 32, ActiMode.AC_MODE_RELU)
+    t2 = m2.relu(t2)
+    t2 = m2.scalar_multiply(t2, 0.5)
+    t2 = m2.dense(t2, 4)
+    t2 = m2.softmax(t2)
+    m2.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    # copy fused weights into m2: "step{i}/{name}" maps to the i-th chain
+    # layer's weight {name}
+    (fused_wd,) = model.state.params.values()
+    for key, v in fused_wd.items():
+        step, wname = key.split("/", 1)
+        layer_name = model.layers[int(step[4:])].name
+        old = m2.state.params[layer_name][wname]
+        m2.state.params[layer_name][wname] = jax.device_put(
+            np.asarray(v), old.sharding)
+    out1 = model.predict(xs[:16], batch_size=16)
+    out2 = m2.predict(xs[:16], batch_size=16)
+    np.testing.assert_allclose(out1, out2, atol=1e-5)
