@@ -677,9 +677,19 @@ class FFModel:
             degrees.append(d)
             d *= 2
         budget = cfg.search_budget if cfg.search_budget > 0 else 10
+        xfers = generate_all_pcg_xfers(degrees or [1], cfg)
+        if cfg.substitution_json_path:
+            # reference: --substitution-json declarative rules
+            from .substitution_loader import (
+                load_rule_collection_from_path,
+                rules_to_substitutions,
+            )
+
+            rules = load_rule_collection_from_path(cfg.substitution_json_path)
+            xfers = xfers + rules_to_substitutions(rules)
         gsh = GraphSearchHelper(
             sh,
-            generate_all_pcg_xfers(degrees or [1], cfg),
+            xfers,
             alpha=cfg.search_alpha,
             budget=budget,
         )

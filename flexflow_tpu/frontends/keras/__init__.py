@@ -1,5 +1,5 @@
 """Keras-compatible frontend (reference: python/flexflow/keras/)."""
-from . import callbacks, layers, optimizers  # noqa: F401
+from . import callbacks, datasets, layers, optimizers  # noqa: F401
 from .layers import (  # noqa: F401
     Activation,
     Add,

@@ -1,0 +1,349 @@
+"""Declarative substitution-rule loader (TASO-style JSON).
+
+TPU-native equivalent of reference src/runtime/substitution_loader.cc +
+substitutions/graph_subst_3_v2.json: rules are {srcOp[], dstOp[],
+mappedOutput[]} where each Operator has a `type` string, `input` tensor refs
+{opId, tsId} (opId = -1-k means rule input k), and `para` key/value
+constraints (PM_PARALLEL_DIM / PM_PARALLEL_DEGREE / ...). The same JSON files
+the reference ships load here (--substitution-json).
+
+Application (reference: GraphXfer::run, substitution.cc:596): brute-force
+subgraph match of the source pattern (patterns are tiny), parameter
+constraint checks, then rewrite — dst parallel ops are built from their
+`para` values, dst compute ops inherit the params of their matched source
+op of the same type.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..ff_types import OperatorType
+from ..parallel.parallel_ops import (
+    CombineParams,
+    ReductionParams,
+    ReplicateParams,
+    RepartitionParams,
+)
+from ..pcg.graph import Graph
+from ..pcg.op import PCGOp
+from ..pcg.parallel_tensor import ParallelDim, ParallelTensor
+from .substitution import Substitution, copy_graph, _consumers
+
+# reference op-type strings (substitution_loader.h NLOHMANN enum maps) →
+# our OperatorType. Only types we can execute are mapped; rules touching
+# unmapped types are reported unsupported.
+_TYPE_MAP = {
+    "OP_PARTITION": OperatorType.OP_REPARTITION,
+    "OP_REPARTITION": OperatorType.OP_REPARTITION,
+    "OP_COMBINE": OperatorType.OP_COMBINE,
+    "OP_REPLICATE": OperatorType.OP_REPLICATE,
+    "OP_REDUCE": OperatorType.OP_REDUCTION,
+    "OP_REDUCTION": OperatorType.OP_REDUCTION,
+    "OP_LINEAR": OperatorType.OP_LINEAR,
+    "OP_CONV2D": OperatorType.OP_CONV2D,
+    "OP_RELU": OperatorType.OP_RELU,
+    "OP_SIGMOID": OperatorType.OP_SIGMOID,
+    "OP_TANH": OperatorType.OP_TANH,
+    "OP_SOFTMAX": OperatorType.OP_SOFTMAX,
+    "OP_EW_ADD": OperatorType.OP_EW_ADD,
+    "OP_EW_MUL": OperatorType.OP_EW_MUL,
+    "OP_MATMUL": OperatorType.OP_BATCHMATMUL,
+    "OP_BATCHMATMUL": OperatorType.OP_BATCHMATMUL,
+    "OP_CONCAT": OperatorType.OP_CONCAT,
+    "OP_SPLIT": OperatorType.OP_SPLIT,
+    "OP_RESHAPE": OperatorType.OP_RESHAPE,
+    "OP_TRANSPOSE": OperatorType.OP_TRANSPOSE,
+    "OP_DROPOUT": OperatorType.OP_DROPOUT,
+    "OP_MULTIHEAD_ATTENTION": OperatorType.OP_MULTIHEAD_ATTENTION,
+    "OP_EMBEDDING": OperatorType.OP_EMBEDDING,
+    "OP_POOL2D_MAX": OperatorType.OP_POOL2D,
+    "OP_POOL2D_AVG": OperatorType.OP_POOL2D,
+    "OP_FLAT": OperatorType.OP_FLAT,
+    "OP_NOOP": OperatorType.OP_NOOP,
+}
+
+_PARALLEL_TYPES = {
+    OperatorType.OP_REPARTITION,
+    OperatorType.OP_COMBINE,
+    OperatorType.OP_REPLICATE,
+    OperatorType.OP_REDUCTION,
+}
+
+
+@dataclasses.dataclass
+class TensorRef:
+    """reference: substitution_loader.h Tensor{opId, tsId}"""
+
+    op_id: int  # >=0: pattern op index; <0: rule input (-1 - input_idx)
+    ts_id: int
+
+
+@dataclasses.dataclass
+class OpPattern:
+    """reference: substitution_loader.h Operator"""
+
+    type_str: str
+    op_type: Optional[OperatorType]
+    inputs: List[TensorRef]
+    params: Dict[str, int]
+
+
+@dataclasses.dataclass
+class Rule:
+    """reference: substitution_loader.h Rule"""
+
+    name: str
+    src_ops: List[OpPattern]
+    dst_ops: List[OpPattern]
+    mapped_outputs: List[Tuple[int, int, int, int]]  # (srcOpId, srcTsId, dstOpId, dstTsId)
+
+    @property
+    def supported(self) -> bool:
+        return all(p.op_type is not None for p in self.src_ops + self.dst_ops)
+
+
+def _parse_op(d: dict) -> OpPattern:
+    return OpPattern(
+        type_str=d["type"],
+        op_type=_TYPE_MAP.get(d["type"]),
+        inputs=[TensorRef(t["opId"], t["tsId"]) for t in d.get("input", [])],
+        params={p["key"]: p["value"] for p in d.get("para", [])},
+    )
+
+
+def load_rule_collection(obj: dict) -> List[Rule]:
+    """reference: substitution_loader.cc load_rule_collection"""
+    rules = []
+    for r in obj.get("rule", []):
+        rules.append(
+            Rule(
+                name=r.get("name", f"rule_{len(rules)}"),
+                src_ops=[_parse_op(o) for o in r.get("srcOp", [])],
+                dst_ops=[_parse_op(o) for o in r.get("dstOp", [])],
+                mapped_outputs=[
+                    (m["srcOpId"], m["srcTsId"], m["dstOpId"], m["dstTsId"])
+                    for m in r.get("mappedOutput", [])
+                ],
+            )
+        )
+    return rules
+
+
+def load_rule_collection_from_path(path: str) -> List[Rule]:
+    """reference: substitution_loader.cc load_rule_collection_from_path"""
+    with open(path) as f:
+        return load_rule_collection(json.load(f))
+
+
+# ---------------------------------------------------------------------------
+# rule application
+# ---------------------------------------------------------------------------
+
+def _op_matches(op: PCGOp, pat: OpPattern) -> bool:
+    if op.op_type != pat.op_type:
+        return False
+    # parameter constraints the pattern pins down
+    deg = pat.params.get("PM_PARALLEL_DEGREE")
+    if deg is not None and op.op_type in _PARALLEL_TYPES:
+        actual = getattr(
+            op.params,
+            {
+                OperatorType.OP_REPARTITION: "repartition_degree",
+                OperatorType.OP_COMBINE: "combine_degree",
+                OperatorType.OP_REPLICATE: "replicate_degree",
+                OperatorType.OP_REDUCTION: "reduction_degree",
+            }[op.op_type],
+        )
+        if actual != deg:
+            return False
+    return True
+
+
+def _match_pattern(graph: Graph, rule: Rule) -> Iterator[Dict[int, PCGOp]]:
+    """Yield {pattern op index -> graph op} assignments satisfying types,
+    connectivity, and shared-input constraints."""
+    prod = graph.producers()
+    cands: List[List[PCGOp]] = []
+    for pat in rule.src_ops:
+        cands.append([op for op in graph.ops if _op_matches(op, pat)])
+        if not cands[-1]:
+            return
+    for combo in itertools.product(*cands):
+        if len({op.guid for op in combo}) != len(combo):
+            continue
+        assign = dict(enumerate(combo))
+        # connectivity: pattern input (opId>=0) must be produced by the
+        # assigned op at the right output index; rule inputs (opId<0) must
+        # be consistent across uses
+        ext_inputs: Dict[int, int] = {}  # rule-input id -> tensor guid
+        ok = True
+        for pi, pat in enumerate(rule.src_ops):
+            op = assign[pi]
+            if len(pat.inputs) > len(op.inputs):
+                ok = False
+                break
+            for slot, ref in enumerate(pat.inputs):
+                t = op.inputs[slot]
+                if ref.op_id >= 0:
+                    p = prod.get(t.guid)
+                    if p is None or p[0] is not assign.get(ref.op_id) or p[1] != ref.ts_id:
+                        ok = False
+                        break
+                else:
+                    key = ref.op_id * 1000 + ref.ts_id
+                    if key in ext_inputs and ext_inputs[key] != t.guid:
+                        ok = False
+                        break
+                    ext_inputs[key] = t.guid
+            if not ok:
+                break
+        if ok:
+            yield assign
+
+
+def _build_parallel_params(op_type: OperatorType, para: Dict[str, int]):
+    dim = para.get("PM_PARALLEL_DIM", 0)
+    deg = para.get("PM_PARALLEL_DEGREE", 2)
+    if op_type == OperatorType.OP_REPARTITION:
+        return RepartitionParams(dim, deg)
+    if op_type == OperatorType.OP_COMBINE:
+        return CombineParams(dim, deg)
+    if op_type == OperatorType.OP_REPLICATE:
+        return ReplicateParams(dim, deg)
+    if op_type == OperatorType.OP_REDUCTION:
+        return ReductionParams(dim, deg)
+    raise ValueError(op_type)
+
+
+def apply_rule(graph: Graph, rule: Rule) -> Iterator[Graph]:
+    """Apply one declarative rule everywhere it matches, yielding rewritten
+    graphs (reference: GraphXfer::run building a new graph per match)."""
+    if not rule.supported:
+        return
+    for assign in _match_pattern(graph, rule):
+        g2, tmap = copy_graph(graph)
+        matched = {i: next(o for o in g2.ops if o.name == assign[i].name)
+                   for i in assign}
+        # resolve rule-external inputs from the matched subgraph
+        def resolve_ext(ref: TensorRef) -> ParallelTensor:
+            # pattern semantics: opId = -1 - k is the k-th external input;
+            # find it on any matched op that referenced it
+            for pi, pat in enumerate(rule.src_ops):
+                for slot, r in enumerate(pat.inputs):
+                    if (r.op_id, r.ts_id) == (ref.op_id, ref.ts_id):
+                        return matched[pi].inputs[slot]
+            raise KeyError(ref)
+
+        # build dst ops in order
+        new_ops: List[PCGOp] = []
+        used_src: set = set()
+
+        def params_from_matched(op_type: OperatorType):
+            for pi, pat in enumerate(rule.src_ops):
+                if pat.op_type == op_type and pi not in used_src:
+                    used_src.add(pi)
+                    return matched[pi].params, matched[pi]
+            return None, None
+
+        try:
+            for dpat in rule.dst_ops:
+                ins: List[ParallelTensor] = []
+                for ref in dpat.inputs:
+                    if ref.op_id < 0:
+                        ins.append(resolve_ext(ref))
+                    else:
+                        ins.append(new_ops[ref.op_id].outputs[ref.ts_id])
+                if dpat.op_type in _PARALLEL_TYPES:
+                    params = _build_parallel_params(dpat.op_type, dpat.params)
+                    src_params_op = None
+                else:
+                    params, src_params_op = params_from_matched(dpat.op_type)
+                    if params is None:
+                        raise KeyError(f"no source op to inherit {dpat.op_type}")
+                nop = PCGOp(dpat.op_type, params, ins)
+                # infer output shape
+                outs = _infer_outputs(nop, src_params_op)
+                for t in outs:
+                    t.owner_op = nop
+                    nop.outputs.append(t)
+                if src_params_op is not None:
+                    nop.weights = list(src_params_op.weights)
+                    nop.weight_names = list(src_params_op.weight_names)
+                    nop.weight_tags = list(getattr(src_params_op, "weight_tags", []))
+                    nop.initializers = dict(src_params_op.initializers)
+                new_ops.append(nop)
+        except Exception:
+            continue  # rule not applicable at this site
+
+        # rewire mapped outputs: consumers of src outputs now read dst
+        ok = True
+        for (s_op, s_ts, d_op, d_ts) in rule.mapped_outputs:
+            try:
+                old_t = matched[s_op].outputs[s_ts]
+                new_t = new_ops[d_op].outputs[d_ts]
+            except (KeyError, IndexError):
+                ok = False
+                break
+            for op, i in _consumers(g2, old_t):
+                op.inputs[i] = new_t
+        if not ok:
+            continue
+        # drop matched src ops, add dst ops
+        matched_guids = {m.guid for m in matched.values()}
+        g2.ops = [o for o in g2.ops if o.guid not in matched_guids]
+        for nop in new_ops:
+            g2.add_op(nop)
+        g2._producer_cache = None
+        if g2.check_correctness():
+            yield g2
+
+
+def _infer_outputs(op: PCGOp, src_op: Optional[PCGOp]) -> List[ParallelTensor]:
+    from ..ops.registry import get_op_def, has_op_def
+
+    if op.op_type in _PARALLEL_TYPES:
+        # shape preserved; degree bookkeeping on the affected dim
+        in_t = op.inputs[0]
+        dims = [dataclasses.replace(d) for d in in_t.dims]
+        p = op.params
+        if op.op_type == OperatorType.OP_REPARTITION:
+            dims[p.repartition_dim].degree = p.repartition_degree
+        elif op.op_type == OperatorType.OP_COMBINE:
+            dims[p.combine_dim].degree = 1
+        elif op.op_type == OperatorType.OP_REDUCTION:
+            if dims and dims[0].is_replica_dim:
+                dims = dims[1:]
+        return [ParallelTensor(dims=dims, data_type=in_t.data_type)]
+    d = get_op_def(op.op_type)
+    shapes, dtypes = d.infer(
+        op.params,
+        [t.material_shape() for t in op.inputs],
+        [t.data_type for t in op.inputs],
+    )
+    return [
+        ParallelTensor(
+            dims=[ParallelDim(size=s, degree=1) for s in shape], data_type=dt
+        )
+        for shape, dt in zip(shapes, dtypes)
+    ]
+
+
+def rules_to_substitutions(rules: List[Rule]) -> List[Substitution]:
+    """Wrap loaded rules as Substitution objects for the best-first search
+    (skips unsupported rules, like the reference skips unknown op types)."""
+    subs = []
+    for rule in rules:
+        if not rule.supported:
+            continue
+
+        def make_apply(r):
+            def apply(graph: Graph) -> Iterator[Graph]:
+                yield from apply_rule(graph, r)
+
+            return apply
+
+        subs.append(Substitution(f"json:{rule.name}", make_apply(rule)))
+    return subs

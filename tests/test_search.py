@@ -295,3 +295,35 @@ def test_strategy_export_import_roundtrip(tmp_path, machine):
     assert any(
         d.degree > 1 for op in g2.ops for t in op.outputs for d in t.dims
     )
+
+
+# -- topology-aware network model (reference: src/runtime/network.cc) -------
+
+def test_torus_topology_routing():
+    from flexflow_tpu.search.network import TorusTopology
+
+    t = TorusTopology(dims=(4, 8))
+    assert t.num_chips == 32
+    assert t.coords(0) == (0, 0) and t.coords(9) == (1, 1)
+    assert t.chip((1, 1)) == 9
+    # wraparound: 0 and 24 (coords (0,0),(3,0)) are neighbors on a 4-torus
+    assert t.hop_distance(0, 24) == 1
+    path = t.shortest_path(0, 18)  # (0,0) -> (2,2)
+    assert len(path) - 1 == t.hop_distance(0, 18) == 4
+
+
+def test_topology_model_costs():
+    from flexflow_tpu.search.network import TopologyAwareMachineModel, TorusTopology
+
+    m = TopologyAwareMachineModel(
+        num_nodes=1, workers_per_node=8, topology=TorusTopology(dims=(2, 4))
+    )
+    near = m.xfer_cost(1 << 20, 0, 1)
+    m.reset_congestion()
+    far = m.xfer_cost(1 << 20, 0, 5)  # multi-hop
+    assert far > near
+    m.reset_congestion()
+    a = m.xfer_cost(1 << 20, 0, 1)
+    b = m.xfer_cost(1 << 20, 0, 1)  # same link now congested
+    assert b > a
+    assert m.allreduce_cost(1 << 20, range(8)) > 0

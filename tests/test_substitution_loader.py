@@ -1,0 +1,102 @@
+"""Declarative substitution loader tests (reference:
+tests/unit/test_substitution_loader.cc builds an in-memory rule and checks
+loading; we also parse the reference's shipped rule collection)."""
+import os
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import ActiMode, DataType, FFConfig, FFModel
+from flexflow_tpu.ff_types import OperatorType
+from flexflow_tpu.pcg.lowering import layers_to_pcg
+from flexflow_tpu.search.substitution_loader import (
+    Rule,
+    apply_rule,
+    load_rule_collection,
+    load_rule_collection_from_path,
+    rules_to_substitutions,
+)
+
+REF_JSON = "/root/reference/substitutions/graph_subst_3_v2.json"
+
+
+def make_inmemory_rule():
+    """A partition->combine identity-ish rewrite over a linear op (the
+    in-memory-rule pattern of the reference unit test)."""
+    return {
+        "rule": [
+            {
+                "_t": "Rule",
+                "name": "partition_linear_combine_2",
+                "srcOp": [
+                    {
+                        "_t": "Operator",
+                        "type": "OP_LINEAR",
+                        "input": [{"_t": "Tensor", "opId": -1, "tsId": 0}],
+                        "para": [],
+                    }
+                ],
+                "dstOp": [
+                    {
+                        "_t": "Operator",
+                        "type": "OP_PARTITION",
+                        "input": [{"_t": "Tensor", "opId": -1, "tsId": 0}],
+                        "para": [
+                            {"_t": "Parameter", "key": "PM_PARALLEL_DIM", "value": 0},
+                            {"_t": "Parameter", "key": "PM_PARALLEL_DEGREE", "value": 2},
+                        ],
+                    },
+                    {
+                        "_t": "Operator",
+                        "type": "OP_LINEAR",
+                        "input": [{"_t": "Tensor", "opId": 0, "tsId": 0}],
+                        "para": [],
+                    },
+                    {
+                        "_t": "Operator",
+                        "type": "OP_COMBINE",
+                        "input": [{"_t": "Tensor", "opId": 1, "tsId": 0}],
+                        "para": [
+                            {"_t": "Parameter", "key": "PM_PARALLEL_DIM", "value": 0},
+                            {"_t": "Parameter", "key": "PM_PARALLEL_DEGREE", "value": 2},
+                        ],
+                    },
+                ],
+                "mappedOutput": [
+                    {"_t": "MapOutput", "srcOpId": 0, "srcTsId": 0,
+                     "dstOpId": 2, "dstTsId": 0}
+                ],
+            }
+        ]
+    }
+
+
+def test_inmemory_rule_loads_and_applies():
+    rules = load_rule_collection(make_inmemory_rule())
+    assert len(rules) == 1 and rules[0].supported
+    model = FFModel(FFConfig())
+    x = model.create_tensor((64, 32), DataType.DT_FLOAT)
+    model.dense(x, 16)
+    graph, _ = layers_to_pcg(model.layers)
+    cands = list(apply_rule(graph, rules[0]))
+    assert len(cands) == 1
+    g2 = cands[0]
+    types = [o.op_type for o in g2.topo_order()]
+    assert types == [
+        OperatorType.OP_REPARTITION,
+        OperatorType.OP_LINEAR,
+        OperatorType.OP_COMBINE,
+    ]
+    # the batch dim is now partitioned between partition and combine
+    lin = g2.topo_order()[1]
+    assert lin.inputs[0].dims[0].degree == 2
+
+
+@pytest.mark.skipif(not os.path.exists(REF_JSON), reason="reference not mounted")
+def test_reference_rule_collection_parses():
+    rules = load_rule_collection_from_path(REF_JSON)
+    assert len(rules) > 100
+    supported = [r for r in rules if r.supported]
+    assert len(supported) > 0
+    subs = rules_to_substitutions(supported[:20])
+    assert subs
