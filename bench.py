@@ -55,16 +55,24 @@ def main():
     key = jax.random.PRNGKey(0)
 
     state = model.state
+
+    def sync(st):
+        # Force a device->host round-trip. Under the remote-TPU ("axon")
+        # platform block_until_ready returns before remote execution
+        # finishes, so fetch a scalar that depends on the last step.
+        leaf = jax.tree_util.tree_leaves(st.params)[0]
+        return float(np.asarray(leaf.reshape(-1)[0]))
+
     # warmup (compile)
     for _ in range(3):
         state, partials = step(state, [x], y, key)
-    jax.block_until_ready(state.params)
+    sync(state)
 
     iters = 20
     t0 = time.perf_counter()
     for _ in range(iters):
         state, partials = step(state, [x], y, key)
-    jax.block_until_ready(state.params)
+    sync(state)
     elapsed = time.perf_counter() - t0
 
     n_chips = max(1, len(jax.devices()))
