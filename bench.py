@@ -56,12 +56,20 @@ def main():
 
     state = model.state
 
+    # Force a device->host round-trip that depends on EVERY param leaf.
+    # Under the remote-TPU ("axon") platform block_until_ready returns
+    # before remote execution finishes, and per-leaf fetches each pay a
+    # full tunnel round-trip — so reduce all leaves to one scalar on
+    # device and fetch that once.
+    probe = jax.jit(
+        lambda params: sum(
+            leaf.reshape(-1)[0].astype(jax.numpy.float32)
+            for leaf in jax.tree_util.tree_leaves(params)
+        )
+    )
+
     def sync(st):
-        # Force a device->host round-trip. Under the remote-TPU ("axon")
-        # platform block_until_ready returns before remote execution
-        # finishes, so fetch a scalar that depends on the last step.
-        leaf = jax.tree_util.tree_leaves(st.params)[0]
-        return float(np.asarray(leaf.reshape(-1)[0]))
+        return float(np.asarray(probe(st.params)))
 
     # warmup (compile)
     for _ in range(3):

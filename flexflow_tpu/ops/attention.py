@@ -96,7 +96,17 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
 
     seq_len = q.shape[1]
     use_dropout = params.dropout > 0.0 and ctx.training and ctx.rng is not None
-    if seq_len >= 512 and not use_dropout:
+    # Dispatch on the size of the s_q×s_kv score tensor, not sequence
+    # length alone: XLA's fused softmax beats the flash kernel's chunked
+    # backward while scores fit HBM comfortably (measured 2× at seq 512 /
+    # 134 MB on v5e), but the dense path saves per-layer probs for the
+    # backward, so past a per-chip byte budget the O(seq)-memory kernels
+    # must take over. Shapes here are global; batch/head axes shard over
+    # the mesh, so the per-chip footprint divides by n_devices.
+    b, _, h, _ = q.shape
+    kv_len = k.shape[1]
+    score_bytes = 4 * b * h * seq_len * kv_len // max(1, ctx.n_devices)
+    if score_bytes > 256 * 1024 * 1024 and not use_dropout:
         # Long sequences: O(seq) memory kernels instead of the s×s score
         # tensor — Pallas flash attention on TPU, chunked scan elsewhere
         # (kernels/attention.py; replaces cuDNN MHA's internal algorithm).

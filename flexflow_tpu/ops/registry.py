@@ -100,6 +100,10 @@ class FwdCtx:
     # balancing — reference folds these into gate grads in hand-written
     # backwards, aggregate.cc; we add them to the scalar loss instead).
     aux_losses: Optional[list] = None
+    # Devices in the executing mesh. Ops trace with GLOBAL shapes; kernels
+    # that budget per-chip memory (attention dispatch) divide by this,
+    # since batch/head axes shard across the mesh.
+    n_devices: int = 1
 
     def add_aux_loss(self, value):
         if self.aux_losses is not None:

@@ -144,6 +144,7 @@ class PCGExecutor:
                     seq_length=seq_length,
                     compute_dtype=self.compute_dtype,
                     aux_losses=aux_out,
+                    n_devices=self.mesh.size,
                 )
                 outs = opdef.forward(op.params, params.get(op.name, {}), ins, ctx)
             for t, o in zip(op.outputs, outs):
