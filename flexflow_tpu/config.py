@@ -48,6 +48,11 @@ class FFConfig:
     # fill remaining devices. The Unity search overrides these.
     tensor_parallel_degree: int = 1
     sequence_parallel_degree: int = 1
+    # Pipeline parallelism (TPU addition — the reference's OP_PIPELINE is
+    # enum-only): stages for transformer_blocks stacks, and microbatches
+    # per pipeline flush (0 = one per stage).
+    pipeline_parallel_degree: int = 1
+    num_microbatches: int = 0
     expert_parallel_degree: int = 1
     # bf16 compute with f32 master weights (TPU-native mixed precision).
     # Off by default so numerical-alignment tests match f32 references;

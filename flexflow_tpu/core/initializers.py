@@ -103,5 +103,7 @@ def get_initializer(spec) -> Initializer:
     if isinstance(spec, Initializer):
         return spec
     if isinstance(spec, str):
+        if spec.startswith("constant:"):
+            return ConstantInitializer(float(spec.split(":", 1)[1]))
         return _BY_NAME[spec]
     raise TypeError(f"bad initializer spec {spec!r}")

@@ -316,6 +316,30 @@ class FFModel:
             OperatorType.OP_MULTIHEAD_ATTENTION, p, [query, key, value], name, inits
         )
 
+    def transformer_blocks(
+        self,
+        input: Tensor,
+        hidden_size: int,
+        num_heads: int,
+        num_layers: int,
+        name: str = "",
+    ) -> Tensor:
+        """`num_layers` benchmark encoder blocks (MHA + 2 dense, the
+        reference's transformer.cc:33-45 block) as ONE stacked op whose
+        layer dim shards over the pipe mesh axis — pipeline parallelism as
+        a sharding (TPU addition; reference's OP_PIPELINE is enum-only).
+        Stage count comes from config.pipeline_parallel_degree."""
+        from ..ops.pipeline import BlockStackParams
+
+        p = BlockStackParams(
+            hidden=hidden_size,
+            num_heads=num_heads,
+            num_layers=num_layers,
+            num_stages=max(1, self.config.pipeline_parallel_degree),
+            num_microbatches=self.config.num_microbatches,
+        )
+        return self._add_layer(OperatorType.OP_BLOCK_STACK, p, [input], name)
+
     # elementwise binary
     def _binary(self, t: OperatorType, x: Tensor, y: Tensor, name: str) -> Tensor:
         return self._add_layer(t, ElementBinaryParams(op_type=t), [x, y], name)
@@ -441,6 +465,39 @@ class FFModel:
         return self._add_layer(
             OperatorType.OP_CAST, CastParams(dtype=_to_dt(dtype)), [input], name
         )
+
+    def squeeze(self, input: Tensor, axes=(), name="") -> Tensor:
+        from ..ops.tensor_ops import SqueezeParams
+
+        return self._add_layer(
+            OperatorType.OP_SQUEEZE, SqueezeParams(tuple(axes)), [input], name
+        )
+
+    def unsqueeze(self, input: Tensor, axes, name="") -> Tensor:
+        from ..ops.tensor_ops import UnsqueezeParams
+
+        return self._add_layer(
+            OperatorType.OP_UNSQUEEZE, UnsqueezeParams(tuple(axes)), [input], name
+        )
+
+    def where(self, cond: Tensor, x: Tensor, y: Tensor, name="") -> Tensor:
+        from ..ops.tensor_ops import WhereParams
+
+        return self._add_layer(
+            OperatorType.OP_WHERE, WhereParams(), [cond, x, y], name
+        )
+
+    def resize(self, input: Tensor, out_shape, name="") -> Tensor:
+        from ..ops.tensor_ops import ResizeParams
+
+        return self._add_layer(
+            OperatorType.OP_RESIZE, ResizeParams(tuple(out_shape)), [input], name
+        )
+
+    def prelu(self, input: Tensor, name="") -> Tensor:
+        from ..ops.elementwise import PReluParams
+
+        return self._add_layer(OperatorType.OP_PRELU, PReluParams(), [input], name)
 
     def dropout(self, input: Tensor, rate: float = 0.5, seed: int = 0, name="") -> Tensor:
         return self._add_layer(
@@ -599,12 +656,16 @@ class FFModel:
             tp = max(1, self.config.tensor_parallel_degree)
             sp = max(1, self.config.sequence_parallel_degree)
             ep = max(1, self.config.expert_parallel_degree)
-            dp = max(1, ndev // (tp * sp * ep))
-            mesh = build_mesh({"data": dp, "model": tp, "seq": sp, "expert": ep})
+            pp = max(1, self.config.pipeline_parallel_degree)
+            dp = max(1, ndev // (tp * sp * ep * pp))
+            mesh = build_mesh(
+                {"data": dp, "model": tp, "seq": sp, "expert": ep, "pipe": pp}
+            )
             strategies.apply_data_parallel(self.graph, dp, axis_idx=0)
             strategies.apply_tensor_parallel(self.graph, tp, axis_idx=1)
             strategies.apply_sequence_parallel(self.graph, sp, axis_idx=2)
             strategies.apply_expert_parallel(self.graph, ep, axis_idx=3)
+            strategies.apply_pipeline_parallel(self.graph, pp, axis_idx=4)
 
         # 3. Label tensor matched to final op's sharding (model.cc:3054)
         logits_pt = self.graph.output_tensors()[-1]

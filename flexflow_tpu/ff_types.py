@@ -220,6 +220,10 @@ class OperatorType(enum.IntEnum):
     OP_SCALAR_SUB = 1103
     OP_SCALAR_FLOOR_DIV = 1104
     OP_SCALAR_TRUE_DIV = 1105
+    # TPU addition: stacked homogeneous transformer blocks executed as a
+    # GPipe pipeline over the "pipe" mesh axis (the reference's OP_PIPELINE
+    # is enum-only, ffconst.h:158 — no implementation exists there).
+    OP_BLOCK_STACK = 1107
     # Parallel ops (reference: ffconst.h:152-160)
     OP_REPARTITION = 1110
     OP_COMBINE = 1111

@@ -237,3 +237,34 @@ class ONNXModel:
 
     def handle_Identity(self, ff, node, env):
         return ff.identity(env[node.input[0]])
+
+    def handle_Squeeze(self, ff, node, env):
+        a = _attrs(node)
+        axes = a.get("axes")
+        if axes is None and len(node.input) > 1:  # opset 13: axes as input
+            axes = self.initializers.get(node.input[1], [])
+        return ff.squeeze(env[node.input[0]], [int(x) for x in (axes or [])])
+
+    def handle_Unsqueeze(self, ff, node, env):
+        a = _attrs(node)
+        axes = a.get("axes")
+        if axes is None and len(node.input) > 1:
+            axes = self.initializers.get(node.input[1])
+        assert axes is not None, "Unsqueeze needs static axes"
+        return ff.unsqueeze(env[node.input[0]], [int(x) for x in axes])
+
+    def handle_Where(self, ff, node, env):
+        return ff.where(env[node.input[0]], env[node.input[1]], env[node.input[2]])
+
+    def handle_Resize(self, ff, node, env):
+        x = env[node.input[0]]
+        sizes = self.initializers.get(node.input[3]) if len(node.input) > 3 else None
+        assert sizes is not None, "Resize supports static `sizes` only"
+        return ff.resize(x, [int(s) for s in sizes])
+
+    def handle_PRelu(self, ff, node, env):
+        out = ff.prelu(env[node.input[0]])
+        slope = self.initializers.get(node.input[1])
+        if slope is not None:
+            self._weight_loads.append((ff.layers[-1], [np.ravel(slope)]))
+        return out

@@ -37,6 +37,14 @@ def build_transformer(
     input_t = model.create_tensor(
         (batch_size, seq_length, hidden_size), DataType.DT_FLOAT, name="tokens"
     )
+    if model.config.pipeline_parallel_degree > 1:
+        # pipeline-parallel path: all blocks as one stacked op whose layer
+        # dim shards over the pipe mesh axis (ops/pipeline.py); numerically
+        # identical to the per-layer graph below
+        t = model.transformer_blocks(
+            input_t, hidden_size, num_heads, num_layers, name="encoder_stack"
+        )
+        return input_t, t
     t = input_t
     kdim = hidden_size // num_heads
     for _ in range(num_layers):

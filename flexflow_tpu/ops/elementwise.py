@@ -133,3 +133,41 @@ for _t in _BINARY_FNS:
         _t, f"ElementBinary_{_t.name}", infer=_binary_infer, forward=_binary_forward,
         num_inputs=2,
     )
+
+
+# -- PReLU (learnable per-channel negative slope; ONNX frontend op) ---------
+import dataclasses as _dc
+
+from .registry import WeightSpec
+
+
+@_dc.dataclass(frozen=True)
+class PReluParams:
+    pass
+
+
+def _prelu_channels(shape):
+    # channel dim: NCHW conv layout for 4-D (conv2d.py is NCHW), else last
+    return shape[1] if len(shape) == 4 else shape[-1]
+
+
+def _prelu_weights(params, in_shapes, in_dtypes):
+    (s,) = in_shapes
+    return [WeightSpec("alpha", (_prelu_channels(s),), in_dtypes[0], "constant:0.25")]
+
+
+def _prelu_forward(params, weights, inputs, ctx):
+    (x,) = inputs
+    a = weights["alpha"].astype(x.dtype)
+    if x.ndim == 4:  # broadcast per-channel over NCHW spatial dims
+        a = a.reshape(1, -1, 1, 1)
+    return [jnp.where(x >= 0, x, a * x)]
+
+
+register_op(
+    OperatorType.OP_PRELU,
+    "PReLU",
+    infer=lambda p, s, dt: ([s[0]], [dt[0]]),
+    weights=_prelu_weights,
+    forward=_prelu_forward,
+)

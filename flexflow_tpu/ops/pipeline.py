@@ -1,0 +1,135 @@
+"""Transformer block-stack operator with pipeline-parallel execution.
+
+This is the compute-op face of pipeline parallelism (parallel/pipeline.py).
+A single PCG node holds ALL `num_layers` encoder blocks with their weights
+STACKED along a leading layer dim; that dim shards over the "pipe" mesh
+axis, turning stage placement into an ordinary sharding decision — the
+TPU-native answer to the reference's unimplemented OP_PIPELINE
+(ffconst.h:158, task IDs model.h:190-192, no source file; SURVEY §2.3).
+
+The block replicates the flagship benchmark block exactly
+(reference: examples/cpp/Transformer/transformer.cc:33-45
+create_attention_encoder — MHA with output bias, then two bias-free dense
+layers, ReLU between, no residual/layernorm), so a pipelined model is
+numerically identical to the same model built layer-by-layer.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..ff_types import DataType, OperatorType
+from .registry import WeightSpec, register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockStackParams:
+    hidden: int
+    num_heads: int
+    num_layers: int
+    num_stages: int = 1  # pipeline degree; 1 = plain sequential scan
+    num_microbatches: int = 0  # 0 -> auto (= num_stages)
+    data_type: DataType = DataType.DT_FLOAT
+
+    @property
+    def head_dim(self):
+        return self.hidden // self.num_heads
+
+
+def _infer(params: BlockStackParams, in_shapes, in_dtypes):
+    (s,) = in_shapes
+    return [tuple(s)], [in_dtypes[0]]
+
+
+def _weights(params: BlockStackParams, in_shapes, in_dtypes):
+    L, e, h, d = params.num_layers, params.hidden, params.num_heads, params.head_dim
+    dt = params.data_type
+    # leading dim of every weight = layer index; tag "pipeline_stage" so
+    # apply_pipeline_parallel shards it over the pipe axis
+    stk = ("pipeline_stage",)
+    return [
+        WeightSpec("wq", (L, e, h, d), dt, "glorot_uniform", stk + ("", "head", "")),
+        WeightSpec("wk", (L, e, h, d), dt, "glorot_uniform", stk + ("", "head", "")),
+        WeightSpec("wv", (L, e, h, d), dt, "glorot_uniform", stk + ("", "head", "")),
+        WeightSpec("wo", (L, h, d, e), dt, "glorot_uniform", stk + ("head", "", "")),
+        WeightSpec("bias_o", (L, e), dt, "zero", stk + ("",)),
+        WeightSpec("w1", (L, e, e), dt, "glorot_uniform", stk + ("", "")),
+        WeightSpec("w2", (L, e, e), dt, "glorot_uniform", stk + ("", "")),
+    ]
+
+
+def _encoder_block(w, x, *, head_dim: int, compute_dtype):
+    """One benchmark encoder block on per-layer weights `w` (no layer dim).
+    Math matches ops/attention.py's dense path + two Dense ops bit-for-bit."""
+    xc = x.astype(compute_dtype) if compute_dtype is not None else x
+    wq, wk, wv, wo = w["wq"], w["wk"], w["wv"], w["wo"]
+    w1, w2 = w["w1"], w["w2"]
+    if compute_dtype is not None:
+        wq, wk, wv, wo, w1, w2 = (
+            t.astype(compute_dtype) for t in (wq, wk, wv, wo, w1, w2)
+        )
+    f32 = jnp.float32
+    q = jnp.einsum("bse,ehd->bshd", xc, wq, preferred_element_type=f32).astype(xc.dtype)
+    k = jnp.einsum("bse,ehd->bshd", xc, wk, preferred_element_type=f32).astype(xc.dtype)
+    v = jnp.einsum("bse,ehd->bshd", xc, wv, preferred_element_type=f32).astype(xc.dtype)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, f32))
+    scores = jnp.einsum("bshd,bthd->bhst", q, k, preferred_element_type=f32) * scale
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    attn = jnp.einsum("bhst,bthd->bshd", probs, v, preferred_element_type=f32)
+    attn = attn.astype(q.dtype)
+    out = jnp.einsum("bshd,hde->bse", attn, wo, preferred_element_type=f32)
+    out = out.astype(x.dtype) + w["bias_o"].astype(x.dtype)
+    h1 = jnp.dot(
+        out.astype(xc.dtype) if compute_dtype is not None else out,
+        w1,
+        preferred_element_type=f32,
+    ).astype(x.dtype)
+    h1 = jax.nn.relu(h1)
+    h2 = jnp.dot(
+        h1.astype(xc.dtype) if compute_dtype is not None else h1,
+        w2,
+        preferred_element_type=f32,
+    ).astype(x.dtype)
+    return h2
+
+
+def _forward(params: BlockStackParams, weights, inputs, ctx):
+    from ..parallel.pipeline import gpipe_spmd, scan_blocks
+
+    (x,) = inputs
+    block = functools.partial(
+        _encoder_block, head_dim=params.head_dim, compute_dtype=ctx.compute_dtype
+    )
+    mesh = ctx.mesh
+    pp = params.num_stages
+    if (
+        pp > 1
+        and mesh is not None
+        and "pipe" in mesh.axis_names
+        and mesh.shape["pipe"] == pp
+    ):
+        nm = params.num_microbatches or pp
+        return [
+            gpipe_spmd(
+                block,
+                weights,
+                x,
+                n_stages=pp,
+                n_micro=nm,
+                mesh=mesh,
+            )
+        ]
+    return [scan_blocks(block, weights, x)]
+
+
+register_op(
+    OperatorType.OP_BLOCK_STACK,
+    "TransformerBlockStack",
+    infer=_infer,
+    weights=_weights,
+    forward=_forward,
+    num_inputs=1,
+)

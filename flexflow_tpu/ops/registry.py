@@ -104,6 +104,9 @@ class FwdCtx:
     # that budget per-chip memory (attention dispatch) divide by this,
     # since batch/head axes shard across the mesh.
     n_devices: int = 1
+    # The executing jax.sharding.Mesh, for ops that drop into shard_map
+    # (pipeline block stack, ring attention).
+    mesh: Optional[object] = None
 
     def add_aux_loss(self, value):
         if self.aux_losses is not None:
@@ -124,6 +127,7 @@ def ensure_ops_loaded():
         lstm,
         moe,
         normalization,
+        pipeline,
         pool2d,
         reduce,
         softmax,

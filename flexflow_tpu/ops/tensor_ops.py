@@ -240,3 +240,91 @@ register_op(
     infer=lambda p, s, dt: ([s[0]], [dt[0]]),
     forward=lambda p, w, x, ctx: [x[0]],
 )
+
+
+# -- Squeeze / Unsqueeze (ONNX frontend ops; reference handles them in
+# python/flexflow/onnx/model.py via reshape) ---------------------------------
+@dataclasses.dataclass(frozen=True)
+class SqueezeParams:
+    axes: Tuple[int, ...] = ()
+
+
+def _squeeze_infer(params, in_shapes, in_dtypes):
+    (s,) = in_shapes
+    axes = params.axes or tuple(i for i, d in enumerate(s) if d == 1)
+    axes = tuple(a % len(s) for a in axes)  # ONNX allows negative axes
+    out = tuple(d for i, d in enumerate(s) if i not in axes)
+    return [out], [in_dtypes[0]]
+
+
+register_op(
+    OperatorType.OP_SQUEEZE,
+    "Squeeze",
+    infer=_squeeze_infer,
+    forward=lambda p, w, x, ctx: [
+        jnp.reshape(x[0], _squeeze_infer(p, [x[0].shape], [None])[0][0])
+    ],
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class UnsqueezeParams:
+    axes: Tuple[int, ...]
+
+
+def _unsqueeze_infer(params, in_shapes, in_dtypes):
+    (s,) = in_shapes
+    out = list(s)
+    for a in sorted(params.axes):
+        out.insert(a if a >= 0 else len(out) + a + 1, 1)
+    return [tuple(out)], [in_dtypes[0]]
+
+
+register_op(
+    OperatorType.OP_UNSQUEEZE,
+    "Unsqueeze",
+    infer=_unsqueeze_infer,
+    forward=lambda p, w, x, ctx: [
+        jnp.reshape(x[0], _unsqueeze_infer(p, [x[0].shape], [None])[0][0])
+    ],
+)
+
+
+# -- Where (ONNX select) -----------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class WhereParams:
+    pass
+
+
+def _where_infer(params, in_shapes, in_dtypes):
+    out = tuple(np.broadcast_shapes(*in_shapes))
+    return [out], [in_dtypes[1]]
+
+
+register_op(
+    OperatorType.OP_WHERE,
+    "Where",
+    infer=_where_infer,
+    forward=lambda p, w, x, ctx: [jnp.where(x[0].astype(bool), x[1], x[2])],
+    num_inputs=3,
+)
+
+
+# -- Resize (nearest; ONNX Resize/Upsample) ---------------------------------
+@dataclasses.dataclass(frozen=True)
+class ResizeParams:
+    out_shape: Tuple[int, ...]  # full output shape
+
+
+def _resize_forward(p, w, x, ctx):
+    import jax
+
+    return [jax.image.resize(x[0], p.out_shape, method="nearest")]
+
+
+register_op(
+    OperatorType.OP_RESIZE,
+    "Resize",
+    infer=lambda p, s, dt: ([tuple(p.out_shape)], [dt[0]]),
+    forward=_resize_forward,
+)

@@ -145,6 +145,7 @@ class PCGExecutor:
                     compute_dtype=self.compute_dtype,
                     aux_losses=aux_out,
                     n_devices=self.mesh.size,
+                    mesh=self.mesh,
                 )
                 outs = opdef.forward(op.params, params.get(op.name, {}), ins, ctx)
             for t, o in zip(op.outputs, outs):

@@ -1,0 +1,134 @@
+"""GPipe-style SPMD pipeline parallelism over a mesh axis.
+
+The reference DECLARES pipeline parallelism but never implements it:
+`OP_PIPELINE` exists only as an enum (ffconst.h:158) and task IDs
+(model.h:190-192) with no source file (SURVEY §2.3). This module supplies
+the capability TPU-natively, the way XLA wants it expressed: every device
+runs the SAME program (SPMD), stage placement is a sharding of the stacked
+layer weights over a "pipe" mesh axis, and activations move between stages
+with `lax.ppermute` hops over the ICI ring.
+
+Schedule: GPipe. The local batch is split into `n_micro` microbatches; for
+`n_micro + n_stages - 1` ticks, each device (stage) computes its layer
+group on the activation it holds, then the ring rotates activations one hop
+so stage s+1 sees stage s's output next tick. Stage 0 injects a fresh
+microbatch each of the first `n_micro` ticks; the last stage collects
+finished microbatches. The whole schedule is a `lax.scan`, so jax.grad
+differentiates it — backward is automatically the reverse pipeline
+(ppermute transposes to the opposite rotation).
+
+Bubble fraction is (n_stages-1)/(n_micro+n_stages-1), the GPipe figure;
+raise num_microbatches to amortize.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def scan_blocks(block_fn: Callable, stacked_params, x):
+    """Degenerate (single-stage) path: run all stacked layers sequentially.
+    `stacked_params` leaves have a leading num_layers dim."""
+
+    def body(h, layer_w):
+        return block_fn(layer_w, h), None
+
+    out, _ = lax.scan(body, x, stacked_params)
+    return out
+
+
+def _stage_apply(block_fn: Callable, local_params, h):
+    """Apply this stage's layer group (leaves have leading layers/stage dim)."""
+
+    def body(c, layer_w):
+        return block_fn(layer_w, c), None
+
+    out, _ = lax.scan(body, h, local_params)
+    return out
+
+
+def gpipe_spmd(
+    block_fn: Callable,
+    stacked_params,
+    x,
+    *,
+    n_stages: int,
+    n_micro: int,
+    mesh,
+    axis_name: str = "pipe",
+    data_axis: str = "data",
+):
+    """Run `n_stages * layers_per_stage` stacked blocks as a GPipe pipeline.
+
+    stacked_params: pytree whose leaves have leading dim num_layers,
+    sharded over `axis_name`. x: (batch, ...) activation, sharded over
+    `data_axis` on dim 0. Returns the same-shaped output, replicated over
+    the pipe axis (every stage ends up with the full result via psum of a
+    buffer that is zero off the last stage).
+    """
+    num_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    assert num_layers % n_stages == 0, (
+        f"{num_layers} layers not divisible into {n_stages} stages"
+    )
+    dp = mesh.shape.get(data_axis, 1)
+    b_local = x.shape[0] // dp
+    # clamp the schedule to what the local batch can supply: the largest
+    # divisor of b_local not exceeding the requested microbatch count
+    n_micro = max(1, min(n_micro, b_local))
+    while b_local % n_micro:
+        n_micro -= 1
+
+    def pipelined(local_params, x_local):
+        stage = lax.axis_index(axis_name)
+        mb = x_local.shape[0] // n_micro
+        mbs = x_local.reshape((n_micro, mb) + x_local.shape[1:])
+        ticks = n_micro + n_stages - 1
+        # carries become pipe-varying inside the loop (ppermute / stage
+        # predicates), so the initial zeros must carry that vma type too
+        zero_x = lax.pcast(jnp.zeros_like(mbs[0]), (axis_name,), to="varying")
+        zero_out = lax.pcast(jnp.zeros_like(mbs), (axis_name,), to="varying")
+        perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+        def tick(carry, t):
+            x_cur, outbuf = carry
+            inj = lax.dynamic_index_in_dim(
+                mbs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+            )
+            x_in = jnp.where(stage == 0, inj, x_cur)
+            y = _stage_apply(block_fn, local_params, x_in)
+            out_idx = t - (n_stages - 1)
+            oi = jnp.clip(out_idx, 0, n_micro - 1)
+            old = lax.dynamic_index_in_dim(outbuf, oi, 0, keepdims=False)
+            valid = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+            outbuf = lax.dynamic_update_index_in_dim(
+                outbuf, jnp.where(valid, y, old), oi, 0
+            )
+            x_next = lax.ppermute(y, axis_name, perm)
+            return (x_next, outbuf), None
+
+        (_, outbuf), _ = lax.scan(tick, (zero_x, zero_out), jnp.arange(ticks))
+        # off-last-stage buffers are all zeros -> psum replicates the result
+        out = lax.psum(outbuf, axis_name)
+        return out.reshape(x_local.shape)
+
+    param_specs = jax.tree_util.tree_map(
+        lambda l: P(*((axis_name,) + (None,) * (l.ndim - 1))), stacked_params
+    )
+    x_spec = P(*((data_axis,) + (None,) * (x.ndim - 1)))
+    fn = shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+    )
+    return fn(stacked_params, x)

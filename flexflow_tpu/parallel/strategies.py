@@ -140,6 +140,23 @@ def apply_expert_parallel(graph: Graph, degree: int, axis_idx: int) -> None:
                     t.dims[0].parallel_idx = axis_idx
 
 
+def apply_pipeline_parallel(graph: Graph, degree: int, axis_idx: int) -> None:
+    """Pipeline parallelism: shard the leading (layer) dim of block-stack
+    weights over the pipe mesh axis — stage placement AS a sharding.
+
+    No reference equivalent (OP_PIPELINE is enum-only there, ffconst.h:158);
+    execution is parallel/pipeline.py's GPipe schedule."""
+    if degree <= 1:
+        return
+    for op in graph.ops:
+        for wpt, tags in zip(op.weights, getattr(op, "weight_tags", [])):
+            for i, tag in enumerate(tags):
+                if tag == "pipeline_stage" and wpt.dims[i].size % degree == 0:
+                    wpt.dims[i].degree = degree
+                    wpt.dims[i].parallel_idx = axis_idx
+                    break
+
+
 def apply_sequence_parallel(
     graph: Graph, degree: int, axis_idx: int, seq_dim: int = 1
 ) -> None:

@@ -1,0 +1,81 @@
+"""Forward checks for the ONNX-surface ops added for importer coverage:
+Squeeze/Unsqueeze (incl. negative axes), Where, PReLU (NCHW per-channel
+slope), Resize. Reference handles these inside its ONNX importer
+(python/flexflow/onnx/model.py) — here they are first-class registry ops."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import DataType, FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+
+
+def _run(build, x_arrays):
+    cfg = FFConfig()
+    cfg.batch_size = x_arrays[0].shape[0]
+    model = FFModel(cfg)
+    ins = build(model)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR],
+    )
+    ex = model.executor
+    fwd = ex.build_forward()
+    bx = [ex.shard_batch(pt, a) for pt, a in zip(ex.input_pts, x_arrays)]
+    return np.asarray(fwd(model.state.params, bx)), model
+
+
+def test_squeeze_negative_axis_and_unsqueeze():
+    x = np.random.RandomState(0).randn(4, 3, 1).astype(np.float32)
+
+    def build(m):
+        t = m.create_tensor((4, 3, 1))
+        t = m.squeeze(t, [-1])        # (4, 3)
+        t = m.unsqueeze(t, [2])       # (4, 3, 1)
+        t = m.squeeze(t)              # no axes: drop all 1-dims -> (4, 3)
+        return t
+
+    out, _ = _run(build, [x])
+    assert out.shape == (4, 3)
+    np.testing.assert_allclose(out, x[:, :, 0])
+
+
+def test_where():
+    rng = np.random.RandomState(1)
+    c = (rng.rand(4, 5) > 0.5).astype(np.float32)
+    a = rng.randn(4, 5).astype(np.float32)
+    b = rng.randn(4, 5).astype(np.float32)
+
+    def build(m):
+        tc = m.create_tensor((4, 5))
+        ta = m.create_tensor((4, 5))
+        tb = m.create_tensor((4, 5))
+        return m.where(tc, ta, tb)
+
+    out, _ = _run(build, [c, a, b])
+    np.testing.assert_allclose(out, np.where(c > 0, a, b))
+
+
+def test_prelu_nchw_per_channel():
+    x = np.random.RandomState(2).randn(2, 3, 4, 4).astype(np.float32)
+
+    def build(m):
+        t = m.create_tensor((2, 3, 4, 4))
+        return m.prelu(t)
+
+    out, model = _run(build, [x])
+    # default slope 0.25, per NCHW channel (dim 1)
+    (wd,) = model.state.params.values()
+    assert wd["alpha"].shape == (3,)
+    np.testing.assert_allclose(out, np.where(x >= 0, x, 0.25 * x), rtol=1e-6)
+
+
+def test_resize_nearest():
+    x = np.arange(2 * 1 * 2 * 2, dtype=np.float32).reshape(2, 1, 2, 2)
+
+    def build(m):
+        t = m.create_tensor((2, 1, 2, 2))
+        return m.resize(t, (2, 1, 4, 4))
+
+    out, _ = _run(build, [x])
+    assert out.shape == (2, 1, 4, 4)
+    np.testing.assert_allclose(out[:, :, ::2, ::2], x)
