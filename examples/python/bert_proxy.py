@@ -1,0 +1,34 @@
+"""BERT-Base-shaped encoder stack
+(reference: examples/python/native/bert_proxy_native.py).
+
+Usage: python examples/python/bert_proxy.py -b 8
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models.misc import build_bert_proxy
+
+
+def main():
+    ffconfig = FFConfig()
+    model = FFModel(ffconfig)
+    seq, hidden = 512, 768
+    build_bert_proxy(model, ffconfig.batch_size, seq_length=seq, hidden_size=hidden)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR],
+    )
+    n = ffconfig.batch_size * 2
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, seq, hidden).astype(np.float32)
+    y = rng.randn(n, seq, hidden).astype(np.float32)
+    model.fit(x, y, epochs=ffconfig.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""CANDLE-Uno drug-response regressor — per-feature towers + deep head
+(reference: examples/cpp/candle_uno/candle_uno.cc;
+scripts/osdi22ae/candle_uno.sh).
+
+Usage: python examples/python/candle_uno.py -b 64
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models.misc import build_candle_uno
+
+
+def main():
+    ffconfig = FFConfig()
+    model = FFModel(ffconfig)
+    shapes = (942, 5270, 2048)
+    build_candle_uno(model, ffconfig.batch_size, feature_shapes=shapes)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.001),
+        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR],
+    )
+    n = ffconfig.batch_size * 2
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(n, s).astype(np.float32) for s in shapes]
+    y = rng.randn(n, 1).astype(np.float32)
+    model.fit(xs, y, epochs=ffconfig.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""InceptionV3 training example
+(reference: examples/cpp/InceptionV3/inception.cc;
+scripts/osdi22ae/inception.sh: budget 20 vs data parallel).
+
+Usage: python examples/python/inception.py -b 8 [-e 1] [--budget 20]
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models.inception import build_inception_v3
+
+
+def main():
+    ffconfig = FFConfig()
+    model = FFModel(ffconfig)
+    build_inception_v3(model, ffconfig.batch_size, num_classes=1000)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY],
+    )
+    n = ffconfig.batch_size * 2
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 3, 299, 299).astype(np.float32)
+    y = rng.randint(0, 1000, (n, 1)).astype(np.int32)
+    model.fit(x, y, epochs=ffconfig.epochs)
+
+
+if __name__ == "__main__":
+    main()

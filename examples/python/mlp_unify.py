@@ -1,0 +1,35 @@
+"""Two-tower MLP — the Unity paper's MLP benchmark
+(reference: examples/cpp/MLP_Unify/mlp.cc; scripts/osdi22ae/mlp.sh:
+budget 20 vs data parallel).
+
+Usage: python examples/python/mlp_unify.py -b 64 [--budget 20]
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models.misc import build_mlp_unify
+
+
+def main():
+    ffconfig = FFConfig()
+    model = FFModel(ffconfig)
+    build_mlp_unify(model, ffconfig.batch_size)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY],
+    )
+    n = ffconfig.batch_size * 4
+    rng = np.random.RandomState(0)
+    x1 = rng.randn(n, 3072).astype(np.float32)
+    x2 = rng.randn(n, 3072).astype(np.float32)
+    y = rng.randint(0, 8192, (n, 1)).astype(np.int32)
+    model.fit([x1, x2], y, epochs=ffconfig.epochs)
+
+
+if __name__ == "__main__":
+    main()

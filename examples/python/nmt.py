@@ -1,0 +1,37 @@
+"""LSTM seq2seq NMT — encoder/decoder with teacher forcing
+(reference: nmt/ standalone CUDA implementation, SURVEY §1 layer 12).
+
+Usage: python examples/python/nmt.py -b 32
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models.nmt import build_nmt
+
+
+def main():
+    ffconfig = FFConfig()
+    model = FFModel(ffconfig)
+    src_vocab = tgt_vocab = 8000
+    src_len = tgt_len = 32
+    build_nmt(model, ffconfig.batch_size, src_vocab=src_vocab,
+              tgt_vocab=tgt_vocab, src_len=src_len, tgt_len=tgt_len)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.1),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    n = ffconfig.batch_size * 4
+    rng = np.random.RandomState(0)
+    src = rng.randint(0, src_vocab, (n, src_len)).astype(np.int32)
+    tgt = rng.randint(0, tgt_vocab, (n, tgt_len)).astype(np.int32)
+    labels = rng.randint(0, tgt_vocab, (n, tgt_len, 1)).astype(np.int32)
+    model.fit([src, tgt], labels, epochs=ffconfig.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""ResNet-50 / ResNeXt-50 training example
+(reference: examples/cpp/ResNet/resnet.cc, examples/cpp/resnext50/resnext.cc;
+OSDI'22 artifact scripts/osdi22ae/resnext-50.sh: batch 16, budget 20).
+
+Usage:
+  python examples/python/resnet.py -b 16            # ResNet-50, data parallel
+  python examples/python/resnet.py -b 16 --resnext  # ResNeXt-50
+  python examples/python/resnet.py -b 16 --budget 20  # Unity search
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models.resnet import build_resnet, build_resnext50
+
+
+def main():
+    ffconfig = FFConfig()
+    use_resnext = "--resnext" in sys.argv
+    model = FFModel(ffconfig)
+    h = w = 64  # reduced spatial size for the synthetic-data demo
+    if use_resnext:
+        build_resnext50(model, ffconfig.batch_size, num_classes=10, height=h, width=w)
+    else:
+        build_resnet(model, ffconfig.batch_size, num_classes=10, height=h, width=w)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY],
+    )
+    n = ffconfig.batch_size * 4
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 3, h, w).astype(np.float32)
+    y = rng.randint(0, 10, (n, 1)).astype(np.int32)
+    model.fit(x, y, epochs=ffconfig.epochs)
+
+
+if __name__ == "__main__":
+    main()

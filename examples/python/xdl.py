@@ -1,0 +1,36 @@
+"""XDL ads-ranking model — embeddings + MLP
+(reference: examples/cpp/XDL/xdl.cc; scripts/osdi22ae/xdl.sh).
+
+Usage: python examples/python/xdl.py -b 64
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models.misc import build_xdl
+
+
+def main():
+    ffconfig = FFConfig()
+    model = FFModel(ffconfig)
+    n_sparse = 4
+    vocab = 100000
+    build_xdl(model, ffconfig.batch_size, embedding_sizes=(vocab,) * n_sparse)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY],
+    )
+    n = ffconfig.batch_size * 4
+    rng = np.random.RandomState(0)
+    sparse = [rng.randint(0, vocab, (n, 1)).astype(np.int32) for _ in range(n_sparse)]
+    dense = rng.randn(n, 16).astype(np.float32)
+    y = rng.randint(0, 2, (n, 1)).astype(np.int32)
+    model.fit(sparse + [dense], y, epochs=ffconfig.epochs)
+
+
+if __name__ == "__main__":
+    main()

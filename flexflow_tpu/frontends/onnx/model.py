@@ -242,7 +242,11 @@ class ONNXModel:
         a = _attrs(node)
         axes = a.get("axes")
         if axes is None and len(node.input) > 1:  # opset 13: axes as input
-            axes = self.initializers.get(node.input[1], [])
+            axes = self.initializers.get(node.input[1])
+            assert axes is not None, (
+                "Squeeze axes input must be a graph initializer (static)"
+            )
+        # no axes anywhere = legal ONNX: squeeze every unit dim
         return ff.squeeze(env[node.input[0]], [int(x) for x in (axes or [])])
 
     def handle_Unsqueeze(self, ff, node, env):
@@ -266,5 +270,9 @@ class ONNXModel:
         out = ff.prelu(env[node.input[0]])
         slope = self.initializers.get(node.input[1])
         if slope is not None:
-            self._weight_loads.append((ff.layers[-1], [np.ravel(slope)]))
+            # PyTorch exports default to a scalar slope; our alpha weight is
+            # per-channel — broadcast up to its declared shape
+            (alpha_decl,) = ff.layers[-1].weights
+            arr = np.broadcast_to(np.ravel(slope), tuple(alpha_decl.dims))
+            self._weight_loads.append((ff.layers[-1], [arr]))
         return out

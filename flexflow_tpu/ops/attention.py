@@ -105,11 +105,16 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
     # the mesh, so the per-chip footprint divides by n_devices.
     b, _, h, _ = q.shape
     kv_len = k.shape[1]
-    # Only the data (batch) and model (heads) mesh axes shard the score
-    # tensor's dims; expert/pipe axes don't divide this op's footprint.
+    # Only the mesh axes that actually shard the score tensor's dims count:
+    # data (batch), model (heads), seq (query positions). Expert/pipe axes
+    # don't divide this op's footprint.
     shard = ctx.n_devices
     if ctx.mesh is not None:
-        shard = ctx.mesh.shape.get("data", 1) * ctx.mesh.shape.get("model", 1)
+        shard = (
+            ctx.mesh.shape.get("data", 1)
+            * ctx.mesh.shape.get("model", 1)
+            * ctx.mesh.shape.get("seq", 1)
+        )
     score_bytes = 4 * b * h * seq_len * kv_len // max(1, shard)
     if score_bytes > 256 * 1024 * 1024 and not use_dropout:
         # Long sequences: O(seq) memory kernels instead of the s×s score

@@ -274,9 +274,13 @@ class UnsqueezeParams:
 
 def _unsqueeze_infer(params, in_shapes, in_dtypes):
     (s,) = in_shapes
+    # ONNX: axes are positions in the OUTPUT (rank = in + len(axes));
+    # negative axes resolve against that final rank, not intermediates
+    out_rank = len(s) + len(params.axes)
+    axes = sorted(a % out_rank for a in params.axes)
     out = list(s)
-    for a in sorted(params.axes):
-        out.insert(a if a >= 0 else len(out) + a + 1, 1)
+    for a in axes:
+        out.insert(a, 1)
     return [tuple(out)], [in_dtypes[0]]
 
 

@@ -44,6 +44,49 @@ def build_mesh(
     return Mesh(dev_array, tuple(k for k, _ in axes))
 
 
+def build_hybrid_mesh(
+    ici_axes: Dict[str, int],
+    dcn_axes: Dict[str, int],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Multi-slice mesh: `dcn_axes` span slices (data-center network),
+    `ici_axes` stay within a slice (inter-chip interconnect).
+
+    TPU-native equivalent of the reference's two-level comm hierarchy
+    (NCCL within a node + GASNet across nodes, SURVEY §5): lay out the
+    device array so collectives over ici axes ride ICI and only the dcn
+    axes (put data parallelism there) cross slices. Uses
+    mesh_utils.create_hybrid_device_mesh when devices carry slice
+    topology; single-slice (or CPU-simulated) device sets fall back to
+    build_mesh with dcn axes leading.
+    """
+    if devices is None:
+        devices = jax.devices()
+    slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+    merged = dict(dcn_axes)
+    merged.update(ici_axes)
+    if len(slice_ids) > 1:
+        from jax.experimental import mesh_utils
+
+        # create_hybrid_device_mesh wants mesh_shape and dcn_mesh_shape of
+        # EQUAL length (per-axis ici and dcn factors). Order axes dcn-first,
+        # give dcn axes ici-factor 1 and ici axes dcn-factor 1 — the result
+        # then has shape (dcn sizes..., ici sizes...) with dcn axes actually
+        # spanning slices; no reshape (which would scramble device order).
+        names = tuple(dcn_axes) + tuple(ici_axes)
+        per_slice = tuple([1] * len(dcn_axes)) + tuple(ici_axes.values())
+        across = tuple(dcn_axes.values()) + tuple([1] * len(ici_axes))
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=per_slice,
+            dcn_mesh_shape=across,
+            devices=devices,
+        )
+        return Mesh(dev_array, names)
+    return build_mesh(
+        {n: merged[n] for n in tuple(dcn_axes) + tuple(ici_axes)}, devices
+    )
+
+
 def default_data_parallel_mesh(num_devices: Optional[int] = None) -> Mesh:
     devices = jax.devices()
     n = num_devices or len(devices)

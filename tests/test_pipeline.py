@@ -167,3 +167,13 @@ def test_pipelined_train_step_runs_and_learns(pp, micro):
         losses.append(float(partials["loss"]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_hybrid_mesh_fallback_single_slice():
+    """build_hybrid_mesh on homogeneous (CPU) devices falls back to a flat
+    mesh with dcn axes leading, so dp crosses the slower links."""
+    from flexflow_tpu.parallel.mesh import build_hybrid_mesh
+
+    mesh = build_hybrid_mesh({"model": 2, "pipe": 2}, {"data": 2})
+    assert mesh.axis_names == ("data", "model", "pipe")
+    assert dict(mesh.shape) == {"data": 2, "model": 2, "pipe": 2}
