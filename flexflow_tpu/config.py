@@ -53,6 +53,12 @@ class FFConfig:
     # per pipeline flush (0 = one per stage).
     pipeline_parallel_degree: int = 1
     num_microbatches: int = 0
+    # Recompute memory-heavy op internals (attention scores/probs) in the
+    # backward instead of saving them (jax.checkpoint). Exact math; trades
+    # FLOPs for HBM. Off by default — at benchmark shapes the stored-probs
+    # backward is faster (measured 316 vs 245 samples/s at seq 512); turn
+    # on for long sequences / big models where residuals exceed HBM.
+    remat: bool = False
     expert_parallel_degree: int = 1
     # bf16 compute with f32 master weights (TPU-native mixed precision).
     # Off by default so numerical-alignment tests match f32 references;

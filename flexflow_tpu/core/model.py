@@ -701,6 +701,7 @@ class FFModel:
             compute_dtype=compute_dtype,
             seed=self.config.seed,
             input_order=ordered_inputs,
+            remat=self.config.remat,
         )
         self.state = self.executor.init_state()
         self.perf_metrics = PerfMetrics()

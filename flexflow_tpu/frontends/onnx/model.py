@@ -241,13 +241,15 @@ class ONNXModel:
     def handle_Squeeze(self, ff, node, env):
         a = _attrs(node)
         axes = a.get("axes")
-        if axes is None and len(node.input) > 1:  # opset 13: axes as input
+        # opset 13: axes as optional second input ('' = omitted)
+        if axes is None and len(node.input) > 1 and node.input[1]:
             axes = self.initializers.get(node.input[1])
             assert axes is not None, (
                 "Squeeze axes input must be a graph initializer (static)"
             )
         # no axes anywhere = legal ONNX: squeeze every unit dim
-        return ff.squeeze(env[node.input[0]], [int(x) for x in (axes or [])])
+        axes = [] if axes is None else list(axes)
+        return ff.squeeze(env[node.input[0]], [int(x) for x in axes])
 
     def handle_Unsqueeze(self, ff, node, env):
         a = _attrs(node)

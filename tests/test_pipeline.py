@@ -177,3 +177,38 @@ def test_hybrid_mesh_fallback_single_slice():
     mesh = build_hybrid_mesh({"model": 2, "pipe": 2}, {"data": 2})
     assert mesh.axis_names == ("data", "model", "pipe")
     assert dict(mesh.shape) == {"data": 2, "model": 2, "pipe": 2}
+
+
+def test_remat_grads_exact():
+    """cfg.remat recomputes attention internals in the backward via
+    jax.checkpoint — gradients must be bit-comparable to the stored path."""
+    def build(remat):
+        cfg = FFConfig()
+        cfg.batch_size = 4
+        cfg.remat = remat
+        m = FFModel(cfg)
+        build_transformer(m, batch_size=4, seq_length=8, hidden_size=16,
+                          num_heads=2, num_layers=2)
+        m.compile(
+            optimizer=SGDOptimizer(lr=0.05),
+            loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+            metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR],
+        )
+        return m
+
+    m0, m1 = build(False), build(True)
+    # identical seeds -> identical init params
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 8, 16).astype(np.float32)
+    y = jnp.asarray(rng.randn(4, 8, 16).astype(np.float32))
+    key = jax.random.PRNGKey(7)
+    outs = []
+    for m in (m0, m1):
+        ex = m.executor
+        step = ex.build_train_step()
+        bx = [ex.shard_batch(ex.input_pts[0], x)]
+        st, partials = step(m.state, bx, y, key)
+        outs.append((float(partials["loss"]),
+                     np.asarray(jax.tree_util.tree_leaves(st.params)[0])))
+    assert outs[0][0] == outs[1][0]
+    np.testing.assert_allclose(outs[0][1], outs[1][1], rtol=1e-6, atol=1e-6)
