@@ -812,6 +812,19 @@ class FFModel:
                   f"(dataset {n} % batch {bs})")
         step_fn = self.executor.build_train_step()
         in_pts = self.executor.input_pts
+        if self.config.profiling:
+            # reference: per-op event timing prints under --profiling
+            # (kernels/linear_kernels.cu:94-117)
+            from ..runtime.profiler import profile_ops
+
+            first = next(self._batches(list(xs) + [y], bs))
+            cast = [
+                np.asarray(a, pt.data_type.np_dtype)
+                for pt, a in zip(in_pts, first[:-1])
+            ]
+            times = profile_ops(self, cast)
+            for op_name, t in sorted(times.items(), key=lambda kv: -kv[1]):
+                print(f"[profiling] {op_name}: {t*1e3:.3f} ms")
         label_dt = self.label_tensor.data_type.jnp_dtype
         self.perf_metrics = PerfMetrics()
         start = time.time()

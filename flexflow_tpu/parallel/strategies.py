@@ -48,7 +48,15 @@ def assign_mesh_axes(graph: Graph, max_devices: int) -> Dict[str, int]:
     A dim whose degree doesn't equal its axis size can't shard evenly under
     NamedSharding and is demoted to replicated (round-1 lowering limit; the
     reference's fully heterogeneous placements would need per-segment
-    programs)."""
+    programs). Block-stack (pipeline) ops keep their stage axis: their
+    num_stages params were fixed at graph build from config, so the mesh
+    must carry a matching "pipe" axis or the GPipe path silently degrades
+    to the sequential scan."""
+    pipe_deg = 1
+    for op in graph.ops:
+        stages = getattr(op.params, "num_stages", 1)
+        if stages > 1:
+            pipe_deg = max(pipe_deg, stages)
     data_deg, model_deg = 1, 1
     tensors = list(graph.input_tensors())
     for op in graph.ops:
@@ -65,10 +73,19 @@ def assign_mesh_axes(graph: Graph, max_devices: int) -> Dict[str, int]:
                 data_deg = max(data_deg, d.degree)
             else:
                 model_deg = max(model_deg, d.degree)
-    while data_deg * model_deg > max_devices and data_deg > 1:
+    # shrink data, then model, before sacrificing the user's requested
+    # pipeline degree; dropping pipe is last resort and is announced
+    while data_deg * model_deg * pipe_deg > max_devices and data_deg > 1:
         data_deg //= 2
-    while data_deg * model_deg > max_devices and model_deg > 1:
+    while data_deg * model_deg * pipe_deg > max_devices and model_deg > 1:
         model_deg //= 2
+    if data_deg * model_deg * pipe_deg > max_devices:
+        print(
+            f"[flexflow_tpu] warning: dropping pipeline degree {pipe_deg} "
+            f"(needs {pipe_deg} devices, have {max_devices}); block-stack "
+            f"ops fall back to the sequential scan"
+        )
+        pipe_deg = 1  # ops degrade to the sequential scan path, still correct
     for t in tensors:
         is_weight = t.guid in weight_guids
         for i, d in enumerate(t.dims):
@@ -87,7 +104,11 @@ def assign_mesh_axes(graph: Graph, max_devices: int) -> Dict[str, int]:
                     d.parallel_idx = 1
                 else:
                     d.degree, d.parallel_idx = 1, -1
-    return {"data": data_deg, "model": model_deg}
+    axes = {"data": data_deg, "model": model_deg}
+    if pipe_deg > 1:
+        axes["pipe"] = pipe_deg
+        apply_pipeline_parallel(graph, pipe_deg, axis_idx=len(axes) - 1)
+    return axes
 
 
 def apply_tensor_parallel(graph: Graph, degree: int, axis_idx: int = 1) -> None:

@@ -210,5 +210,33 @@ def test_remat_grads_exact():
         st, partials = step(m.state, bx, y, key)
         outs.append((float(partials["loss"]),
                      np.asarray(jax.tree_util.tree_leaves(st.params)[0])))
-    assert outs[0][0] == outs[1][0]
+    np.testing.assert_allclose(outs[0][0], outs[1][0], rtol=1e-6)
     np.testing.assert_allclose(outs[0][1], outs[1][1], rtol=1e-6, atol=1e-6)
+
+
+def test_search_path_keeps_pipe_axis():
+    """Unity-search compile must carry the pipe mesh axis for block-stack
+    ops (their num_stages is fixed at graph build), or GPipe silently
+    degrades to the sequential scan."""
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.pipeline_parallel_degree = 2
+    cfg.search_budget = 3
+    model = FFModel(cfg)
+    build_transformer(model, batch_size=8, seq_length=16, hidden_size=32,
+                      num_heads=4, num_layers=4)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR],
+    )
+    mesh = model.executor.mesh
+    assert mesh.shape.get("pipe") == 2, dict(mesh.shape)
+    ex = model.executor
+    step = ex.build_train_step()
+    rng = np.random.RandomState(6)
+    x = rng.randn(8, 16, 32).astype(np.float32)
+    y = jnp.asarray((x * 0.5).astype(np.float32))
+    st, partials = step(model.state, [ex.shard_batch(ex.input_pts[0], x)], y,
+                        jax.random.PRNGKey(0))
+    assert np.isfinite(float(partials["loss"]))
