@@ -86,8 +86,10 @@ class FFModel:
         self._tensor_map: Dict[int, int] = {}
         self._pt_by_guid: Dict[int, object] = {}
         self._current_batch: Optional[Tuple] = None
+        self._last_logits = None
         self._pending_grads = None
         self._dataloaders: List[object] = []
+        self._constant_values: Dict[int, float] = {}  # Tensor.guid -> value
         self._rng = jax.random.PRNGKey(self.config.seed)
 
     # ------------------------------------------------------------------
@@ -649,7 +651,14 @@ class FFModel:
             pre_pos[self._tensor_map[t.guid]]
             for t in self.input_tensors
             if self._tensor_map.get(t.guid) in pre_pos
+            and t.guid not in self._constant_values
         ]
+        self._constant_positions = {
+            pre_pos[self._tensor_map[t.guid]]: self._constant_values[t.guid]
+            for t in self.input_tensors
+            if t.guid in self._constant_values
+            and self._tensor_map.get(t.guid) in pre_pos
+        }
         if self.config.search_budget >= 0 and not self.config.only_data_parallel:
             mesh = self._run_strategy_search(ndev)
         else:
@@ -692,6 +701,10 @@ class FFModel:
         # those actually consumed by the graph become executor inputs.
         cur_inputs = self.graph.input_tensors()
         ordered_inputs = [cur_inputs[i] for i in self._input_positions]
+        constants = {
+            cur_inputs[i].guid: (cur_inputs[i], v)
+            for i, v in self._constant_positions.items()
+        }
         self.executor = PCGExecutor(
             self.graph,
             mesh,
@@ -702,6 +715,7 @@ class FFModel:
             seed=self.config.seed,
             input_order=ordered_inputs,
             remat=self.config.remat,
+            constants=constants,
         )
         self.state = self.executor.init_state()
         self.perf_metrics = PerfMetrics()
@@ -945,14 +959,64 @@ class FFModel:
     def get_perf_metrics(self) -> PerfMetrics:
         return self.perf_metrics
 
+    def reset_metrics(self):
+        """reference: flexflow_cffi.py:1968 reset_metrics."""
+        self.perf_metrics = PerfMetrics()
+
+    def compute_metrics(self):
+        """Fold the current batch's metrics into perf_metrics
+        (reference: flexflow_cffi.py:2004 compute_metrics)."""
+        assert self._last_logits is not None and self._current_batch is not None
+        _, label = self._current_batch
+        by = jnp.asarray(label, self.label_tensor.data_type.jnp_dtype)
+        partials = self.metrics_obj.compute(self._last_logits, by)
+        self.perf_metrics.update(
+            {k: float(v) for k, v in partials.items() if k != "loss"}
+        )
+        return self.perf_metrics
+
+    def init_layers(self):
+        """Re-initialize all weights (reference: flexflow_cffi.py:1975;
+        there a Legion task per weight — here a fresh executor state)."""
+        assert self.executor is not None, "call compile() first"
+        self.state = self.executor.init_state()
+
+    def prefetch(self):
+        """No-op: XLA prefetches HBM transfers itself; kept for script
+        compatibility (reference: flexflow_cffi.py:1982)."""
+
+    def map_tensor(self, tensor, parallel_op=None):
+        """No-op: tensors materialize with their NamedSharding at first use
+        (reference: flexflow_cffi.py:937 maps Legion regions)."""
+
+    def create_constant(self, dims, value, data_type=DataType.DT_FLOAT):
+        """Constant input tensor: materialized by the executor, never part
+        of fit()'s batch inputs (reference: flexflow_cffi.py:941)."""
+        t = self.create_tensor(dims, data_type, create_grad=False)
+        self._constant_values[t.guid] = float(value)
+        return t
+
     def get_layers(self) -> Dict[int, Layer]:
         return dict(enumerate(self.layers))
 
     def get_layer_by_id(self, idx: int) -> Layer:
         return self.layers[idx]
 
+    def get_layer_by_name(self, name: str) -> Layer:
+        for layer in self.layers:
+            if layer.name == name:
+                return layer
+        raise KeyError(f"no layer named {name!r}")
+
     def get_last_layer(self) -> Layer:
         return self.layers[-1]
+
+    def print_layers(self, id: int = -1):
+        """reference: flexflow_cffi.py print_layers."""
+        for i, layer in enumerate(self.layers):
+            if id in (-1, i):
+                shapes = [tuple(t.dims) for t in layer.outputs]
+                print(f"layer {i}: {layer.name} ({layer.op_type.name}) -> {shapes}")
 
     # ------------------------------------------------------------------
     # weight access (reference: parallel_tensor.cc set_tensor/get_tensor)

@@ -68,10 +68,15 @@ class PCGExecutor:
         seed: int = 0,
         input_order: Optional[List] = None,
         remat: bool = False,
+        constants: Optional[Dict] = None,
     ):
         self.graph = graph
         self.mesh = mesh
         self.remat = remat
+        # guid -> (ParallelTensor, python float): inputs materialized as
+        # jnp.full at trace time, excluded from batch inputs
+        # (reference: flexflow_constant_create, flexflow_cffi.py:941)
+        self.constants = constants or {}
         self.optimizer = optimizer
         self.loss_type = loss_type
         self.loss_fn = losses_mod.get_loss_fn(loss_type)
@@ -137,6 +142,10 @@ class PCGExecutor:
         """Walk the PCG and compute every tensor. Returns guid -> value.
         Differentiable aux losses (MoE balance) are appended to aux_out."""
         vals: Dict[int, jax.Array] = dict(inputs)
+        for guid, (pt, value) in self.constants.items():
+            vals[guid] = jnp.full(
+                pt.material_shape(), value, pt.data_type.jnp_dtype
+            )
         for op in self.topo:
             ins = [vals[t.guid] for t in op.inputs]
             if op.is_parallel_op:

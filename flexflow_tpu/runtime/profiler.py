@@ -38,6 +38,8 @@ def profile_ops(model, batch_inputs, *, repeats: int = 3) -> Dict[str, float]:
     import jax.numpy as jnp
 
     vals = {pt.guid: jnp.asarray(a) for pt, a in zip(ex.input_pts, batch_inputs)}
+    for guid, (pt, value) in ex.constants.items():
+        vals[guid] = jnp.full(pt.material_shape(), value, pt.data_type.jnp_dtype)
     from ..ops.registry import FwdCtx, get_op_def
     from ..parallel import parallel_ops as par_ops
 

@@ -79,3 +79,32 @@ def test_resize_nearest():
     out, _ = _run(build, [x])
     assert out.shape == (2, 1, 4, 4)
     np.testing.assert_allclose(out[:, :, ::2, ::2], x)
+
+
+def test_create_constant_and_introspection():
+    """cffi-parity methods: create_constant feeds the graph without being a
+    fit() input; get_layer_by_name/print_layers/reset_metrics behave."""
+    cfg = FFConfig()
+    cfg.batch_size = 4
+    m = FFModel(cfg)
+    x = m.create_tensor((4, 8))
+    c = m.create_constant((4, 8), 2.0)
+    t = m.add(x, c, name="plus2")
+    t = m.dense(t, 4, name="head")
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR],
+    )
+    assert len(m.executor.input_pts) == 1  # constant excluded
+    ex = m.executor
+    fwd = ex.build_forward()
+    xin = np.zeros((4, 8), np.float32)
+    out = np.asarray(fwd(m.state.params, [xin]))
+    # zeros + 2.0 through a linear head: must equal head(2*ones)
+    k = np.asarray(m.state.params["head"]["kernel"])
+    b = np.asarray(m.state.params["head"]["bias"])
+    np.testing.assert_allclose(out, (np.full((4, 8), 2.0) @ k) + b, rtol=1e-5)
+    assert m.get_layer_by_name("plus2").name == "plus2"
+    m.reset_metrics()
+    m.print_layers(0)

@@ -181,7 +181,10 @@ def test_hybrid_mesh_fallback_single_slice():
 
 def test_remat_grads_exact():
     """cfg.remat recomputes attention internals in the backward via
-    jax.checkpoint — gradients must be bit-comparable to the stored path."""
+    jax.checkpoint — same math as the stored path, so loss and updated
+    params must agree to float tolerance (bitwise equality is NOT
+    guaranteed: checkpoint's prevent_cse barriers can change XLA fusion
+    and hence rounding)."""
     def build(remat):
         cfg = FFConfig()
         cfg.batch_size = 4
