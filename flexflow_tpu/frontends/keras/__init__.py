@@ -1,6 +1,7 @@
 """Keras-compatible frontend (reference: python/flexflow/keras/)."""
 from . import callbacks, datasets, layers, optimizers  # noqa: F401
 from .layers import (  # noqa: F401
+    Permute,
     Activation,
     Add,
     AveragePooling2D,

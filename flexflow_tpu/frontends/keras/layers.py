@@ -315,6 +315,25 @@ class Reshape(Layer):
         return [t]
 
 
+class Permute(Layer):
+    """reference: python/flexflow/keras/layers/core.py Permute — dims are
+    1-indexed over non-batch axes, Keras semantics."""
+
+    def __init__(self, dims, **kw):
+        super().__init__(**kw)
+        self.dims = tuple(dims)
+
+    def compute_output_shape(self, shapes):
+        (s,) = shapes
+        return [tuple(s[d - 1] for d in self.dims)]
+
+    def build_ff(self, ffmodel, ff_inputs):
+        perm = (0,) + tuple(d for d in self.dims)  # batch stays in front
+        t = ffmodel.transpose(ff_inputs[0], perm, name=self.name)
+        self._ff_layer = ffmodel.layers[-1]
+        return [t]
+
+
 class _Merge(Layer):
     op = None
 

@@ -32,6 +32,11 @@ _METRIC_MAP = {
     "mean_squared_error": MetricsType.METRICS_MEAN_SQUARED_ERROR,
     "root_mean_squared_error": MetricsType.METRICS_ROOT_MEAN_SQUARED_ERROR,
     "mean_absolute_error": MetricsType.METRICS_MEAN_ABSOLUTE_ERROR,
+    # Keras short aliases
+    "acc": MetricsType.METRICS_ACCURACY,
+    "mse": MetricsType.METRICS_MEAN_SQUARED_ERROR,
+    "rmse": MetricsType.METRICS_ROOT_MEAN_SQUARED_ERROR,
+    "mae": MetricsType.METRICS_MEAN_ABSOLUTE_ERROR,
 }
 
 

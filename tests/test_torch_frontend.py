@@ -70,3 +70,68 @@ def test_torch_functional_ops():
             return torch.softmax(a + x * 2.0, dim=-1)
 
     _import_and_compare(Funky(), (4, 8))
+
+
+def test_torch_transformer_encoder_alignment():
+    """Trace a self-attention encoder block (Linear QKV + matmul/softmax +
+    residual + LayerNorm + MLP) through fx and align the imported model's
+    forward with torch — the reference's mt5_encoder alignment analogue
+    (tests/align, python/flexflow/torch/model.py HF tracing)."""
+    import math
+
+    import torch
+    from torch import nn
+
+    E, H = 32, 4
+
+    class Block(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.q = nn.Linear(E, E)
+            self.k = nn.Linear(E, E)
+            self.v = nn.Linear(E, E)
+            self.o = nn.Linear(E, E)
+            self.ln1 = nn.LayerNorm(E)
+            self.ln2 = nn.LayerNorm(E)
+            self.up = nn.Linear(E, 4 * E)
+            self.down = nn.Linear(4 * E, E)
+
+        def forward(self, x):
+            b, s, e = 2, 6, E
+            h = self.ln1(x)
+            q = self.q(h).view(b, s, H, e // H).permute(0, 2, 1, 3)
+            k = self.k(h).view(b, s, H, e // H).permute(0, 2, 1, 3)
+            v = self.v(h).view(b, s, H, e // H).permute(0, 2, 1, 3)
+            att = torch.matmul(q, k.transpose(2, 3)) / math.sqrt(e // H)
+            att = torch.softmax(att, dim=-1)
+            ctx = torch.matmul(att, v).permute(0, 2, 1, 3).reshape(b, s, e)
+            x = x + self.o(ctx)
+            h2 = self.ln2(x)
+            x = x + self.down(torch.nn.functional.gelu(self.up(h2)))
+            return x
+
+    torch.manual_seed(0)
+    block = Block().eval()
+    x = torch.randn(2, 6, E)
+    with torch.no_grad():
+        want = block(x).numpy()
+
+    from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                              SGDOptimizer)
+
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    ffmodel = FFModel(cfg)
+    inp = ffmodel.create_tensor((2, 6, E))
+    pt = PyTorchModel(block)
+    (out,) = pt.torch_to_ff(ffmodel, [inp])
+    ffmodel.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR],
+    )
+    pt.load_weights(ffmodel)
+    ex = ffmodel.executor
+    fwd = ex.build_forward()
+    got = np.asarray(fwd(ffmodel.state.params, [x.numpy()]))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
