@@ -1,0 +1,70 @@
+"""Perf sweep on the real chip: attention impl x remat for the bench config."""
+import json
+import os
+import time
+
+import numpy as np
+
+
+def run_variant(impl: str, remat: bool, iters: int = 10):
+    import jax
+
+    from flexflow_tpu import (
+        FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
+    )
+    from flexflow_tpu.models.transformer import build_transformer
+
+    os.environ["FF_ATTENTION_IMPL"] = impl
+    batch = 8
+    cfg = FFConfig()
+    cfg.batch_size = batch
+    cfg.allow_mixed_precision = True
+    cfg.remat = remat
+    model = FFModel(cfg)
+    build_transformer(model, batch_size=batch, seq_length=512,
+                      hidden_size=1024, num_heads=16, num_layers=12)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR],
+    )
+    ex = model.executor
+    step = ex.build_train_step()
+    in_pt = ex.input_pts[0]
+    rng = np.random.RandomState(0)
+    x = ex.shard_batch(in_pt, rng.randn(*in_pt.material_shape()).astype(np.float32))
+    y = jax.numpy.asarray(rng.randn(*in_pt.material_shape()).astype(np.float32))
+    key = jax.random.PRNGKey(0)
+    state = model.state
+    probe = jax.jit(
+        lambda params: sum(
+            leaf.reshape(-1)[0].astype(jax.numpy.float32)
+            for leaf in jax.tree_util.tree_leaves(params)
+        )
+    )
+
+    def sync(st):
+        return float(np.asarray(probe(st.params)))
+
+    for _ in range(3):
+        state, _ = step(state, [x], y, key)
+    sync(state)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, _ = step(state, [x], y, key)
+    sync(state)
+    dt = time.perf_counter() - t0
+    sps = batch * iters / dt
+    print(json.dumps({"impl": impl, "remat": remat,
+                      "samples_per_s": round(sps, 2)}), flush=True)
+
+
+if __name__ == "__main__":
+    for impl, remat in [("dense", False), ("dense", True),
+                        ("flash", False), ("flash", True),
+                        ("chunked", False)]:
+        try:
+            run_variant(impl, remat)
+        except Exception as e:  # keep sweeping
+            print(json.dumps({"impl": impl, "remat": remat,
+                              "error": str(e)[:200]}), flush=True)

@@ -16,6 +16,8 @@ Inputs are (batch, seq, embed) like the reference's (N, L, E).
 from __future__ import annotations
 
 import dataclasses
+import os
+import warnings
 from typing import Optional
 
 import jax
@@ -116,13 +118,29 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
             * ctx.mesh.shape.get("seq", 1)
         )
     score_bytes = 4 * b * h * seq_len * kv_len // max(1, shard)
-    if score_bytes > 256 * 1024 * 1024 and not use_dropout:
+    # FF_ATTENTION_IMPL ∈ {auto, dense, flash, chunked} overrides the
+    # size-based dispatch (like picking a cuDNN MHA algo by hand).
+    impl = os.environ.get("FF_ATTENTION_IMPL", "auto")
+    if impl not in ("auto", "dense", "flash", "chunked"):
+        raise ValueError(
+            f"FF_ATTENTION_IMPL={impl!r}: expected auto|dense|flash|chunked"
+        )
+    if impl in ("flash", "chunked") and use_dropout:
+        warnings.warn(
+            f"FF_ATTENTION_IMPL={impl} ignored: attention dropout needs the "
+            "dense path (streaming kernels don't thread the dropout rng)"
+        )
+    use_streaming = (
+        impl in ("flash", "chunked")
+        or (impl == "auto" and score_bytes > 256 * 1024 * 1024)
+    ) and not use_dropout
+    if use_streaming:
         # Long sequences: O(seq) memory kernels instead of the s×s score
         # tensor — Pallas flash attention on TPU, chunked scan elsewhere
         # (kernels/attention.py; replaces cuDNN MHA's internal algorithm).
         from ..kernels.attention import chunked_attention, flash_attention
 
-        if jax.default_backend() == "tpu":
+        if impl != "chunked" and jax.default_backend() == "tpu":
             attn = flash_attention(q, k, v, params.causal)
         else:
             attn = chunked_attention(q, k, v, causal=params.causal)

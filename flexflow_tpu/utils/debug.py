@@ -1,0 +1,90 @@
+"""Interactive debugging helpers: pretty-printers for PCG structures.
+
+TPU-native equivalent of the reference's gdb pretty-printers
+(gdb/pretty_print.py registers printers for Node/Edge/Graph/MachineView).
+Our graph IR is Python, so these are plain functions usable from any REPL or
+debugger (`from flexflow_tpu.utils.debug import pp`), plus a tensor-value
+inspector that mirrors the reference's `print_tensor<T>` device helper
+(src/runtime/cuda_helper.cu) without a device round-trip per element.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+def format_parallel_tensor(pt) -> str:
+    """[size/degree(idx)|R] per dim — replica dims marked R (the reference
+    prints ParallelDim the same way in its dot exports)."""
+    dims = []
+    for d in pt.dims:
+        tag = f"{d.size}"
+        if d.degree > 1:
+            tag += f"/{d.degree}"
+        if d.parallel_idx >= 0:
+            tag += f"({d.parallel_idx})"
+        if d.is_replica_dim:
+            tag += "R"
+        dims.append(tag)
+    return f"PT#{pt.guid}[{' x '.join(dims)}] {pt.data_type.name}"
+
+
+def format_machine_view(mv) -> str:
+    devs = list(mv.device_ids()) if hasattr(mv, "device_ids") else []
+    short = devs if len(devs) <= 8 else devs[:8] + ["..."]
+    return (
+        f"MachineView({mv.device_type} start={mv.start_device_id} "
+        f"dim={mv.dim} stride={mv.stride} devices={short})"
+    )
+
+
+def format_op(op, *, views: dict | None = None) -> str:
+    ins = ", ".join(format_parallel_tensor(t) for t in op.inputs)
+    outs = ", ".join(format_parallel_tensor(t) for t in op.outputs)
+    line = f"{op.name} <{op.op_type.name}> ({ins}) -> ({outs})"
+    if views and op in views:
+        line += f"  @ {format_machine_view(views[op])}"
+    return line
+
+
+def format_graph(graph, *, views: dict | None = None) -> str:
+    lines = [f"Graph: {len(graph.ops)} ops"]
+    for op in graph.topo_order():
+        lines.append("  " + format_op(op, views=views))
+    return "\n".join(lines)
+
+
+def summarize_array(x: Any, name: str = "tensor", edge: int = 3) -> str:
+    """Shape/dtype/stats plus corner values — the reference's print_tensor
+    debug task, but summarized host-side in one transfer."""
+    arr = np.asarray(x)
+    flat = arr.reshape(-1)
+    head = ", ".join(f"{v:.4g}" for v in flat[:edge])
+    tail = ", ".join(f"{v:.4g}" for v in flat[-edge:]) if flat.size > edge else ""
+    stats = ""
+    if arr.size and np.issubdtype(arr.dtype, np.floating):
+        stats = (
+            f" mean={arr.mean():.4g} std={arr.std():.4g}"
+            f" min={arr.min():.4g} max={arr.max():.4g}"
+            f" nan={int(np.isnan(arr).sum())}"
+        )
+    return (
+        f"{name}: shape={arr.shape} dtype={arr.dtype}{stats}"
+        f" values=[{head}{', ..., ' + tail if tail else ''}]"
+    )
+
+
+def pp(obj: Any, **kw) -> None:
+    """Print any PCG object (Graph / PCGOp / ParallelTensor / MachineView /
+    array) in its pretty form."""
+    for probe, fmt in (
+        ("ops", format_graph),
+        ("op_type", format_op),
+        ("dims", format_parallel_tensor),
+        ("start_device_id", format_machine_view),
+    ):
+        if hasattr(obj, probe):
+            print(fmt(obj, **kw) if fmt is format_graph else fmt(obj))
+            return
+    print(summarize_array(obj, **kw))

@@ -1,0 +1,32 @@
+"""Packaging entry (reference: setup.py + cmake; SURVEY §2.7).
+
+Builds the native C++ runtime library (dataloader + task-graph simulator,
+flexflow_tpu/native/src) at install time when a toolchain is present; the
+package also self-builds it lazily at runtime (native/__init__.py), so a
+pure-Python install still works everywhere.
+"""
+import subprocess
+import sys
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        super().run()
+        try:
+            sys.path.insert(0, str(Path(__file__).parent))
+            from flexflow_tpu import native
+
+            lib = native.build(force=True)
+            if lib:
+                dest = Path(self.build_lib) / "flexflow_tpu" / "native"
+                dest.mkdir(parents=True, exist_ok=True)
+                self.copy_file(lib, str(dest / Path(lib).name))
+        except Exception as exc:  # toolchain-less install is fine
+            print(f"[setup] skipping native build: {exc}")
+
+
+setup(cmdclass={"build_py": BuildPyWithNative})

@@ -113,3 +113,80 @@ def test_ring_attention_grad():
     g = jax.grad(lambda q_: jnp.sum(ring(q_, k, v)))(q)
     ref = jax.grad(lambda q_: jnp.sum(naive_attention(q_, k, v)))(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(ref), atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# FF_ATTENTION_IMPL dispatch (ops/attention.py)
+# ---------------------------------------------------------------------------
+
+def _mha_forward(monkeypatch, impl, *, dropout=0.0, training=False):
+    """Run the MHA op forward under a forced impl, recording which kernel
+    path executed."""
+    import flexflow_tpu.ops.attention as mha
+    from flexflow_tpu.ops.registry import FwdCtx, get_op_def
+    from flexflow_tpu.ff_types import OperatorType
+
+    if impl is not None:
+        monkeypatch.setenv("FF_ATTENTION_IMPL", impl)
+    else:
+        monkeypatch.delenv("FF_ATTENTION_IMPL", raising=False)
+
+    called = {}
+    import flexflow_tpu.kernels.attention as kern
+
+    real_chunked = kern.chunked_attention
+
+    def spy_chunked(*a, **k):
+        called.setdefault("path", "chunked")
+        return real_chunked(*a, **k)
+
+    def spy_flash(q, k_, v, causal=False, **kw):
+        called.setdefault("path", "flash")
+        return real_chunked(q, k_, v, causal=causal)
+
+    monkeypatch.setattr(kern, "chunked_attention", spy_chunked)
+    monkeypatch.setattr(kern, "flash_attention", spy_flash)
+
+    params = mha.MultiHeadAttentionParams(embed_dim=16, num_heads=2)
+    opdef = get_op_def(OperatorType.OP_MULTIHEAD_ATTENTION)
+    x = jnp.asarray(RNG.randn(2, 8, 16).astype(np.float32))
+    shapes, dtypes = [(2, 8, 16)] * 3, None
+    from flexflow_tpu.ff_types import DataType
+    ws = opdef.weights(params, shapes, [DataType.DT_FLOAT] * 3)
+    key = jax.random.PRNGKey(0)
+    weights = {}
+    for w in ws:
+        key, sub = jax.random.split(key)
+        weights[w.name] = jax.random.normal(sub, w.shape, jnp.float32) * 0.1
+    if dropout:
+        params = mha.MultiHeadAttentionParams(
+            embed_dim=16, num_heads=2, dropout=dropout
+        )
+    ctx = FwdCtx(training=training, rng=key if training else None,
+                 seq_length=-1, compute_dtype=None, aux_losses=None,
+                 n_devices=1, mesh=None)
+    out, = opdef.forward(params, weights, [x, x, x], ctx)
+    return called.get("path", "dense"), out
+
+
+@pytest.mark.parametrize("impl,expected", [
+    (None, "dense"),        # auto at tiny size -> dense
+    ("dense", "dense"),
+    ("chunked", "chunked"),
+    ("flash", "chunked"),   # flash on CPU backend falls back to chunked
+])
+def test_attention_impl_dispatch(monkeypatch, impl, expected):
+    path, out = _mha_forward(monkeypatch, impl)
+    assert path == expected
+    assert out.shape == (2, 8, 16)
+
+
+def test_attention_impl_invalid(monkeypatch):
+    with pytest.raises(ValueError, match="FF_ATTENTION_IMPL"):
+        _mha_forward(monkeypatch, "falsh")
+
+
+def test_attention_impl_dropout_warns_and_runs_dense(monkeypatch):
+    with pytest.warns(UserWarning, match="dense path"):
+        path, _ = _mha_forward(monkeypatch, "flash", dropout=0.5, training=True)
+    assert path == "dense"

@@ -1,0 +1,132 @@
+"""Tests for tools/ (reference: tools/protobuf_to_json + substitutions_to_dot)
+and the debug pretty-printers (reference: gdb/pretty_print.py)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _varint(n):
+    if n < 0:
+        n += 1 << 64
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _ld(field, payload):
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _vi(field, val):
+    return _varint((field << 3) | 0) + _varint(val)
+
+
+def test_rules_to_json_decodes_wire_format(tmp_path):
+    from rules_to_json import decode_rule_collection
+
+    tensor = _vi(1, -1) + _vi(2, 0)
+    param = _vi(1, 30) + _vi(2, 4)  # PM_PARALLEL_DIM = 4
+    src = _vi(1, 5) + _ld(2, tensor)  # OP_LINEAR
+    dst = _vi(1, 83) + _ld(2, tensor) + _ld(3, param)  # OP_REPARTITION
+    mo = _vi(1, 0) + _vi(2, 0) + _vi(3, 0) + _vi(4, 0)
+    coll = _ld(1, _ld(1, src) + _ld(2, dst) + _ld(3, mo))
+
+    d = decode_rule_collection(coll)
+    rule = d["rule"][0]
+    assert rule["srcOp"][0]["type"] == "OP_LINEAR"
+    assert rule["srcOp"][0]["input"][0] == {"_t": "Tensor", "opId": -1, "tsId": 0}
+    assert rule["dstOp"][0]["type"] == "OP_REPARTITION"
+    assert rule["dstOp"][0]["para"][0] == {
+        "_t": "Parameter", "key": "PM_PARALLEL_DIM", "value": 4,
+    }
+    assert rule["mappedOutput"][0]["srcOpId"] == 0
+
+
+def test_rules_to_json_output_loads_as_substitutions(tmp_path):
+    """The converted JSON must feed straight into the substitution loader."""
+    from rules_to_json import decode_rule_collection
+
+    from flexflow_tpu.search.substitution_loader import load_rule_collection
+
+    tensor = _vi(1, -1) + _vi(2, 0)
+    para = _ld(3, _vi(1, 30) + _vi(2, 2)) + _ld(3, _vi(1, 31) + _vi(2, 2))
+    dst = _vi(1, 83) + _ld(2, tensor) + para
+    src = _vi(1, 13) + _ld(2, tensor)  # OP_RELU
+    coll = _ld(1, _ld(1, src) + _ld(2, dst))
+    rules = load_rule_collection(decode_rule_collection(coll))
+    assert len(rules) == 1
+
+
+def test_substitutions_to_dot(tmp_path):
+    from substitutions_to_dot import rule_to_dot
+
+    rule = {
+        "srcOp": [
+            {"type": "OP_LINEAR", "input": [{"opId": -1, "tsId": 0}],
+             "para": []},
+        ],
+        "dstOp": [
+            {"type": "OP_REPARTITION", "input": [{"opId": -1, "tsId": 0}],
+             "para": [{"key": "PM_PARALLEL_DEGREE", "value": 2}]},
+        ],
+        "mappedOutput": [
+            {"srcOpId": 0, "dstOpId": 0, "srcTsId": 0, "dstTsId": 0},
+        ],
+    }
+    dot = rule_to_dot(rule, "r0")
+    assert "digraph" in dot and "LINEAR" in dot and "REPARTITION" in dot
+    assert "parallel_degree=2" in dot
+    assert "style=dashed" in dot  # mapped output edge
+
+
+def test_substitutions_to_dot_cli(tmp_path):
+    rules = {"rule": [{"name": "r0", "srcOp": [], "dstOp": [],
+                       "mappedOutput": []}]}
+    src = tmp_path / "rules.json"
+    src.write_text(json.dumps(rules))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "substitutions_to_dot.py"),
+         str(src), str(tmp_path / "dots")],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    assert (tmp_path / "dots" / "r0.dot").exists()
+
+
+def test_debug_pretty_printers(capsys):
+    from flexflow_tpu import DataType, FFConfig, FFModel
+    from flexflow_tpu.utils.debug import (
+        format_graph, format_op, format_parallel_tensor, pp, summarize_array,
+    )
+
+    from flexflow_tpu.pcg.lowering import layers_to_pcg
+
+    cfg = FFConfig()
+    cfg.batch_size = 4
+    model = FFModel(cfg)
+    x = model.create_tensor((4, 8), DataType.DT_FLOAT)
+    model.dense(x, 16)
+    graph, _ = layers_to_pcg(model.layers)
+    txt = format_graph(graph)
+    assert "Graph:" in txt and "LINEAR" in txt
+
+    op = graph.topo_order()[-1]
+    assert "PT#" in format_op(op)
+    assert "x" in format_parallel_tensor(op.outputs[0])
+
+    s = summarize_array(np.arange(100, dtype=np.float32), "w")
+    assert "shape=(100,)" in s and "mean=" in s and "nan=0" in s
+
+    pp(graph)
+    assert "Graph:" in capsys.readouterr().out

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Convert TASO-generated substitution rule files (GraphSubst protobuf wire
+format) into the JSON format `--substitution-json` loads.
+
+TPU-native equivalent of reference tools/protobuf_to_json (C++ with
+libprotobuf; schema tools/protobuf_to_json/rules.proto). The schema is four
+tiny messages — Parameter{key,value}, Tensor{opId,tsId},
+Operator{type,input[],para[]}, Rule{srcOp[],dstOp[],mappedOutput[]} — so
+this decodes the proto2 wire format directly (varints + length-delimited
+submessages) with no protobuf dependency, then emits the same `_t`-tagged
+JSON as the reference's nlohmann serializer (substitution_loader.h).
+
+Usage: python tools/rules_to_json.py rules.pb > rules.json
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+# Numeric enum values from the reference's ffconst.h — the wire format
+# stores ints; JSON stores names (substitution_loader.h NLOHMANN maps).
+OP_TYPE_NAMES = {
+    0: "OP_INPUT", 1: "OP_WEIGHT", 2: "OP_NOOP", 3: "OP_CONV2D",
+    4: "OP_DROPOUT", 5: "OP_LINEAR", 6: "OP_BATCHMATMUL", 7: "OP_POOL2D",
+    8: "OP_SCALAR_MULTIPLY", 9: "OP_SCALAR_ADD", 10: "OP_SCALAR_FLOOR_DIV",
+    11: "OP_SCALAR_TRUE_DIV", 12: "OP_SCALAR_SUB", 13: "OP_RELU",
+    14: "OP_IDENTITY", 15: "OP_SIGMOID", 16: "OP_TANH", 17: "OP_ELU",
+    18: "OP_FLAT", 19: "OP_SOFTMAX", 20: "OP_BATCHNORM", 21: "OP_CONCAT",
+    22: "OP_SPLIT", 23: "OP_EMBEDDING", 24: "OP_GROUP_BY", 25: "OP_CACHE",
+    26: "OP_AGGREGATE", 27: "OP_AGG_SPEC", 28: "OP_RESHAPE",
+    29: "OP_REVERSE", 30: "OP_TRANSPOSE", 31: "OP_EW_ADD", 32: "OP_EW_MUL",
+    33: "OP_MATMUL", 34: "OP_MUL", 35: "OP_ENLARGE", 36: "OP_MERGE_GCONV",
+    37: "OP_CONSTANT_IMM", 38: "OP_CONSTANT_ICONV", 39: "OP_CONSTANT_ONE",
+    40: "OP_CONSTANT_POOL", 41: "OP_SQUEEZE", 42: "OP_UNSQUEEZE",
+    43: "OP_EW_SUB", 44: "OP_EW_DIV", 45: "OP_EW_EQUAL", 46: "OP_EW_GREATER",
+    47: "OP_EW_LESS", 48: "OP_EW_MAX", 49: "OP_EW_MIN",
+    50: "OP_REDUCE_ARGMAX", 51: "OP_REDUCE_ARGMIN", 52: "OP_REDUCE_MAX",
+    53: "OP_REDUCE_MEAN", 54: "OP_REDUCE_MIN", 55: "OP_REDUCE_PROD",
+    56: "OP_REDUCE_SUM", 57: "OP_PAD", 58: "OP_SHAPE", 59: "OP_SIZE",
+    60: "OP_TOPK", 61: "OP_WHERE", 62: "OP_CEIL", 63: "OP_CAST",
+    64: "OP_EXP", 65: "OP_ROUND", 66: "OP_LOG", 67: "OP_LOGICAL_NOT",
+    68: "OP_SQRT", 69: "OP_SIN", 70: "OP_COS", 71: "OP_LEAKYRELU",
+    72: "OP_SLICE", 73: "OP_RESIZE", 74: "OP_PRELU", 75: "OP_GELU",
+    76: "OP_MULTIHEAD_ATTENTION", 77: "OP_FUSED", 78: "OP_RSQRT",
+    79: "OP_POW", 80: "OP_MEAN", 81: "OP_LAYERNORM", 82: "OP_GATHER",
+    83: "OP_REPARTITION", 84: "OP_COMBINE", 85: "OP_REPLICATE",
+    86: "OP_REDUCTION", 87: "OP_PIPELINE", 88: "OP_FUSED_PARALLEL",
+    89: "OP_INVALID",
+    # legacy TASO spelling: OP_PARTITION == OP_REPARTITION slot in old files
+}
+
+PM_NAMES = {
+    0: "PM_OP_TYPE", 1: "PM_NUM_INPUTS", 2: "PM_NUM_OUTPUTS", 3: "PM_GROUP",
+    4: "PM_KERNEL_H", 5: "PM_KERNEL_W", 6: "PM_STRIDE_H", 7: "PM_STRIDE_W",
+    8: "PM_PADDING_H", 9: "PM_PADDING_W", 10: "PM_ACTI", 11: "PM_NUMDIM",
+    12: "PM_AXIS", 13: "PM_PERM", 14: "PM_OUTSHUFFLE",
+    15: "PM_MERGE_GCONV_COUNT", 16: "PM_AXES", 17: "PM_KEEP_DIMS",
+    18: "PM_EPSILON", 19: "PM_REPARTITION_DIM", 20: "PM_REPARTITION_DEGREE",
+    21: "PM_REPLICATE_DIM", 22: "PM_REPLICATE_DEGREE", 23: "PM_COMBINE_DIM",
+    24: "PM_COMBINE_DEGREE", 25: "PM_REDUCTION_DIM",
+    26: "PM_REDUCTION_DEGREE", 27: "PM_SOFTMAX_DIM", 28: "PM_NUM_HEADS",
+    29: "PM_INVALID", 30: "PM_PARALLEL_DIM", 31: "PM_PARALLEL_DEGREE",
+    32: "PM_PAD",
+}
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return result, pos
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a message body."""
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:  # varint
+            val, pos = _read_varint(buf, pos)
+        elif wt == 2:  # length-delimited
+            n, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + n]
+            pos += n
+        elif wt == 5:  # 32-bit
+            val = int.from_bytes(buf[pos:pos + 4], "little")
+            pos += 4
+        elif wt == 1:  # 64-bit
+            val = int.from_bytes(buf[pos:pos + 8], "little")
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield field, wt, val
+
+
+def _signed(v: int) -> int:
+    """proto2 int32 negative values are 10-byte varints (2^64 complement)."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _decode_tensor(buf: bytes) -> dict:
+    out = {"_t": "Tensor", "opId": 0, "tsId": 0}
+    for field, _, val in _fields(buf):
+        if field == 1:
+            out["opId"] = _signed(val)
+        elif field == 2:
+            out["tsId"] = _signed(val)
+    return out
+
+
+def _decode_parameter(buf: bytes) -> dict:
+    key = value = 0
+    for field, _, val in _fields(buf):
+        if field == 1:
+            key = _signed(val)
+        elif field == 2:
+            value = _signed(val)
+    return {"_t": "Parameter",
+            "key": PM_NAMES.get(key, f"PM_{key}"), "value": value}
+
+
+def _decode_operator(buf: bytes) -> dict:
+    out = {"_t": "Operator", "type": "OP_INVALID", "input": [], "para": []}
+    for field, _, val in _fields(buf):
+        if field == 1:
+            out["type"] = OP_TYPE_NAMES.get(_signed(val), f"OP_{val}")
+        elif field == 2:
+            out["input"].append(_decode_tensor(val))
+        elif field == 3:
+            out["para"].append(_decode_parameter(val))
+    return out
+
+
+def _decode_map_output(buf: bytes) -> dict:
+    out = {"_t": "MapOutput", "srcOpId": 0, "dstOpId": 0,
+           "srcTsId": 0, "dstTsId": 0}
+    names = {1: "srcOpId", 2: "dstOpId", 3: "srcTsId", 4: "dstTsId"}
+    for field, _, val in _fields(buf):
+        if field in names:
+            out[names[field]] = _signed(val)
+    return out
+
+
+def _decode_rule(buf: bytes, idx: int) -> dict:
+    out = {"_t": "Rule", "name": f"rule_{idx}", "srcOp": [], "dstOp": [],
+           "mappedOutput": []}
+    for field, _, val in _fields(buf):
+        if field == 1:
+            out["srcOp"].append(_decode_operator(val))
+        elif field == 2:
+            out["dstOp"].append(_decode_operator(val))
+        elif field == 3:
+            out["mappedOutput"].append(_decode_map_output(val))
+    return out
+
+
+def decode_rule_collection(buf: bytes) -> dict:
+    rules = []
+    for field, _, val in _fields(buf):
+        if field == 1:
+            rules.append(_decode_rule(val, len(rules)))
+    return {"_t": "RuleCollection", "rule": rules}
+
+
+def main(argv):
+    if len(argv) != 2:
+        print(__doc__, file=sys.stderr)
+        return 1
+    with open(argv[1], "rb") as f:
+        collection = decode_rule_collection(f.read())
+    json.dump(collection, sys.stdout, indent=2, sort_keys=True)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

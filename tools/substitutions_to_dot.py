@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Render substitution rules as graphviz dot — one digraph per rule, source
+pattern and target pattern as clustered subgraphs with external inputs as
+ellipses and mapped outputs as dashed edges.
+
+TPU-native equivalent of reference tools/substitutions_to_dot (C++ over the
+same JSON). Works on both the reference's TASO-style JSON
+(substitutions/graph_subst_3_v2.json) and the output of
+tools/rules_to_json.py.
+
+Usage:
+  python tools/substitutions_to_dot.py rules.json out_dir/ [--limit N]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _pattern_cluster(lines, ops, prefix, label, color):
+    lines.append(f'  subgraph cluster_{prefix} {{')
+    lines.append(f'    label="{label}"; color={color};')
+    ext_inputs = set()
+    for i, op in enumerate(ops):
+        paras = {
+            p["key"]: p["value"] for p in op.get("para", [])
+        }
+        para_str = "".join(
+            f'\\n{k.replace("PM_", "").lower()}={v}' for k, v in paras.items()
+        )
+        typ = op.get("type", "?").replace("OP_", "")
+        lines.append(
+            f'    {prefix}{i} [shape=box, label="{i}: {typ}{para_str}"];'
+        )
+        for t in op.get("input", []):
+            op_id, ts_id = t.get("opId", 0), t.get("tsId", 0)
+            if op_id < 0:  # external input k encoded as -1-k
+                ext = -op_id - 1
+                ext_inputs.add(ext)
+                lines.append(
+                    f'    {prefix}in{ext} -> {prefix}{i} [label="t{ts_id}"];'
+                )
+            else:
+                lines.append(
+                    f'    {prefix}{op_id} -> {prefix}{i} [label="t{ts_id}"];'
+                )
+    for ext in sorted(ext_inputs):
+        lines.append(
+            f'    {prefix}in{ext} [shape=ellipse, label="input {ext}"];'
+        )
+    lines.append("  }")
+
+
+def rule_to_dot(rule: dict, name: str) -> str:
+    lines = [f'digraph "{name}" {{', "  rankdir=TB;"]
+    _pattern_cluster(lines, rule.get("srcOp", []), "src", "source", "red")
+    _pattern_cluster(lines, rule.get("dstOp", []), "dst", "target", "blue")
+    for m in rule.get("mappedOutput", []):
+        lines.append(
+            f'  src{m["srcOpId"]} -> dst{m["dstOpId"]} '
+            f'[style=dashed, color=gray, '
+            f'label="out t{m["srcTsId"]}->t{m["dstTsId"]}"];'
+        )
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def main(argv):
+    if len(argv) < 3:
+        print(__doc__, file=sys.stderr)
+        return 1
+    limit = None
+    if "--limit" in argv:
+        i = argv.index("--limit")
+        limit = int(argv[i + 1])
+        argv = argv[:i] + argv[i + 2:]
+    with open(argv[1]) as f:
+        data = json.load(f)
+    rules = data["rule"] if isinstance(data, dict) else data
+    os.makedirs(argv[2], exist_ok=True)
+    for i, rule in enumerate(rules[:limit]):
+        name = rule.get("name", f"rule_{i}")
+        with open(os.path.join(argv[2], f"{name}.dot"), "w") as f:
+            f.write(rule_to_dot(rule, name))
+    print(f"wrote {len(rules[:limit])} dot files to {argv[2]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
