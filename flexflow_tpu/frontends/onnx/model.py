@@ -26,17 +26,31 @@ except Exception:
     HAS_ONNX = False
 
 
+# AttributeProto.AttributeType values (onnx.proto): FLOAT=1 INT=2 STRING=3
+# FLOATS=6 INTS=7
+_ATTR_FIELD_BY_TYPE = {1: "f", 2: "i", 3: "s", 6: "floats", 7: "ints"}
+
+
 def _attrs(node) -> Dict[str, object]:
     out = {}
     for a in node.attribute:
         # minimal AttributeProto decoding (reference: onnx/model.py uses
-        # helper.get_attribute_value)
-        for field in ("i", "f", "s", "ints", "floats"):
+        # helper.get_attribute_value). Trust the type tag when present —
+        # heuristics must not let a default i=0 shadow a populated `ints`.
+        t = getattr(a, "type", 0)
+        if t in _ATTR_FIELD_BY_TYPE:
+            field = _ATTR_FIELD_BY_TYPE[t]
+            v = getattr(a, field)
+            out[a.name] = list(v) if field in ("ints", "floats") else v
+            continue
+        for field in ("ints", "floats", "s", "i", "f"):
             v = getattr(a, field, None)
-            if v not in (None, "", b"", []) or (
-                field in ("i", "f") and v == 0 and a.type in (1, 2)
-            ):
-                out[a.name] = list(v) if field in ("ints", "floats") else v
+            if field in ("ints", "floats", "s"):
+                if v not in (None, "", b"") and len(v):
+                    out[a.name] = list(v) if field != "s" else v
+                    break
+            elif v:  # scalar: zero is indistinguishable from unset → default
+                out[a.name] = v
                 break
     return out
 

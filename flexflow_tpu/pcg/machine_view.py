@@ -131,6 +131,12 @@ def enumerate_machine_views(num_nodes: int, procs_per_node: int) -> List[Machine
                         start_node * procs_per_node + local, degree, procs_per_node
                     )
                 )
+    # multi-node contiguous views (whole-node groups: the full-machine
+    # data-parallel view lives here)
+    for n in range(2, num_nodes + 1):
+        degree = n * procs_per_node
+        for start_node in range(0, num_nodes - n + 1):
+            views.append(make_1d_view(start_node * procs_per_node, degree, 1))
     # dedupe
     seen = set()
     out = []

@@ -60,7 +60,7 @@ def test_onnx_mlp_import():
             Node("Relu", ["h"], ["hr"]),
             Node("MatMul", ["hr", "w2"], ["logits"]),
             Node("Softmax", ["logits"], ["probs"],
-                 attrs=[Attr("axis", i=-1, type=1)]),
+                 attrs=[Attr("axis", i=-1, type=2)]),  # AttributeProto INT
         ],
         initializers=[Init("w1", w1), Init("b1", b1), Init("w2", w2)],
         outputs=["probs"],

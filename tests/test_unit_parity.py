@@ -1,0 +1,148 @@
+"""Unit-test parity with the reference's tests/unit suite (SURVEY §4):
+test_machine_view.cc, test_parallel_config.cc, test_dot.cc,
+test_random_utils.cc — the graph/search data-structure tests that run with
+no accelerator. (test_dominators/test_disjoint_set/test_substitution_loader
+equivalents live in test_utils_and_more.py / test_substitution_loader.py.)
+"""
+import random
+
+import pytest
+
+from flexflow_tpu.pcg.machine_view import (
+    MachineResource,
+    MachineView,
+    enumerate_machine_views,
+    make_1d_view,
+)
+
+
+# ---------------------------------------------------------------------------
+# MachineView (reference: tests/unit/test_machine_view.cc)
+# ---------------------------------------------------------------------------
+
+def test_machine_view_linear_indexing():
+    v = MachineView(start_device_id=4, dim=(2, 3), stride=(3, 1))
+    assert v.ndims == 2
+    assert v.num_parts() == 6
+    # row-major walk over the strided grid
+    assert v.get_device_id((0, 0)) == 4
+    assert v.get_device_id((1, 2)) == 4 + 3 + 2
+    assert v.device_ids() == [4, 5, 6, 7, 8, 9]
+
+
+def test_machine_view_strided():
+    # one proc per node across 4 nodes of 8 procs: stride 8
+    v = make_1d_view(start=3, degree=4, stride=8)
+    assert v.device_ids() == [3, 11, 19, 27]
+
+
+def test_machine_view_hash_distinguishes():
+    a = make_1d_view(0, 4)
+    b = make_1d_view(0, 4, stride=2)
+    c = make_1d_view(1, 4)
+    assert len({a.hash(), b.hash(), c.hash()}) == 3
+    assert a.hash() == make_1d_view(0, 4).hash()
+
+
+# ---------------------------------------------------------------------------
+# MachineResource validity (reference: tests/unit/test_parallel_config.cc —
+# the device-assignment validity rules; our MachineView subsumes the legacy
+# ParallelConfig device_ids array)
+# ---------------------------------------------------------------------------
+
+def test_machine_resource_validity():
+    # 2 nodes x 4 procs, all available
+    m = MachineResource(num_nodes=2, all_procs_per_node=4,
+                        available_procs_per_node=4)
+    assert m.num_procs() == 8
+    assert m.is_valid_machine_view(make_1d_view(0, 8))
+    assert not m.is_valid_machine_view(make_1d_view(5, 4))  # runs past dev 7
+
+
+def test_machine_resource_restricted_procs():
+    # only 2 of 4 procs per node usable (horizontal search split)
+    m = MachineResource(num_nodes=2, all_procs_per_node=4,
+                        available_procs_per_node=2)
+    assert m.num_procs() == 4
+    assert m.is_valid_machine_view(make_1d_view(0, 2))
+    # local proc id 2 exceeds available 2
+    assert not m.is_valid_machine_view(make_1d_view(2, 2))
+    # strided inter-node view on local proc 1 is fine
+    assert m.is_valid_machine_view(make_1d_view(1, 2, stride=4))
+
+
+def test_machine_resource_node_offset():
+    m = MachineResource(num_nodes=1, all_procs_per_node=4,
+                        available_procs_per_node=4, start_node_id=1)
+    assert m.is_valid_machine_view(make_1d_view(4, 4))
+    assert not m.is_valid_machine_view(make_1d_view(0, 4))
+
+
+def test_enumerate_machine_views_all_valid():
+    """Every pre-registered view must be valid on its machine and unique
+    (reference: register_all_machine_views)."""
+    m = MachineResource(num_nodes=2, all_procs_per_node=4,
+                        available_procs_per_node=4)
+    views = enumerate_machine_views(2, 4)
+    assert views, "no views enumerated"
+    hashes = [v.hash() for v in views]
+    assert len(hashes) == len(set(hashes)), "duplicate views"
+    assert all(m.is_valid_machine_view(v) for v in views)
+    # full-machine data-parallel view must be among them
+    assert any(v.num_parts() == 8 for v in views)
+
+
+# ---------------------------------------------------------------------------
+# Dot export (reference: tests/unit/test_dot.cc)
+# ---------------------------------------------------------------------------
+
+def test_graph_dot_export():
+    from flexflow_tpu import DataType, FFConfig, FFModel
+    from flexflow_tpu.pcg.lowering import layers_to_pcg
+
+    cfg = FFConfig()
+    cfg.batch_size = 4
+    model = FFModel(cfg)
+    x = model.create_tensor((4, 8), DataType.DT_FLOAT)
+    t = model.dense(x, 16)
+    model.relu(t)
+    graph, _ = layers_to_pcg(model.layers)
+    dot = graph.export_dot()
+    assert dot.startswith("digraph")
+    assert dot.count("->") == len(graph.ops) - 1  # a chain
+    for op in graph.ops:
+        assert f"n{op.guid}" in dot
+
+
+# ---------------------------------------------------------------------------
+# Random strategy utilities (reference: tests/unit/test_random_utils.cc —
+# validity of random choices; here: the MCMC rewrite's view sampling)
+# ---------------------------------------------------------------------------
+
+def test_mcmc_random_views_are_valid():
+    from flexflow_tpu import DataType, FFConfig, FFModel
+    from flexflow_tpu.pcg.lowering import layers_to_pcg
+    from flexflow_tpu.search.machine_model import MachineModel
+    from flexflow_tpu.search.mcmc import MCMCSearch
+
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    model = FFModel(cfg)
+    x = model.create_tensor((8, 16), DataType.DT_FLOAT)
+    t = model.dense(x, 32)
+    model.dense(t, 8)
+    graph, _ = layers_to_pcg(model.layers)
+
+    machine = MachineModel(num_nodes=1, workers_per_node=8)
+    search = MCMCSearch(machine, seed=7)
+    m = MachineResource(num_nodes=1, all_procs_per_node=8,
+                        available_procs_per_node=8)
+    rng = random.Random(3)
+    for op in graph.ops:
+        views = search._valid_views(op, machine)
+        assert views, f"no valid views for {op.name}"
+        for _ in range(5):
+            v = rng.choice(views)
+            assert m.is_valid_machine_view(v)
+            # degree must evenly divide the op's batch dim
+            assert 8 % v.num_parts() == 0 or v.num_parts() == 1
