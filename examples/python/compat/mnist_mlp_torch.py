@@ -1,0 +1,65 @@
+"""PyTorch-FX MNIST MLP through the `flexflow` compat package (reference:
+examples/python/pytorch/mnist_mlp.py + mnist_mlp_torch.py — export the torch
+module to the flexflow file format, then rebuild with
+PyTorchModel.file_to_ff and train)."""
+import os
+import tempfile
+
+import numpy as np
+import torch.nn as nn
+
+from flexflow.core import *  # noqa: F401,F403
+from flexflow.torch.model import PyTorchModel, torch_to_flexflow
+from flexflow.keras.datasets import mnist
+
+
+class MLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.linear1 = nn.Linear(784, 512)
+        self.linear2 = nn.Linear(512, 512)
+        self.linear3 = nn.Linear(512, 10)
+        self.relu = nn.ReLU()
+        self.softmax = nn.Softmax(dim=-1)
+
+    def forward(self, x):
+        y = self.relu(self.linear1(x))
+        y = self.relu(self.linear2(y))
+        return self.softmax(self.linear3(y))
+
+
+def top_level_task(epochs=1, n_samples=4096):
+    # reference mnist_mlp_torch.py: torch_to_flexflow(model, "mlp.ff")
+    path = os.path.join(tempfile.gettempdir(), "mlp.ff")
+    torch_to_flexflow(MLP(), path)
+
+    ffconfig = FFConfig()
+    ffmodel = FFModel(ffconfig)
+    input_tensor = ffmodel.create_tensor(
+        [ffconfig.batch_size, 784], DataType.DT_FLOAT)
+
+    # reference mnist_mlp.py: PyTorchModel.file_to_ff("mlp.ff", ...)
+    output_tensors = PyTorchModel.file_to_ff(path, ffmodel, [input_tensor])
+
+    ffoptimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.optimizer = ffoptimizer
+    ffmodel.compile(
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY,
+                 MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY])
+    label_tensor = ffmodel.label_tensor
+
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train[:n_samples].reshape(n_samples, 784).astype('float32') / 255
+    y_train = y_train[:n_samples].astype('int32').reshape(-1, 1)
+
+    dataloader_input = ffmodel.create_data_loader(input_tensor, x_train)
+    dataloader_label = ffmodel.create_data_loader(label_tensor, y_train)
+    ffmodel.init_layers()
+    ffmodel.fit(x=dataloader_input, y=dataloader_label, epochs=epochs)
+    return ffmodel.get_perf_metrics().get_accuracy()
+
+
+if __name__ == "__main__":
+    print("mnist mlp torch (compat)")
+    top_level_task()

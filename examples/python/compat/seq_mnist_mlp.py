@@ -1,0 +1,37 @@
+"""Keras-Sequential MNIST MLP through the `flexflow` compat package
+(reference: examples/python/keras/seq_mnist_mlp.py — same imports and
+training flow)."""
+from flexflow.keras.models import Sequential
+from flexflow.keras.layers import Flatten, Dense, Activation, Dropout  # noqa: F401
+import flexflow.keras.optimizers
+from flexflow.keras.callbacks import Callback, VerifyMetrics, EpochVerifyMetrics  # noqa: F401
+from flexflow.keras.initializers import GlorotUniform, Zeros  # noqa: F401
+from flexflow.keras.datasets import mnist
+
+import flexflow.core as ff  # noqa: F401
+import numpy as np
+from accuracy import ModelAccuracy  # noqa: F401
+
+
+def top_level_task(epochs=1, n_samples=4096):
+    (x_train, y_train), (x_test, y_test) = mnist.load_data()
+    x_train = x_train[:n_samples].reshape(n_samples, 784).astype('float32') / 255
+    y_train = y_train[:n_samples].astype('int32').reshape(-1, 1)
+
+    model = Sequential()
+    model.add(Dense(512, input_shape=(784,), activation="relu",
+                    kernel_initializer=GlorotUniform(12)))
+    model.add(Dense(512, activation="relu"))
+    model.add(Dense(10))
+    model.add(Activation("softmax"))
+
+    opt = flexflow.keras.optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss='sparse_categorical_crossentropy',
+                  metrics=['accuracy', 'sparse_categorical_crossentropy'])
+    pm = model.fit(x_train, y_train, epochs=epochs)
+    return pm.get_accuracy()
+
+
+if __name__ == "__main__":
+    print("Sequential mnist mlp (compat)")
+    top_level_task()

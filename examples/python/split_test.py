@@ -1,0 +1,55 @@
+"""Branching-graph search exercise (reference: examples/cpp/split_test/
+split_test.cc — a diamond MLP whose parallel branches stress the search's
+horizontal (nonsequence) split path, graph.cc find_optimal_nonsequence_
+graph_time).
+
+Usage:
+  python examples/python/split_test.py --budget 10     # Unity search
+  python examples/python/split_test.py --only-data-parallel
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+
+
+def build(model, batch, dims=(256, 128, 64, 32)):
+    from flexflow_tpu.ff_types import ActiMode, DataType
+
+    inp = model.create_tensor([batch, dims[0]], DataType.DT_FLOAT)
+    t = model.dense(inp, dims[1])
+    t = model.relu(t)
+    t1 = model.dense(t, dims[2])
+    t2 = model.dense(t, dims[2])
+    t = model.add(t1, t2)
+    t = model.relu(t)
+    t1 = model.dense(t, dims[3])
+    t2 = model.dense(t, dims[3])
+    t = model.add(t1, t2)
+    t = model.relu(t)
+    t = model.softmax(t)
+    return inp, t
+
+
+def main():
+    ffconfig = FFConfig()
+    model = FFModel(ffconfig)
+    inp, out = build(model, ffconfig.batch_size)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.001),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY,
+                 MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    n = ffconfig.batch_size * 4
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, inp.dims[1]), dtype=np.float32)
+    y = rng.integers(0, out.dims[-1], (n, 1)).astype(np.int32)
+    model.fit(x, y, epochs=ffconfig.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Conv-stack search exercise (reference: examples/cpp/split_test_2/
+split_test_2.cc — a strided conv pyramid compiled through the substitution
+search with an explicit budget, exercising GraphSearchHelper.graph_optimize
+directly).
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.ff_types import DataType
+
+
+def main():
+    ffconfig = FFConfig()
+    if ffconfig.search_budget < 0:
+        ffconfig.search_budget = 10
+    model = FFModel(ffconfig)
+    inp = model.create_tensor([ffconfig.batch_size, 4, 32, 32], DataType.DT_FLOAT)
+    t = inp
+    for _ in range(3):
+        t = model.conv2d(t, 8, 3, 3, 2, 2, 0, 0)
+    t = model.flat(t)
+    t = model.relu(t)
+    t = model.softmax(t)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.001),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY,
+                 MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    n = ffconfig.batch_size * 2
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, 4, 32, 32), dtype=np.float32)
+    y = rng.integers(0, t.dims[-1], (n, 1)).astype(np.int32)
+    model.fit(x, y, epochs=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,15 @@
+"""Drop-in `flexflow` namespace for reference-script compatibility.
+
+The reference's Python package is `flexflow` (python/flexflow/__init__.py)
+with `flexflow.core`, `flexflow.keras`, `flexflow.torch`, `flexflow.onnx`
+subpackages. This shim maps that exact import surface onto flexflow_tpu, so
+scripts written for the reference —
+
+    from flexflow.core import *
+    from flexflow.keras.models import Sequential
+    from flexflow.torch.model import PyTorchModel
+
+— run unchanged on the TPU-native framework. No Legion bootstrap is needed:
+plain `python script.py` works (the reference's FF_USE_NATIVE_PYTHON mode).
+"""
+from flexflow_tpu import __version__  # noqa: F401

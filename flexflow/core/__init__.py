@@ -1,0 +1,82 @@
+"""`flexflow.core` — the reference's cffi star-import surface
+(python/flexflow/core/flexflow_cffi.py via core/__init__.py) mapped onto
+flexflow_tpu.
+
+Covers the names reference native-python examples use with
+`from flexflow.core import *`: FFConfig, FFModel, Tensor, SingleDataLoader,
+optimizers (with the reference's `SGDOptimizer(ffmodel, lr)` signatures),
+initializers, and every enum. The reference's Legion bootstrap
+(flexflow_top.py) has no equivalent here — jax owns process/device setup.
+"""
+from __future__ import annotations
+
+from flexflow_tpu import (  # noqa: F401
+    ActiMode,
+    AggrMode,
+    BatchScheduler,
+    CompMode,
+    ConstantInitializer,
+    DataType,
+    FFConfig,
+    FFIterationConfig,
+    FFModel,
+    GlorotUniformInitializer,
+    Initializer,
+    Layer,
+    LossType,
+    Metrics,
+    MetricsType,
+    NormInitializer,
+    OneInitializer,
+    OperatorType,
+    Optimizer,
+    ParameterSyncType,
+    PerfMetrics,
+    PoolType,
+    SingleDataLoader,
+    Tensor,
+    UniformInitializer,
+    ZeroInitializer,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from flexflow_tpu.core.optimizers import (
+    AdamOptimizer as _CoreAdam,
+    SGDOptimizer as _CoreSGD,
+)
+from flexflow_tpu.ff_types import RegularizerMode  # noqa: F401
+
+
+def _drop_ffmodel(args):
+    """The reference cffi optimizers take the FFModel as first arg
+    (flexflow_cffi.py SGDOptimizer(ffmodel, ...)); ours are model-free
+    dataclasses. Accept both calling conventions."""
+    if args and isinstance(args[0], FFModel):
+        return args[1:]
+    return args
+
+
+class SGDOptimizer(_CoreSGD):
+    """reference cffi: SGDOptimizer(ffmodel, lr, momentum, nesterov, wd)."""
+
+    def __init__(self, *args, **kw):
+        args = _drop_ffmodel(args)
+        super().__init__(*args, **kw)
+
+
+class AdamOptimizer(_CoreAdam):
+    """reference cffi: AdamOptimizer(ffmodel, alpha, beta1, beta2, wd, eps)."""
+
+    def __init__(self, *args, **kw):
+        args = _drop_ffmodel(args)
+        super().__init__(*args, **kw)
+
+
+def get_legion_runtime():  # pragma: no cover - parity stub
+    """Legion runtime handle (reference flexflow_cffi). No Legion here."""
+    return None
+
+
+def init_flexflow_runtime(*a, **kw):  # pragma: no cover - parity stub
+    """reference: starts the Legion runtime. jax needs no explicit start."""
+    return None

@@ -1,0 +1,38 @@
+"""`flexflow.keras` — reference Keras-compatible frontend namespace
+(python/flexflow/keras/__init__.py) mapped onto
+flexflow_tpu.frontends.keras."""
+from flexflow_tpu.frontends.keras import (  # noqa: F401
+    Activation,
+    Add,
+    AveragePooling2D,
+    BatchNormalization,
+    Concatenate,
+    Conv2D,
+    Dense,
+    Dropout,
+    Embedding,
+    Flatten,
+    Input,
+    LayerNormalization,
+    Maximum,
+    MaxPooling2D,
+    Minimum,
+    Model,
+    MultiHeadAttention,
+    Multiply,
+    Permute,
+    Reshape,
+    Sequential,
+    Subtract,
+)
+from . import (  # noqa: F401
+    callbacks,
+    datasets,
+    initializers,
+    layers,
+    losses,
+    metrics,
+    models,
+    optimizers,
+    regularizers,
+)

@@ -1,0 +1,2 @@
+"""Shim: reference python/flexflow/keras/callbacks.py surface."""
+from flexflow_tpu.frontends.keras.callbacks import *  # noqa: F401,F403

@@ -1,0 +1,10 @@
+"""Shim: reference python/flexflow/keras/datasets/ (mnist/cifar10/reuters).
+
+Synthetic deterministic datasets by default (zero-egress environments);
+shapes and dtypes match the Keras originals.
+"""
+from flexflow_tpu.frontends.keras.datasets import (  # noqa: F401
+    cifar10,
+    mnist,
+    reuters,
+)

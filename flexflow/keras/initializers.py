@@ -1,0 +1,2 @@
+"""Shim: reference python/flexflow/keras/initializers.py surface."""
+from flexflow_tpu.frontends.keras.initializers import *  # noqa: F401,F403

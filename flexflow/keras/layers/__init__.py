@@ -1,0 +1,2 @@
+"""Shim: reference python/flexflow/keras/layers/ (all layer classes)."""
+from flexflow_tpu.frontends.keras.layers import *  # noqa: F401,F403

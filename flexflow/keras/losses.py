@@ -1,0 +1,2 @@
+"""Shim: reference python/flexflow/keras/losses.py surface."""
+from flexflow_tpu.frontends.keras.losses import *  # noqa: F401,F403

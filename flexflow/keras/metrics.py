@@ -1,0 +1,2 @@
+"""Shim: reference python/flexflow/keras/metrics.py surface."""
+from flexflow_tpu.frontends.keras.metrics import *  # noqa: F401,F403

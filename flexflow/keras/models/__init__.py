@@ -1,0 +1,3 @@
+"""Shim: reference python/flexflow/keras/models/ (Model, Sequential)."""
+from flexflow_tpu.frontends.keras.models import Model, Sequential  # noqa: F401
+from flexflow_tpu.frontends.keras.layers import Input  # noqa: F401

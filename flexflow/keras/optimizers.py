@@ -1,0 +1,2 @@
+"""Shim: reference python/flexflow/keras/optimizers.py surface."""
+from flexflow_tpu.frontends.keras.optimizers import *  # noqa: F401,F403

@@ -1,0 +1,2 @@
+"""Shim: reference python/flexflow/keras/regularizers.py surface."""
+from flexflow_tpu.frontends.keras.regularizers import *  # noqa: F401,F403

@@ -1,0 +1,3 @@
+"""Shim: reference python/flexflow/onnx/ (ONNX frontend)."""
+from . import model  # noqa: F401
+from flexflow_tpu.frontends.onnx.model import ONNXModel  # noqa: F401

@@ -1,0 +1,2 @@
+"""Shim: reference python/flexflow/onnx/model.py (ONNXModel)."""
+from flexflow_tpu.frontends.onnx.model import *  # noqa: F401,F403

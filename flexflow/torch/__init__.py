@@ -1,0 +1,3 @@
+"""Shim: reference python/flexflow/torch/ (PyTorch-FX frontend)."""
+from . import model  # noqa: F401
+from flexflow_tpu.frontends.torch.model import PyTorchModel  # noqa: F401

@@ -1,0 +1,2 @@
+"""Shim: reference python/flexflow/torch/model.py (PyTorchModel et al.)."""
+from flexflow_tpu.frontends.torch.model import *  # noqa: F401,F403

@@ -160,6 +160,23 @@ class FFConfig:
                 pass
             i += 1
 
+    # snake_case aliases matching the reference cffi property names
+    # (flexflow_cffi.py:526 FFConfig.batch_size/workers_per_node/num_nodes),
+    # so `from flexflow.core import *` scripts read config fields verbatim.
+    @property
+    def workers_per_node(self) -> int:
+        if self.workersPerNode > 0:
+            return self.workersPerNode
+        return len(jax.devices())
+
+    @property
+    def num_nodes(self) -> int:
+        return self.numNodes
+
+    @property
+    def cpus_per_node(self) -> int:
+        return self.cpusPerNode
+
     @property
     def numWorkers(self) -> int:
         """Total chips in the (possibly hypothetical) machine."""

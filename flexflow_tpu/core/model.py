@@ -28,6 +28,7 @@ from ..ff_types import (
     MetricsType,
     OperatorType,
     PoolType,
+    RegularizerMode,
 )
 from ..ops.attention import MultiHeadAttentionParams
 from ..ops.batch_matmul import BatchMatmulParams
@@ -194,11 +195,14 @@ class FFModel:
         kernel_regularizer=None,
         name: str = "",
     ) -> Tensor:
+        reg_type, reg_lambda = _to_regularizer(kernel_regularizer)
         p = LinearParams(
             out_channels=out_dim,
             use_bias=use_bias,
             activation=_to_acti(activation),
             data_type=_to_dt(datatype),
+            kernel_reg_lambda=reg_lambda,
+            kernel_reg_type=reg_type,
         )
         return self._add_layer(
             OperatorType.OP_LINEAR,
@@ -813,6 +817,7 @@ class FFModel:
         verbose: bool = True,
     ):
         assert self.executor is not None, "call compile() first"
+        x, y = _unwrap_loaders(x, y)
         xs = x if isinstance(x, (list, tuple)) else [x]
         bs = batch_size or self.config.batch_size
         ep = epochs or self.config.epochs
@@ -880,6 +885,7 @@ class FFModel:
 
     def eval(self, x=None, y=None, batch_size: Optional[int] = None):
         assert self.executor is not None
+        x, y = _unwrap_loaders(x, y)
         xs = x if isinstance(x, (list, tuple)) else [x]
         bs = batch_size or self.config.batch_size
         step_fn = self.executor.build_eval_step()
@@ -1057,6 +1063,44 @@ class FFModel:
         dl = SingleDataLoader(self, batch_tensor, full_array)
         self._dataloaders.append(dl)
         return dl
+
+
+def _unwrap_loaders(x, y):
+    """fit/eval accept SingleDataLoader objects for x/y like the reference
+    (flexflow_cffi.py fit(x=dataloader_input, y=dataloader_label)); unwrap
+    them to their backing arrays."""
+    from .dataloader import SingleDataLoader
+
+    def unwrap(v):
+        if isinstance(v, SingleDataLoader):
+            return v.full_array[: v.num_samples]
+        return v
+
+    if isinstance(x, (list, tuple)):
+        x = [unwrap(v) for v in x]
+    else:
+        x = unwrap(x)
+    return x, unwrap(y)
+
+
+def _to_regularizer(reg):
+    """Normalize a kernel_regularizer spec to (RegularizerMode, lambda).
+
+    Accepts keras-style objects with `.type`/`._lambda` (frontends/keras/
+    regularizers.py), ("l1"|"l2", lam) tuples, or a bare float (treated as L2
+    like the reference's kernel_reg_lambda, linear.cc:41)."""
+    if reg is None:
+        return RegularizerMode.REG_MODE_NONE, 0.0
+    if isinstance(reg, (int, float)):
+        return RegularizerMode.REG_MODE_L2, float(reg)
+    if isinstance(reg, tuple):
+        kind, lam = reg
+        mode = {
+            "l1": RegularizerMode.REG_MODE_L1,
+            "l2": RegularizerMode.REG_MODE_L2,
+        }[str(kind).lower()]
+        return mode, float(lam)
+    return RegularizerMode(reg.type), float(reg._lambda)
 
 
 def _to_dt(dt) -> DataType:

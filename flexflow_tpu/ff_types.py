@@ -92,6 +92,15 @@ class AggrMode(enum.IntEnum):
     AGGR_MODE_AVG = 22
 
 
+class RegularizerMode(enum.IntEnum):
+    """Weight regularizers (reference: python/flexflow/type.py:12-15;
+    linear_kernels.cu:333-350 applies L2 as grad += lambda * w)."""
+
+    REG_MODE_NONE = 25
+    REG_MODE_L1 = 26
+    REG_MODE_L2 = 27
+
+
 class PoolType(enum.IntEnum):
     """Pooling modes (reference: ffconst.h:37-40)."""
 

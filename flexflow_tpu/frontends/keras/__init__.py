@@ -1,5 +1,14 @@
 """Keras-compatible frontend (reference: python/flexflow/keras/)."""
-from . import callbacks, datasets, layers, optimizers  # noqa: F401
+from . import (  # noqa: F401
+    callbacks,
+    datasets,
+    initializers,
+    layers,
+    losses,
+    metrics,
+    optimizers,
+    regularizers,
+)
 from .layers import (  # noqa: F401
     Permute,
     Activation,

@@ -35,10 +35,12 @@ class KerasTensor:
 class Layer:
     """Base deferred layer (reference: keras/layers/base_layer.py)."""
 
-    def __init__(self, name: Optional[str] = None, **kwargs):
+    def __init__(self, name: Optional[str] = None, input_shape=None, **kwargs):
         self.name = name or f"{type(self).__name__.lower()}_{next(_uid)}"
         self.inbound: List[KerasTensor] = []
         self.outputs: List[KerasTensor] = []
+        # keras-style: first Sequential layer may declare its input shape
+        self.input_shape = tuple(input_shape) if input_shape is not None else None
         self._ff_tensors = None  # set during model build
 
     def __call__(self, inputs):
@@ -76,6 +78,14 @@ def Input(shape: Sequence[int], dtype=DataType.DT_FLOAT, name: str = "") -> Kera
     return t
 
 
+def _init_or_none(init):
+    """Map keras initializer specs to core ones. `DefaultInitializer` (and
+    the stock string defaults) mean "layer default" → None."""
+    if init is None or type(init).__name__ == "DefaultInitializer":
+        return None  # the layer's WeightSpec default (glorot kernel, zero bias)
+    return init  # strings resolve via core get_initializer (_BY_NAME)
+
+
 def _acti(activation) -> ActiMode:
     if activation in (None, "linear", "none"):
         return ActiMode.AC_MODE_NONE
@@ -93,13 +103,14 @@ def _acti(activation) -> ActiMode:
 class Dense(Layer):
     def __init__(self, units: int, activation=None, use_bias=True,
                  kernel_initializer="glorot_uniform", bias_initializer="zeros",
-                 **kw):
+                 kernel_regularizer=None, **kw):
         super().__init__(**kw)
         self.units = units
         self.activation = activation
         self.use_bias = use_bias
         self.kernel_initializer = kernel_initializer
         self.bias_initializer = bias_initializer
+        self.kernel_regularizer = kernel_regularizer
 
     def compute_output_shape(self, shapes):
         return [tuple(shapes[0][:-1]) + (self.units,)]
@@ -112,6 +123,9 @@ class Dense(Layer):
             self.units,
             _acti(None if softmax else act),
             use_bias=self.use_bias,
+            kernel_initializer=_init_or_none(self.kernel_initializer),
+            bias_initializer=_init_or_none(self.bias_initializer),
+            kernel_regularizer=self.kernel_regularizer,
             name=self.name,
         )
         if softmax:

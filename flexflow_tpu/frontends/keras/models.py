@@ -102,8 +102,22 @@ class Model:
         core_opt = (
             optimizer.to_core() if isinstance(optimizer, KerasOptimizer) else optimizer
         )
-        loss_type = _LOSS_MAP[loss] if isinstance(loss, str) else loss
-        ms = [(_METRIC_MAP[m] if isinstance(m, str) else m) for m in metrics]
+        # strings, LossType/MetricsType enums, or keras loss/metric objects
+        # carrying `.type` (losses.py / metrics.py) are all accepted
+        if isinstance(loss, str):
+            loss_type = _LOSS_MAP[loss]
+        elif hasattr(loss, "type") and loss.type is not None:
+            loss_type = loss.type
+        else:
+            loss_type = loss
+        ms = []
+        for m in metrics:
+            if isinstance(m, str):
+                ms.append(_METRIC_MAP[m])
+            elif hasattr(m, "type") and not isinstance(m, MetricsType):
+                ms.append(m.type)
+            else:
+                ms.append(m)
         ffmodel.compile(optimizer=core_opt, loss_type=loss_type, metrics=ms)
         return self
 

@@ -7,10 +7,18 @@ each fx node onto FFModel ops (per-node `to_ff`, model.py:2496). Weights are
 transferred from the torch module so imported models start from the same
 parameters (the reference does this via set_tensor after compile; we stage
 them and FFModel applies at compile).
+
+File format (reference: torch_to_flexflow export + PyTorchModel.file_to_ff
+import, model.py:2540): `torch_to_flexflow(module, path)` serializes the
+traced graph as JSON-lines — one record per fx node, with module configs
+extracted so replay needs no torch — and `PyTorchModel.file_to_ff(path,
+ffmodel, input_tensors)` rebuilds the FFModel ops from the file. Both paths
+share one builder table (`_MODULE_BUILDERS`), so live trace and file replay
+cannot drift apart.
 """
 from __future__ import annotations
 
-import operator
+import json
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -24,6 +32,196 @@ try:
     HAS_TORCH = True
 except Exception:  # pragma: no cover
     HAS_TORCH = False
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+# ---------------------------------------------------------------------------
+# Module specs: one entry per supported nn.Module type.
+#   export(mod)             -> JSON-serializable config dict
+#   build(ff, cfg, args, name) -> output Tensor(s)
+#   weights(mod)            -> [np arrays] in our layout, or None
+# ---------------------------------------------------------------------------
+
+def _linear_export(mod):
+    return {"out_features": mod.out_features, "bias": mod.bias is not None}
+
+
+def _linear_build(ff, cfg, args, name):
+    return ff.dense(args[0], cfg["out_features"], use_bias=cfg["bias"], name=name)
+
+
+def _linear_weights(mod):
+    w = [mod.weight.detach().numpy().T]  # torch (out,in) -> ours (in,out)
+    if mod.bias is not None:
+        w.append(mod.bias.detach().numpy())
+    return w
+
+
+def _conv2d_export(mod):
+    return {
+        "out_channels": mod.out_channels,
+        "kernel": list(_pair(mod.kernel_size)),
+        "stride": list(_pair(mod.stride)),
+        "padding": list(_pair(mod.padding)),
+        "groups": mod.groups,
+        "bias": mod.bias is not None,
+    }
+
+
+def _conv2d_build(ff, cfg, args, name):
+    k, s, p = cfg["kernel"], cfg["stride"], cfg["padding"]
+    return ff.conv2d(
+        args[0], cfg["out_channels"], k[0], k[1], s[0], s[1], p[0], p[1],
+        groups=cfg["groups"], use_bias=cfg["bias"], name=name,
+    )
+
+
+def _conv2d_weights(mod):
+    w = [mod.weight.detach().numpy()]
+    if mod.bias is not None:
+        w.append(mod.bias.detach().numpy())
+    return w
+
+
+def _pool_export(mod):
+    k = _pair(mod.kernel_size)
+    s = _pair(mod.stride) if mod.stride is not None else k
+    return {"kernel": list(k), "stride": list(s),
+            "padding": list(_pair(mod.padding))}
+
+
+def _maxpool_build(ff, cfg, args, name):
+    k, s, p = cfg["kernel"], cfg["stride"], cfg["padding"]
+    return ff.pool2d(args[0], k[0], k[1], s[0], s[1], p[0], p[1],
+                     PoolType.POOL_MAX, name=name)
+
+
+def _avgpool_build(ff, cfg, args, name):
+    k, s, p = cfg["kernel"], cfg["stride"], cfg["padding"]
+    return ff.pool2d(args[0], k[0], k[1], s[0], s[1], p[0], p[1],
+                     PoolType.POOL_AVG, name=name)
+
+
+def _adaptive_export(mod):
+    return {"output_size": list(_pair(mod.output_size))}
+
+
+def _adaptive_build(ff, cfg, args, name):
+    x = args[0]
+    h, w = x.dims[2], x.dims[3]
+    osz = tuple(cfg["output_size"])
+    if osz == (1, 1):
+        return ff.pool2d(x, h, w, 1, 1, 0, 0, PoolType.POOL_AVG, name=name)
+    assert (h, w) == osz, "unsupported AdaptiveAvgPool2d size"
+    return x
+
+
+def _bn_export(mod):
+    return {}
+
+
+def _bn_build(ff, cfg, args, name):
+    return ff.batch_norm(args[0], relu=False, name=name)
+
+
+def _bn_weights(mod):
+    return [mod.weight.detach().numpy(), mod.bias.detach().numpy()]
+
+
+def _ln_export(mod):
+    return {"normalized_shape": list(mod.normalized_shape), "eps": mod.eps,
+            "affine": mod.elementwise_affine}
+
+
+def _ln_build(ff, cfg, args, name):
+    return ff.layer_norm(
+        args[0], axes=tuple(range(-len(cfg["normalized_shape"]), 0)),
+        eps=cfg["eps"], name=name,
+    )
+
+
+def _ln_weights(mod):
+    if not mod.elementwise_affine:
+        return None
+    return [mod.weight.detach().numpy(), mod.bias.detach().numpy()]
+
+
+def _emb_export(mod):
+    return {"num": mod.num_embeddings, "dim": mod.embedding_dim}
+
+
+def _emb_build(ff, cfg, args, name):
+    return ff.embedding(args[0], cfg["num"], cfg["dim"],
+                        AggrMode.AGGR_MODE_NONE, name=name)
+
+
+def _emb_weights(mod):
+    return [mod.weight.detach().numpy()]
+
+
+def _act_build(method):
+    def build(ff, cfg, args, name):
+        return getattr(ff, method)(args[0], name=name)
+
+    return build
+
+
+def _softmax_export(mod):
+    return {"dim": mod.dim if mod.dim is not None else -1}
+
+
+def _softmax_build(ff, cfg, args, name):
+    return ff.softmax(args[0], axis=cfg["dim"], name=name)
+
+
+def _dropout_export(mod):
+    return {"p": mod.p}
+
+
+def _dropout_build(ff, cfg, args, name):
+    return ff.dropout(args[0], cfg["p"], name=name)
+
+
+def _mha_export(mod):
+    return {"embed_dim": mod.embed_dim, "num_heads": mod.num_heads,
+            "dropout": mod.dropout, "bias": mod.in_proj_bias is not None}
+
+
+def _mha_build(ff, cfg, args, name):
+    return ff.multihead_attention(
+        args[0], args[1], args[2], cfg["embed_dim"], cfg["num_heads"],
+        dropout=cfg["dropout"], bias=cfg["bias"], name=name,
+    )
+
+
+def _none_export(mod):
+    return {}
+
+
+# type name -> (export, build, weights|None)
+_MODULE_BUILDERS = {
+    "Linear": (_linear_export, _linear_build, _linear_weights),
+    "Conv2d": (_conv2d_export, _conv2d_build, _conv2d_weights),
+    "MaxPool2d": (_pool_export, _maxpool_build, None),
+    "AvgPool2d": (_pool_export, _avgpool_build, None),
+    "AdaptiveAvgPool2d": (_adaptive_export, _adaptive_build, None),
+    "BatchNorm2d": (_bn_export, _bn_build, _bn_weights),
+    "LayerNorm": (_ln_export, _ln_build, _ln_weights),
+    "Embedding": (_emb_export, _emb_build, _emb_weights),
+    "ReLU": (_none_export, _act_build("relu"), None),
+    "GELU": (_none_export, _act_build("gelu"), None),
+    "Sigmoid": (_none_export, _act_build("sigmoid"), None),
+    "Tanh": (_none_export, _act_build("tanh"), None),
+    "ELU": (_none_export, _act_build("elu"), None),
+    "Identity": (_none_export, _act_build("identity"), None),
+    "Flatten": (_none_export, lambda ff, c, a, n: ff.flat(a[0], name=n), None),
+    "Softmax": (_softmax_export, _softmax_build, None),
+    "Dropout": (_dropout_export, _dropout_build, None),
+    "MultiheadAttention": (_mha_export, _mha_build, None),
+}
 
 
 class PyTorchModel:
@@ -55,6 +253,10 @@ class PyTorchModel:
         outputs: List = []
 
         for node in traced.graph.nodes:
+            if node.op != "placeholder" and node.op != "output" and not node.users:
+                # dead value (e.g. the discarded attention-weights half of
+                # `out, _ = mha(...)`): nothing consumes it, skip
+                continue
             if node.op == "placeholder":
                 env[node.name] = inputs.pop(0)
             elif node.op == "call_module":
@@ -87,100 +289,17 @@ class PyTorchModel:
 
     # -- modules ---------------------------------------------------------
     def _module_to_ff(self, ff, mod, args, node):
-        nn = torch.nn
-        x = args[0]
-        name = node.name
-        if isinstance(mod, nn.Linear):
-            out = ff.dense(x, mod.out_features, use_bias=mod.bias is not None,
-                           name=name)
-            w = [mod.weight.detach().numpy().T]  # torch (out,in) -> ours (in,out)
-            if mod.bias is not None:
-                w.append(mod.bias.detach().numpy())
-            self._weight_loads.append((ff.layers[-1], w))
-            return out
-        if isinstance(mod, nn.Conv2d):
-            out = ff.conv2d(
-                x, mod.out_channels, mod.kernel_size[0], mod.kernel_size[1],
-                mod.stride[0], mod.stride[1], mod.padding[0], mod.padding[1],
-                groups=mod.groups, use_bias=mod.bias is not None, name=name,
-            )
-            w = [mod.weight.detach().numpy()]
-            if mod.bias is not None:
-                w.append(mod.bias.detach().numpy())
-            self._weight_loads.append((ff.layers[-1], w))
-            return out
-        if isinstance(mod, nn.MaxPool2d):
-            k = mod.kernel_size if isinstance(mod.kernel_size, tuple) else (mod.kernel_size,) * 2
-            s = mod.stride if isinstance(mod.stride, tuple) else (mod.stride or k[0],) * 2
-            p = mod.padding if isinstance(mod.padding, tuple) else (mod.padding,) * 2
-            return ff.pool2d(x, k[0], k[1], s[0], s[1], p[0], p[1],
-                             PoolType.POOL_MAX, name=name)
-        if isinstance(mod, nn.AvgPool2d):
-            k = mod.kernel_size if isinstance(mod.kernel_size, tuple) else (mod.kernel_size,) * 2
-            s = mod.stride if isinstance(mod.stride, tuple) else (mod.stride or k[0],) * 2
-            p = mod.padding if isinstance(mod.padding, tuple) else (mod.padding,) * 2
-            return ff.pool2d(x, k[0], k[1], s[0], s[1], p[0], p[1],
-                             PoolType.POOL_AVG, name=name)
-        if isinstance(mod, nn.AdaptiveAvgPool2d):
-            # only output_size (1,1) or same-size supported, like reference
-            h, w_ = x.dims[2], x.dims[3]
-            osz = mod.output_size if isinstance(mod.output_size, tuple) else (mod.output_size,) * 2
-            if osz == (1, 1):
-                return ff.pool2d(x, h, w_, 1, 1, 0, 0, PoolType.POOL_AVG, name=name)
-            assert (h, w_) == osz, "unsupported AdaptiveAvgPool2d size"
-            return x
-        if isinstance(mod, nn.BatchNorm2d):
-            out = ff.batch_norm(x, relu=False, name=name)
-            self._weight_loads.append((
-                ff.layers[-1],
-                [mod.weight.detach().numpy(), mod.bias.detach().numpy()],
-            ))
-            return out
-        if isinstance(mod, nn.LayerNorm):
-            out = ff.layer_norm(
-                x, axes=tuple(range(-len(mod.normalized_shape), 0)),
-                eps=mod.eps, name=name,
-            )
-            if mod.elementwise_affine:
-                self._weight_loads.append((
-                    ff.layers[-1],
-                    [mod.weight.detach().numpy(), mod.bias.detach().numpy()],
-                ))
-            return out
-        if isinstance(mod, nn.Embedding):
-            out = ff.embedding(x, mod.num_embeddings, mod.embedding_dim,
-                               AggrMode.AGGR_MODE_NONE, name=name)
-            self._weight_loads.append(
-                (ff.layers[-1], [mod.weight.detach().numpy()])
-            )
-            return out
-        if isinstance(mod, nn.ReLU):
-            return ff.relu(x, name=name)
-        if isinstance(mod, nn.GELU):
-            return ff.gelu(x, name=name)
-        if isinstance(mod, nn.Sigmoid):
-            return ff.sigmoid(x, name=name)
-        if isinstance(mod, nn.Tanh):
-            return ff.tanh(x, name=name)
-        if isinstance(mod, nn.ELU):
-            return ff.elu(x, name=name)
-        if isinstance(mod, nn.Softmax):
-            return ff.softmax(x, axis=mod.dim if mod.dim is not None else -1, name=name)
-        if isinstance(mod, nn.Dropout):
-            return ff.dropout(x, mod.p, name=name)
-        if isinstance(mod, nn.Flatten):
-            return ff.flat(x, name=name)
-        if isinstance(mod, nn.Identity):
-            return ff.identity(x, name=name)
-        if isinstance(mod, nn.MultiheadAttention):
-            q, k, v = args[0], args[1], args[2]
-            out = ff.multihead_attention(
-                q, k, v, mod.embed_dim, mod.num_heads,
-                dropout=mod.dropout, bias=mod.in_proj_bias is not None,
-                name=name,
-            )
-            return out
-        raise NotImplementedError(f"torch module {type(mod).__name__}")
+        tname = type(mod).__name__
+        spec = _MODULE_BUILDERS.get(tname)
+        if spec is None:
+            raise NotImplementedError(f"torch module {tname}")
+        export, build, weights = spec
+        out = build(ff, export(mod), args, node.name)
+        if weights is not None:
+            w = weights(mod)
+            if w is not None:
+                self._weight_loads.append((ff.layers[-1], w))
+        return out
 
     # -- functions -------------------------------------------------------
     def _function_to_ff(self, ff, node, env):
@@ -188,95 +307,16 @@ class PyTorchModel:
             return env[a.name] if isinstance(a, torch.fx.Node) else a
 
         args = [val(a) for a in node.args]
-        fn = node.target
-        if fn in (operator.add, torch.add):
-            if _is_scalar(args[1]):
-                return ff.scalar_add(args[0], float(args[1]))
-            return ff.add(args[0], args[1])
-        if fn in (operator.sub, torch.sub):
-            if _is_scalar(args[1]):
-                return ff.scalar_sub(args[0], float(args[1]))
-            return ff.subtract(args[0], args[1])
-        if fn in (operator.mul, torch.mul):
-            if _is_scalar(args[1]):
-                return ff.scalar_multiply(args[0], float(args[1]))
-            return ff.multiply(args[0], args[1])
-        if fn in (operator.truediv, torch.div):
-            if _is_scalar(args[1]):
-                return ff.scalar_true_divide(args[0], float(args[1]))
-            return ff.divide(args[0], args[1])
-        if fn in (torch.relu, torch.nn.functional.relu):
-            return ff.relu(args[0])
-        if fn is torch.nn.functional.gelu:
-            return ff.gelu(args[0])
-        if fn in (torch.sigmoid, torch.nn.functional.sigmoid):
-            return ff.sigmoid(args[0])
-        if fn in (torch.tanh, torch.nn.functional.tanh):
-            return ff.tanh(args[0])
-        if fn in (torch.softmax, torch.nn.functional.softmax):
-            dim = node.kwargs.get("dim", args[1] if len(args) > 1 else -1)
-            return ff.softmax(args[0], axis=dim if dim is not None else -1)
-        if fn in (torch.cat, torch.concat):
-            dim = node.kwargs.get("dim", args[1] if len(args) > 1 else 0)
-            return ff.concat(list(args[0]), dim)
-        if fn in (torch.flatten,):
-            return ff.flat(args[0])
-        if fn in (torch.matmul, torch.bmm):
-            return ff.batch_matmul(args[0], args[1])
-        if fn is operator.getitem:
-            return args[0][args[1]]
-        if fn in (torch.exp,):
-            return ff.exp(args[0])
-        if fn in (torch.pow, operator.pow):
-            return ff.pow(args[0], float(args[1]))
-        if fn in (torch.mean,):
-            dims = node.kwargs.get("dim", args[1] if len(args) > 1 else None)
-            keep = node.kwargs.get("keepdim", False)
-            dims = [dims] if isinstance(dims, int) else list(dims)
-            return ff.mean(args[0], dims, keep)
-        if fn in (torch.transpose,):
-            d0, d1 = args[1], args[2]
-            perm = list(range(len(args[0].dims)))
-            perm[d0], perm[d1] = perm[d1], perm[d0]
-            return ff.transpose(args[0], perm)
-        raise NotImplementedError(f"torch function {fn}")
+        kwargs = {k: val(v) for k, v in node.kwargs.items()}
+        return _replay_fn(ff, _fn_name(node.target), args, kwargs)
 
     def _method_to_ff(self, ff, node, env):
         def val(a):
             return env[a.name] if isinstance(a, torch.fx.Node) else a
 
         args = [val(a) for a in node.args]
-        m = node.target
-        x = args[0]
-        if m in ("view", "reshape"):
-            shape = [int(s) if not isinstance(s, str) else -1 for s in args[1:]]
-            if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
-                shape = list(shape[0])
-            return ff.reshape(x, shape)
-        if m == "flatten":
-            return ff.flat(x)
-        if m == "permute":
-            perm = args[1] if isinstance(args[1], (list, tuple)) else args[1:]
-            return ff.transpose(x, list(perm))
-        if m == "transpose":
-            d0, d1 = args[1], args[2]
-            perm = list(range(len(x.dims)))
-            perm[d0], perm[d1] = perm[d1], perm[d0]
-            return ff.transpose(x, perm)
-        if m == "relu":
-            return ff.relu(x)
-        if m == "softmax":
-            return ff.softmax(x, axis=node.kwargs.get("dim", -1))
-        if m == "contiguous" or m == "detach" or m == "clone":
-            return x
-        if m == "size":
-            return x.dims if len(args) == 1 else x.dims[args[1]]
-        if m == "mean":
-            dims = args[1] if len(args) > 1 else node.kwargs.get("dim")
-            keep = node.kwargs.get("keepdim", False)
-            dims = [dims] if isinstance(dims, int) else list(dims)
-            return ff.mean(x, dims, keep)
-        raise NotImplementedError(f"torch method {m}")
+        kwargs = {k: val(v) for k, v in node.kwargs.items()}
+        return _replay_fn(ff, node.target, args, kwargs)
 
     # ------------------------------------------------------------------
     def load_weights(self, ffmodel=None):
@@ -286,16 +326,192 @@ class PyTorchModel:
             for wt, arr in zip(layer.weights, arrays):
                 wt.set_tensor(self._ffmodel, arr)
 
+    # -- file-format import (reference: model.py:2540 file_to_ff) -------
+    @staticmethod
+    def file_to_ff(filename: str, ffmodel, input_tensors: List) -> List:
+        """Rebuild FFModel ops from a `torch_to_flexflow` export. Works
+        without torch installed (the file carries extracted configs)."""
+        env: Dict[str, object] = {}
+        inputs = list(input_tensors)
+        outputs: List = []
+
+        def val(a):
+            if isinstance(a, dict) and "ref" in a:
+                return env[a["ref"]]
+            if isinstance(a, list):
+                return [val(x) for x in a]
+            return a
+
+        with open(filename) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                kind, name = rec["op"], rec["name"]
+                if kind == "placeholder":
+                    env[name] = inputs.pop(0)
+                elif kind == "call_module":
+                    spec = _MODULE_BUILDERS.get(rec["module_type"])
+                    if spec is None:
+                        raise NotImplementedError(
+                            f"module {rec['module_type']} in {filename}"
+                        )
+                    _, build, _ = spec
+                    args = [val(a) for a in rec["args"]]
+                    env[name] = build(ffmodel, rec["config"], args, name)
+                elif kind in ("call_function", "call_method"):
+                    env[name] = _replay_fn(
+                        ffmodel, rec["target"], [val(a) for a in rec["args"]],
+                        rec.get("kwargs", {}),
+                    )
+                elif kind == "output":
+                    for a in rec["args"]:
+                        outputs.append(val(a))
+        return outputs
+
+
+def _fn_name(fn) -> str:
+    """Normalize a live call_function target to its serialized name — the
+    same `fn.__name__` torch_to_flexflow writes, so live trace and file
+    replay go through the one `_replay_fn` dispatch."""
+    return fn if isinstance(fn, str) else fn.__name__
+
+
+def _replay_fn(ff, target: str, args, kwargs):
+    """The single call_function/call_method dispatch, shared by the live fx
+    walk (torch_to_ff) and file replay (file_to_ff). Targets are normalized
+    names (`operator.add`/`torch.add` → "add", methods keep their string)."""
+    x = args[0] if args else None
+    if target in ("add", "sub", "subtract", "mul", "multiply", "truediv",
+                  "div", "divide"):
+        key = {"subtract": "sub", "multiply": "mul", "divide": "div"}.get(
+            target, target
+        )
+        scalar_ops = {"add": ff.scalar_add, "sub": ff.scalar_sub,
+                      "mul": ff.scalar_multiply,
+                      "truediv": ff.scalar_true_divide,
+                      "div": ff.scalar_true_divide}
+        pair_ops = {"add": ff.add, "sub": ff.subtract, "mul": ff.multiply,
+                    "truediv": ff.divide, "div": ff.divide}
+        if _is_scalar(args[1]):
+            return scalar_ops[key](x, float(args[1]))
+        return pair_ops[key](x, args[1])
+    if target in ("relu", "gelu", "sigmoid", "tanh", "elu", "exp", "sin",
+                  "cos", "rsqrt", "sqrt", "log"):
+        return getattr(ff, target)(x)
+    if target == "softmax":
+        dim = kwargs.get("dim", args[1] if len(args) > 1 else -1)
+        return ff.softmax(x, axis=dim if dim is not None else -1)
+    if target in ("cat", "concat"):
+        dim = kwargs.get("dim", args[1] if len(args) > 1 else 0)
+        return ff.concat(list(args[0]), dim)
+    if target in ("flatten", "flat"):
+        return ff.flat(x)
+    if target in ("matmul", "bmm"):
+        return ff.batch_matmul(x, args[1])
+    if target == "pow":
+        return ff.pow(x, float(args[1]))
+    if target == "mean":
+        dims = kwargs.get("dim", args[1] if len(args) > 1 else None)
+        keep = kwargs.get("keepdim", False)
+        if dims is None:  # torch.mean(x): global mean over every dim
+            dims = list(range(len(x.dims)))
+        dims = [dims] if isinstance(dims, int) else list(dims)
+        return ff.mean(x, dims, keep)
+    if target == "transpose":
+        d0, d1 = args[1], args[2]
+        perm = list(range(len(x.dims)))
+        perm[d0], perm[d1] = perm[d1], perm[d0]
+        return ff.transpose(x, perm)
+    if target == "permute":
+        perm = args[1] if isinstance(args[1], (list, tuple)) else args[1:]
+        return ff.transpose(x, list(perm))
+    if target in ("view", "reshape"):
+        shape = args[1:] if not isinstance(args[1], (list, tuple)) else args[1]
+        shape = [-1 if isinstance(s, str) else int(s) for s in shape]
+        return ff.reshape(x, shape)
+    if target in ("contiguous", "detach", "clone", "identity"):
+        return x
+    if target == "size":
+        return x.dims if len(args) == 1 else x.dims[args[1]]
+    if target == "getitem":
+        if isinstance(x, (list, tuple)):
+            return x[args[1]]
+        if args[1] == 0:
+            # tuple-returning torch ops (e.g. MultiheadAttention's
+            # (output, weights)) map to a single output Tensor here
+            return x
+        raise NotImplementedError(f"getitem[{args[1]}] on single-output op")
+    raise NotImplementedError(f"torch call {target}")
+
 
 def _is_scalar(v) -> bool:
     return isinstance(v, (int, float))
 
 
-def torch_to_flexflow(module, path: str, batch_size: int = 1):
-    """File-format export stub for parity with reference
-    torch/model.py torch_to_flexflow (serializes the fx graph)."""
+def torch_to_flexflow(module, path: str, batch_size: int = 1) -> str:
+    """Serialize a torch module's fx graph to the flexflow file format
+    (reference: torch/model.py torch_to_flexflow). JSON-lines, one record
+    per fx node; module configs are extracted so `file_to_ff` replays
+    without torch."""
+    assert HAS_TORCH, "torch is not available"
     traced = torch.fx.symbolic_trace(module)
+    modules = dict(traced.named_modules())
+
+    def ser(a):
+        if isinstance(a, torch.fx.Node):
+            return {"ref": a.name}
+        if isinstance(a, (tuple, list)):
+            return [ser(x) for x in a]
+        if isinstance(a, (int, float, str, bool)) or a is None:
+            return a
+        raise NotImplementedError(f"cannot serialize arg {a!r}")
+
     with open(path, "w") as f:
         for node in traced.graph.nodes:
-            f.write(f"{node.op}\t{node.name}\t{node.target}\t{node.args}\n")
+            if node.op != "placeholder" and node.op != "output" and not node.users:
+                continue  # dead value, same skip as the live walk
+            rec = {"op": node.op, "name": node.name}
+            if node.op == "placeholder":
+                pass
+            elif node.op == "call_module":
+                mod = modules[node.target]
+                tname = type(mod).__name__
+                spec = _MODULE_BUILDERS.get(tname)
+                if spec is None:
+                    raise NotImplementedError(f"torch module {tname}")
+                if node.kwargs:
+                    # refuse to write a file that silently loses semantics
+                    # (e.g. MultiheadAttention key_padding_mask=...)
+                    raise NotImplementedError(
+                        f"kwargs on module call {tname}: {sorted(node.kwargs)}"
+                    )
+                rec["module_type"] = tname
+                rec["config"] = spec[0](mod)
+                rec["args"] = [ser(a) for a in node.args]
+            elif node.op in ("call_function", "call_method"):
+                t = node.target
+                rec["target"] = t if isinstance(t, str) else t.__name__
+                rec["args"] = [ser(a) for a in node.args]
+                rec["kwargs"] = {k: ser(v) for k, v in node.kwargs.items()}
+            elif node.op == "output":
+                flat = []
+
+                def collect(a):
+                    if isinstance(a, torch.fx.Node):
+                        flat.append({"ref": a.name})
+                    elif isinstance(a, (tuple, list)):
+                        for x in a:
+                            collect(x)
+
+                collect(node.args[0])
+                rec["args"] = flat
+            elif node.op == "get_attr":  # pragma: no cover
+                raise NotImplementedError("get_attr not serializable")
+            f.write(json.dumps(rec) + "\n")
     return path
+
+
+# reference model.py:2607 exposes file_to_ff module-level (usable sans torch)
+file_to_ff = PyTorchModel.file_to_ff

@@ -18,7 +18,7 @@ from typing import Tuple
 
 import jax.numpy as jnp
 
-from ..ff_types import ActiMode, DataType, OperatorType
+from ..ff_types import ActiMode, DataType, OperatorType, RegularizerMode
 from .common import apply_activation
 from .registry import WeightSpec, register_op
 
@@ -32,6 +32,7 @@ class LinearParams:
     activation: ActiMode = ActiMode.AC_MODE_NONE
     data_type: DataType = DataType.DT_FLOAT
     kernel_reg_lambda: float = 0.0
+    kernel_reg_type: RegularizerMode = RegularizerMode.REG_MODE_NONE
 
 
 def _infer(params: LinearParams, in_shapes, in_dtypes):

@@ -29,7 +29,13 @@ from ..core import losses as losses_mod
 from ..core.initializers import get_initializer
 from ..core.metrics import Metrics
 from ..core.optimizers import Optimizer
-from ..ff_types import CompMode, DataType, LossType, OperatorType
+from ..ff_types import (
+    CompMode,
+    DataType,
+    LossType,
+    OperatorType,
+    RegularizerMode,
+)
 from ..ops.registry import FwdCtx, get_op_def
 from ..pcg.graph import Graph
 from ..pcg.op import PCGOp
@@ -191,6 +197,27 @@ class PCGExecutor:
         )
         return {pt.guid: a for pt, a in zip(self.input_pts, batch_arrays)}
 
+    def _reg_penalty(self, params):
+        """Weight-regularizer loss terms (reference applies L2 directly in
+        the kernel-grad GEMM, linear_kernels.cu:333-350 grad += lambda*w;
+        here the equivalent penalty lambda/2*||w||^2 joins the loss so
+        jax.grad produces that same gradient)."""
+        terms = []
+        for op in self.topo:
+            lam = getattr(op.params, "kernel_reg_lambda", 0.0)
+            if not lam:
+                continue
+            w = params.get(op.name, {}).get("kernel")
+            if w is None:
+                continue
+            mode = getattr(op.params, "kernel_reg_type", None)
+            wf = w.astype(jnp.float32)
+            if mode == RegularizerMode.REG_MODE_L1:
+                terms.append(lam * jnp.sum(jnp.abs(wf)))
+            else:
+                terms.append(0.5 * lam * jnp.sum(wf * wf))
+        return terms
+
     def build_train_step(self) -> Callable:
         if self._train_step is not None:
             return self._train_step
@@ -206,6 +233,8 @@ class PCGExecutor:
                 loss = self.loss_fn(logits, labels)
                 for a in aux:
                     loss = loss + a
+                for r in self._reg_penalty(params):
+                    loss = loss + r
                 return loss, logits
 
             (loss, logits), grads = jax.value_and_grad(loss_of, has_aux=True)(

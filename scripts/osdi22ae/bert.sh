@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# reference: scripts/osdi22ae/bert.sh
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+echo "Running BERT with a parallelization strategy discovered by Unity"
+run_example transformer.py -b 8 --budget 30
+
+echo "Running BERT with data parallelism"
+run_example transformer.py -b 8 --budget 30 --only-data-parallel

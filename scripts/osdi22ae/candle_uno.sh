@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# reference: scripts/osdi22ae/candle_uno.sh
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+echo "Running CANDLE Uno with a parallelization strategy discovered by Unity"
+run_example candle_uno.py --budget 20
+
+echo "Running CANDLE Uno with data parallelism"
+run_example candle_uno.py --budget 20 --only-data-parallel

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Shared launcher for the OSDI'22 artifact-equivalent benchmarks
+# (reference: scripts/osdi22ae/*.sh). The reference runs each example twice
+# on 4 GPUs: once with the Unity-searched strategy (--budget N) and once
+# with --only-data-parallel. Here the "cluster" is a TPU mesh; without real
+# chips, set FF_VIRTUAL_MESH=8 to run on a virtual 8-device CPU mesh.
+set -euo pipefail
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../.." && pwd)"
+if [[ "${FF_VIRTUAL_MESH:-}" != "" ]]; then
+  export JAX_PLATFORMS=cpu
+  export XLA_FLAGS="--xla_force_host_platform_device_count=${FF_VIRTUAL_MESH}"
+fi
+run_example() {
+  local name="$1"; shift
+  ( cd "$REPO" && PYTHONPATH="$REPO" python "examples/python/$name" "$@" )
+}

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# reference: scripts/osdi22ae/dlrm.sh
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+echo "Running DLRM with a parallelization strategy discovered by Unity"
+run_example dlrm.py --budget 20
+
+echo "Running DLRM with data parallelism"
+run_example dlrm.py --budget 20 --only-data-parallel

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# reference: scripts/osdi22ae/inception.sh
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+echo "Running InceptionV3 with a parallelization strategy discovered by Unity"
+run_example inception.py --budget 20
+
+echo "Running InceptionV3 with data parallelism"
+run_example inception.py --budget 20 --only-data-parallel

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# reference: scripts/osdi22ae/mlp.sh
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+echo "Running MLP with a parallelization strategy discovered by Unity"
+run_example mlp_unify.py --budget 20
+
+echo "Running MLP with data parallelism"
+run_example mlp_unify.py --budget 20 --only-data-parallel

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# reference: scripts/osdi22ae/resnext-50.sh
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+echo "Running ResNeXt-50 with a parallelization strategy discovered by Unity"
+run_example resnet.py --resnext -b 16 --budget 20
+
+echo "Running ResNeXt-50 with data parallelism"
+run_example resnet.py --resnext -b 16 --budget 20 --only-data-parallel

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# reference: scripts/osdi22ae/xdl.sh
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+echo "Running XDL with a parallelization strategy discovered by Unity"
+run_example xdl.py --budget 20
+
+echo "Running XDL with data parallelism"
+run_example xdl.py --budget 20 --only-data-parallel

@@ -1,0 +1,178 @@
+"""Drop-in `flexflow` package compatibility tests.
+
+The reference's user-facing import surface is the `flexflow` package
+(python/flexflow/): `from flexflow.core import *`, flexflow.keras.*,
+flexflow.torch.model, flexflow.onnx.model. These tests run reference-style
+scripts (examples/python/compat/, near-verbatim ports of
+examples/python/native + keras + pytorch examples) against the shim.
+"""
+import runpy
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def test_core_star_surface():
+    import flexflow.core as ffc
+
+    for name in [
+        "FFConfig", "FFModel", "Tensor", "SingleDataLoader", "SGDOptimizer",
+        "AdamOptimizer", "UniformInitializer", "GlorotUniformInitializer",
+        "ZeroInitializer", "NormInitializer", "ConstantInitializer",
+        "DataType", "ActiMode", "LossType", "MetricsType", "PoolType",
+        "AggrMode", "CompMode", "ParameterSyncType", "PerfMetrics",
+    ]:
+        assert hasattr(ffc, name), name
+
+
+def test_optimizer_reference_signature():
+    """reference cffi: SGDOptimizer(ffmodel, lr) / AdamOptimizer(ffmodel, ...)."""
+    from flexflow.core import AdamOptimizer, FFConfig, FFModel, SGDOptimizer
+
+    m = FFModel(FFConfig())
+    o = SGDOptimizer(m, 0.02, 0.9)
+    assert o.lr == 0.02 and o.momentum == 0.9
+    a = AdamOptimizer(m, 0.005)
+    assert a.alpha == 0.005
+    # model-free calling convention still works
+    assert SGDOptimizer(lr=0.1).lr == 0.1
+
+
+def test_config_snake_case_fields():
+    from flexflow.core import FFConfig
+
+    cfg = FFConfig()
+    assert cfg.num_nodes == cfg.numNodes
+    assert cfg.workers_per_node >= 1
+    assert cfg.get_current_time() > 0
+
+
+def test_keras_namespace():
+    from flexflow.keras.models import Model, Sequential  # noqa: F401
+    from flexflow.keras.layers import Dense, Flatten, Activation  # noqa: F401
+    from flexflow.keras.callbacks import VerifyMetrics  # noqa: F401
+    from flexflow.keras.initializers import GlorotUniform, Zeros
+    from flexflow.keras.regularizers import L1, L2
+    from flexflow.keras import losses, metrics
+    import flexflow.keras.optimizers as opt
+
+    assert opt.SGD().to_core().lr == 0.01
+    assert GlorotUniform(3).seed == 3
+    assert L2(0.01)._lambda == 0.01
+    assert losses.SparseCategoricalCrossentropy().type is not None
+    assert metrics.Accuracy().type is not None
+    z = Zeros()
+    import jax
+
+    arr = z(jax.random.PRNGKey(0), (3, 3), np.float32)
+    assert float(np.sum(np.asarray(arr))) == 0.0
+
+
+def test_type_module():
+    import flexflow.type as ft
+
+    assert ft.OpType is ft.OperatorType
+    assert ft.enum_to_int(ft.DataType, ft.DataType.DT_FLOAT) == int(
+        ft.DataType.DT_FLOAT
+    )
+    assert ft.str_to_enum(ft.ActiMode, "AC_MODE_RELU") is ft.ActiMode.AC_MODE_RELU
+
+
+def _run_example(script, extra=()):
+    import os
+    import pathlib
+
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(repo)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    out = subprocess.run(
+        [sys.executable, str(repo / "examples/python/compat" / script), *extra],
+        capture_output=True, text=True, timeout=600,
+        cwd=str(repo / "examples/python/compat"), env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return out.stdout
+
+
+def test_compat_mnist_mlp_trains():
+    out = _run_example("mnist_mlp.py")
+    assert "THROUGHPUT" in out
+
+
+def test_compat_keras_sequential_trains():
+    out = _run_example("seq_mnist_mlp.py")
+    assert "THROUGHPUT" in out
+
+
+def test_compat_torch_file_roundtrip():
+    pytest.importorskip("torch")
+    out = _run_example("mnist_mlp_torch.py")
+    assert "THROUGHPUT" in out
+
+
+def test_torch_file_format_roundtrip_inproc():
+    """torch_to_flexflow → file_to_ff reproduces the live-trace graph."""
+    torch = pytest.importorskip("torch")
+    import tempfile
+
+    from flexflow.core import DataType, FFConfig, FFModel
+    from flexflow.torch.model import PyTorchModel, torch_to_flexflow
+
+    class Net(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = torch.nn.Linear(8, 4)
+            self.drop = torch.nn.Dropout(0.1)
+
+        def forward(self, x):
+            return torch.softmax(self.drop(self.fc(x)).relu(), dim=-1)
+
+    with tempfile.NamedTemporaryFile(suffix=".ff", delete=False) as f:
+        path = f.name
+    torch_to_flexflow(Net(), path)
+
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    m = FFModel(cfg)
+    inp = m.create_tensor([8, 8], DataType.DT_FLOAT)
+    outs = PyTorchModel.file_to_ff(path, m, [inp])
+    assert len(outs) == 1 and outs[0].dims == (8, 4)
+    # same op sequence as a live trace
+    m2 = FFModel(cfg)
+    inp2 = m2.create_tensor([8, 8], DataType.DT_FLOAT)
+    PyTorchModel(Net()).torch_to_ff(m2, [inp2])
+    assert [l.op_type for l in m.layers] == [l.op_type for l in m2.layers]
+
+
+def test_l2_regularizer_affects_gradients():
+    """L2 kernel regularizer adds lambda*w to the kernel grad (reference
+    linear_kernels.cu:333-350)."""
+    from flexflow.core import (
+        DataType, FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
+    )
+
+    def train_once(lam):
+        cfg = FFConfig()
+        cfg.batch_size = 8
+        m = FFModel(cfg)
+        t_in = m.create_tensor([8, 4], DataType.DT_FLOAT)
+        reg = ("l2", lam) if lam else None
+        t = m.dense(t_in, 2, kernel_regularizer=reg)
+        m.compile(
+            optimizer=SGDOptimizer(lr=0.5),
+            loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+            metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR],
+        )
+        x = np.zeros((8, 4), np.float32)  # zero input → data grad is 0
+        y = np.zeros((8, 2), np.float32)
+        m.fit(x, y, epochs=1, verbose=False)
+        return np.asarray(m.state.params["op_linear_0"]["kernel"])
+
+    k_plain = train_once(0.0)
+    k_reg = train_once(0.5)
+    # with zero data gradient, L2 shrinks weights: w' = w - lr*lam*w
+    assert np.allclose(k_reg, k_plain * (1 - 0.5 * 0.5), atol=1e-5)
