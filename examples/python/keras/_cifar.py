@@ -1,0 +1,10 @@
+"""Shared CIFAR-10 loading for the example suite (NCHW float like the
+reference examples)."""
+from flexflow.keras.datasets import cifar10
+
+
+def load_cifar(num_samples):
+    (x_train, y_train), _ = cifar10.load_data(n_train=num_samples)
+    x_train = x_train.transpose(0, 3, 1, 2).astype("float32") / 255  # NCHW
+    y_train = y_train.astype("int32").reshape(-1, 1)
+    return x_train, y_train

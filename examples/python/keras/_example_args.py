@@ -1,0 +1,22 @@
+"""Shared argv handling so every example runs full-size by default but can be
+smoke-tested fast (--epochs 1 --num-samples 512). The reference examples get
+this from FFConfig argv parsing (-e/--epochs, config.h:92-160)."""
+import argparse
+
+
+def example_args(epochs=5, num_samples=4096, batch_size=64):
+    p = argparse.ArgumentParser()
+    p.add_argument("-e", "--epochs", type=int, default=epochs)
+    p.add_argument("--num-samples", type=int, default=num_samples)
+    p.add_argument("-b", "--batch-size", type=int, default=batch_size)
+    p.add_argument("--verify", action="store_true",
+                   help="assert final accuracy against ModelAccuracy")
+    args, _ = p.parse_known_args()
+    return args
+
+
+def verify_callbacks(args, target):
+    from flexflow.keras.callbacks import EpochVerifyMetrics, VerifyMetrics
+    if not args.verify:
+        return []
+    return [VerifyMetrics(target), EpochVerifyMetrics(target)]

@@ -1,0 +1,44 @@
+"""Callback demo: LearningRateScheduler on a CIFAR-10 CNN (reference:
+examples/python/keras/callback.py)."""
+from flexflow.keras.models import Model
+from flexflow.keras.layers import (
+    Input, Conv2D, MaxPooling2D, Flatten, Dense, Activation)
+import flexflow.keras.optimizers
+from flexflow.keras.callbacks import LearningRateScheduler
+from flexflow.keras import backend as K
+
+from accuracy import ModelAccuracy
+from _cifar import load_cifar
+from _example_args import example_args, verify_callbacks
+
+
+def lr_scheduler(epoch):
+    return 0.01 if epoch == 0 else 0.02
+
+
+def top_level_task(args):
+    print(K.backend())
+    num_classes = 10
+    x_train, y_train = load_cifar(args.num_samples)
+
+    inp = Input(shape=(3, 32, 32))
+    x = Conv2D(filters=32, kernel_size=(3, 3), strides=(1, 1), padding=(1, 1),
+               activation="relu")(inp)
+    x = MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid")(x)
+    x = Flatten()(x)
+    x = Dense(256, activation="relu")(x)
+    out = Activation("softmax")(Dense(num_classes)(x))
+
+    model = Model(inp, out)
+    opt = flexflow.keras.optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  batch_size=args.batch_size)
+    cbs = [LearningRateScheduler(lr_scheduler)]
+    cbs += verify_callbacks(args, ModelAccuracy.CIFAR10_CNN)
+    model.fit(x_train, y_train, epochs=max(args.epochs, 2), callbacks=cbs)
+
+
+if __name__ == "__main__":
+    print("Callbacks, cifar10 cnn")
+    top_level_task(example_args())

@@ -1,0 +1,50 @@
+"""CANDLE Uno drug-response model, keras frontend (reference:
+examples/python/keras/candle_uno/candle_uno.py + uno.py — multi-tower
+feature encoders concatenated into a regression head; the reference's data
+pipeline is replaced with synthetic feature tensors of the published
+dimensions)."""
+import numpy as np
+
+from flexflow.keras.models import Model
+from flexflow.keras.layers import Input, Dense, Concatenate
+import flexflow.keras.optimizers
+
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _example_args import example_args  # noqa: E402
+
+FEATURE_SHAPES = {"cell.rnaseq": 942, "drug1.descriptors": 5270,
+                  "drug1.fingerprints": 2048}
+
+
+def feature_tower(name, width, dense_layers=(1000, 1000, 1000)):
+    inp = Input(shape=(width,), name=f"input.{name}")
+    x = inp
+    for i, units in enumerate(dense_layers):
+        x = Dense(units, activation="relu", name=f"{name}.dense{i}")(x)
+    return inp, x
+
+
+def top_level_task(args):
+    towers = [feature_tower(n, w) for n, w in FEATURE_SHAPES.items()]
+    merged = Concatenate(axis=1)([t[1] for t in towers])
+    x = merged
+    for i in range(3):
+        x = Dense(1000, activation="relu", name=f"top.dense{i}")(x)
+    out = Dense(1, name="response")(x)
+
+    model = Model([t[0] for t in towers], out)
+    model.compile(optimizer=flexflow.keras.optimizers.SGD(learning_rate=0.01),
+                  loss="mean_squared_error", metrics=["mean_squared_error"],
+                  batch_size=args.batch_size)
+    n = args.num_samples
+    xs = [np.random.randn(n, w).astype(np.float32)
+          for w in FEATURE_SHAPES.values()]
+    y = np.random.randn(n, 1).astype(np.float32)
+    model.fit(xs, y, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("candle uno")
+    top_level_task(example_args(epochs=2, num_samples=512, batch_size=32))

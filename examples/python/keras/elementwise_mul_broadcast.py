@@ -1,0 +1,40 @@
+"""Broadcasted Multiply merges (reference:
+examples/python/keras/elementwise_mul_broadcast.py broadcast1/2)."""
+import numpy as np
+
+from flexflow.keras.models import Model
+from flexflow.keras.layers import Input, Dense, Reshape, Multiply
+import flexflow.keras.optimizers
+
+from _example_args import example_args
+
+
+def broadcast(args, first_bigger: bool):
+    in0 = Input(shape=(32,), dtype="float32")
+    in1 = Input(shape=(10,), dtype="float32")
+    x0 = Dense(20, activation="relu")(in0)
+    x1 = Dense(10, activation="relu")(in1)
+    nx0 = Reshape((10, 2))(x0)
+    nx1 = Reshape((10, 1))(x1)
+    pair = [nx0, nx1] if first_bigger else [nx1, nx0]
+    m0 = Multiply()(pair)  # broadcast (10,1)x(10,2) -> (10,2)
+    f0 = Reshape((20,))(m0)
+    out = Dense(1)(f0)
+    model = Model([in0, in1], out)
+    model.compile(optimizer=flexflow.keras.optimizers.Adam(learning_rate=0.001),
+                  loss="mean_squared_error", metrics=["mean_squared_error"],
+                  batch_size=args.batch_size)
+    n = args.num_samples
+    model.fit([np.random.randn(n, 32).astype(np.float32),
+               np.random.randn(n, 10).astype(np.float32)],
+              np.random.randn(n, 1).astype(np.float32), epochs=args.epochs)
+
+
+def top_level_task(args):
+    broadcast(args, True)
+    broadcast(args, False)
+
+
+if __name__ == "__main__":
+    print("Elementwise multiply with broadcast")
+    top_level_task(example_args(epochs=2, num_samples=512))

@@ -1,0 +1,54 @@
+"""CIFAR-10 AlexNet, functional API (reference:
+examples/python/keras/func_cifar10_alexnet.py — images upscaled to 229x229;
+here resized with numpy repeat since PIL isn't required)."""
+import numpy as np
+
+from flexflow.keras.models import Model
+from flexflow.keras.layers import (
+    Input, Conv2D, MaxPooling2D, Flatten, Dense, Activation)
+import flexflow.keras.optimizers
+from flexflow.keras.datasets import cifar10
+
+from accuracy import ModelAccuracy
+from _example_args import example_args, verify_callbacks
+
+
+def top_level_task(args):
+    num_classes = 10
+    (x_train, y_train), _ = cifar10.load_data(n_train=args.num_samples)
+    x_train = x_train.transpose(0, 3, 1, 2).astype("float32") / 255  # NCHW
+    # nearest-neighbour upscale 32 -> 224 (7x) instead of PIL's 229
+    x_train = x_train.repeat(7, axis=2).repeat(7, axis=3)
+    y_train = y_train.astype("int32").reshape(-1, 1)
+
+    input_tensor = Input(shape=(3, 224, 224))
+    x = Conv2D(filters=64, kernel_size=(11, 11), strides=(4, 4),
+               padding=(2, 2), activation="relu")(input_tensor)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2), padding="valid")(x)
+    x = Conv2D(filters=192, kernel_size=(5, 5), strides=(1, 1),
+               padding=(2, 2), activation="relu")(x)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2), padding="valid")(x)
+    x = Conv2D(filters=384, kernel_size=(3, 3), strides=(1, 1),
+               padding=(1, 1), activation="relu")(x)
+    x = Conv2D(filters=256, kernel_size=(3, 3), strides=(1, 1),
+               padding=(1, 1), activation="relu")(x)
+    x = Conv2D(filters=256, kernel_size=(3, 3), strides=(1, 1),
+               padding=(1, 1), activation="relu")(x)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2), padding="valid")(x)
+    x = Flatten()(x)
+    x = Dense(4096, activation="relu")(x)
+    x = Dense(4096, activation="relu")(x)
+    out = Activation("softmax")(Dense(num_classes)(x))
+
+    model = Model(input_tensor, out)
+    opt = flexflow.keras.optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  batch_size=args.batch_size)
+    model.fit(x_train, y_train, epochs=args.epochs,
+              callbacks=verify_callbacks(args, ModelAccuracy.CIFAR10_ALEXNET))
+
+
+if __name__ == "__main__":
+    print("Functional API, cifar10 alexnet")
+    top_level_task(example_args(num_samples=1024))

@@ -1,0 +1,43 @@
+"""CIFAR-10 CNN, functional API (reference:
+examples/python/keras/func_cifar10_cnn.py — 2x[conv,conv,pool] + dense)."""
+from flexflow.keras.models import Model
+from flexflow.keras.layers import (
+    Input, Conv2D, MaxPooling2D, Flatten, Dense, Activation)
+import flexflow.keras.optimizers
+
+from accuracy import ModelAccuracy
+from _cifar import load_cifar
+from _example_args import example_args, verify_callbacks
+
+
+def top_level_task(args):
+    num_classes = 10
+    x_train, y_train = load_cifar(args.num_samples)
+
+    input_tensor = Input(shape=(3, 32, 32))
+    x = Conv2D(filters=32, kernel_size=(3, 3), strides=(1, 1), padding=(1, 1),
+               activation="relu")(input_tensor)
+    x = Conv2D(filters=32, kernel_size=(3, 3), strides=(1, 1), padding=(1, 1),
+               activation="relu")(x)
+    x = MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid")(x)
+    x = Conv2D(filters=64, kernel_size=(3, 3), strides=(1, 1), padding=(1, 1),
+               activation="relu")(x)
+    x = Conv2D(filters=64, kernel_size=(3, 3), strides=(1, 1), padding=(1, 1),
+               activation="relu")(x)
+    x = MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid")(x)
+    x = Flatten()(x)
+    x = Dense(512, activation="relu")(x)
+    out = Activation("softmax")(Dense(num_classes)(x))
+
+    model = Model(input_tensor, out)
+    opt = flexflow.keras.optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  batch_size=args.batch_size)
+    model.fit(x_train, y_train, epochs=args.epochs,
+              callbacks=verify_callbacks(args, ModelAccuracy.CIFAR10_CNN))
+
+
+if __name__ == "__main__":
+    print("Functional API, cifar10 cnn")
+    top_level_task(example_args())

@@ -1,0 +1,46 @@
+"""CIFAR-10: two sub-Models whose outputs are concatenated into a two-input
+model (reference: examples/python/keras/func_cifar10_cnn_concat_model.py)."""
+from flexflow.keras.models import Model
+from flexflow.keras.layers import (
+    Input, Conv2D, MaxPooling2D, Flatten, Dense, Activation, Concatenate)
+import flexflow.keras.optimizers
+
+from accuracy import ModelAccuracy
+from _cifar import load_cifar
+from _example_args import example_args, verify_callbacks
+
+
+def branch():
+    inp = Input(shape=(3, 32, 32))
+    x = Conv2D(filters=32, kernel_size=(3, 3), strides=(1, 1),
+               padding=(1, 1), activation="relu")(inp)
+    x = MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid")(x)
+    x = Flatten()(x)
+    return inp, x
+
+
+def top_level_task(args):
+    num_classes = 10
+    x_train, y_train = load_cifar(args.num_samples)
+
+    in1, ot1 = branch()
+    model1 = Model(in1, ot1)
+    in2, ot2 = branch()
+    model2 = Model(in2, ot2)
+
+    merged = Concatenate(axis=1)([model1.outputs[0], model2.outputs[0]])
+    x = Dense(512, activation="relu")(merged)
+    out = Activation("softmax")(Dense(num_classes)(x))
+
+    model = Model([in1, in2], out)
+    opt = flexflow.keras.optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  batch_size=args.batch_size)
+    model.fit([x_train, x_train], y_train, epochs=args.epochs,
+              callbacks=verify_callbacks(args, ModelAccuracy.CIFAR10_CNN))
+
+
+if __name__ == "__main__":
+    print("Functional API, cifar10 cnn concat model")
+    top_level_task(example_args())

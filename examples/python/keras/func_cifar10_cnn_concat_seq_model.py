@@ -1,0 +1,43 @@
+"""CIFAR-10: a Sequential feature extractor concatenated with a functional
+branch (reference: examples/python/keras/func_cifar10_cnn_concat_seq_model.py)."""
+from flexflow.keras.models import Model, Sequential
+from flexflow.keras.layers import (
+    Input, Conv2D, MaxPooling2D, Flatten, Dense, Activation, Concatenate)
+import flexflow.keras.optimizers
+
+from accuracy import ModelAccuracy
+from _cifar import load_cifar
+from _example_args import example_args, verify_callbacks
+
+
+def top_level_task(args):
+    num_classes = 10
+    x_train, y_train = load_cifar(args.num_samples)
+
+    seq = Sequential([
+        Conv2D(filters=32, input_shape=(3, 32, 32), kernel_size=(3, 3),
+               strides=(1, 1), padding=(1, 1), activation="relu"),
+        MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid"),
+        Flatten(),
+    ])
+
+    in2 = Input(shape=(3, 32, 32))
+    f2 = Flatten()(Conv2D(filters=32, kernel_size=(3, 3), strides=(1, 1),
+                          padding=(1, 1), activation="relu")(in2))
+
+    merged = Concatenate(axis=1)([seq.outputs[0], f2])
+    x = Dense(512, activation="relu")(merged)
+    out = Activation("softmax")(Dense(num_classes)(x))
+
+    model = Model([seq.inputs[0], in2], out)
+    opt = flexflow.keras.optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  batch_size=args.batch_size)
+    model.fit([x_train, x_train], y_train, epochs=args.epochs,
+              callbacks=verify_callbacks(args, ModelAccuracy.CIFAR10_CNN))
+
+
+if __name__ == "__main__":
+    print("Functional API, cifar10 cnn concat seq model")
+    top_level_task(example_args())

@@ -1,0 +1,44 @@
+"""CIFAR-10: chained sub-models (reference:
+examples/python/keras/func_cifar10_cnn_nested.py — model1's output feeds
+model2's graph, final Model spans both)."""
+from flexflow.keras.models import Model
+from flexflow.keras.layers import (
+    Input, Conv2D, MaxPooling2D, Flatten, Dense, Activation)
+import flexflow.keras.optimizers
+
+from accuracy import ModelAccuracy
+from _cifar import load_cifar
+from _example_args import example_args, verify_callbacks
+
+
+def top_level_task(args):
+    num_classes = 10
+    x_train, y_train = load_cifar(args.num_samples)
+
+    in1 = Input(shape=(3, 32, 32))
+    o1 = Conv2D(filters=32, kernel_size=(3, 3), strides=(1, 1),
+                padding=(1, 1), activation="relu")(in1)
+    o1 = MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid")(o1)
+    model1 = Model(in1, o1)
+
+    o2 = Conv2D(filters=64, kernel_size=(3, 3), strides=(1, 1),
+                padding=(1, 1), activation="relu")(model1.outputs[0])
+    o2 = MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid")(o2)
+    model2 = Model(in1, o2)
+
+    x = Flatten()(model2.outputs[0])
+    x = Dense(512, activation="relu")(x)
+    out = Activation("softmax")(Dense(num_classes)(x))
+    model = Model(in1, out)
+
+    opt = flexflow.keras.optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  batch_size=args.batch_size)
+    model.fit(x_train, y_train, epochs=args.epochs,
+              callbacks=verify_callbacks(args, ModelAccuracy.CIFAR10_CNN))
+
+
+if __name__ == "__main__":
+    print("Functional API, cifar10 cnn nested")
+    top_level_task(example_args())

@@ -1,0 +1,55 @@
+"""Net2Net CIFAR-10 CNN: teacher weights seed the student (reference:
+examples/python/keras/func_cifar10_cnn_net2net.py)."""
+from flexflow.keras.models import Model
+from flexflow.keras.layers import (
+    Input, Conv2D, MaxPooling2D, Flatten, Dense, Activation)
+import flexflow.keras.optimizers
+
+from accuracy import ModelAccuracy
+from _cifar import load_cifar
+from _example_args import example_args, verify_callbacks
+
+
+def build(num_classes):
+    inp = Input(shape=(3, 32, 32))
+    x = Conv2D(filters=32, kernel_size=(3, 3), strides=(1, 1), padding=(1, 1),
+               activation="relu", name="conv1")(inp)
+    x = Conv2D(filters=64, kernel_size=(3, 3), strides=(1, 1), padding=(1, 1),
+               activation="relu", name="conv2")(x)
+    x = MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid")(x)
+    x = Flatten()(x)
+    x = Dense(256, activation="relu", name="dense1")(x)
+    x = Dense(num_classes, name="dense2")(x)
+    return Model(inp, Activation("softmax")(x))
+
+
+def top_level_task(args):
+    num_classes = 10
+    x_train, y_train = load_cifar(args.num_samples)
+
+    teacher = build(num_classes)
+    teacher.compile(optimizer=flexflow.keras.optimizers.SGD(learning_rate=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy", "sparse_categorical_crossentropy"],
+                    batch_size=args.batch_size)
+    teacher.fit(x_train, y_train, epochs=args.epochs)
+
+    weights = {
+        name: teacher.get_layer(name=name).get_weights(teacher.ffmodel)
+        for name in ("conv1", "conv2", "dense1", "dense2")
+    }
+
+    student = build(num_classes)
+    student.compile(optimizer=flexflow.keras.optimizers.SGD(learning_rate=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy", "sparse_categorical_crossentropy"],
+                    batch_size=args.batch_size)
+    for name, w in weights.items():
+        student.get_layer(name=name).set_weights(w)
+    student.fit(x_train, y_train, epochs=args.epochs,
+                callbacks=verify_callbacks(args, ModelAccuracy.CIFAR10_CNN))
+
+
+if __name__ == "__main__":
+    print("Functional API, cifar10 cnn net2net")
+    top_level_task(example_args())

@@ -1,0 +1,42 @@
+"""MNIST CNN, functional API (reference: examples/python/keras/func_mnist_cnn.py
+— Conv 32/64 + maxpool + Dense 128/10)."""
+import numpy as np
+
+from flexflow.keras.models import Model
+from flexflow.keras.layers import (
+    Input, Conv2D, MaxPooling2D, Flatten, Dense, Activation)
+import flexflow.keras.optimizers
+from flexflow.keras.datasets import mnist
+
+from accuracy import ModelAccuracy
+from _example_args import example_args, verify_callbacks
+
+
+def top_level_task(args):
+    num_classes = 10
+    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
+    x_train = x_train.reshape(-1, 1, 28, 28).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+
+    input_tensor = Input(shape=(1, 28, 28))
+    x = Conv2D(filters=32, kernel_size=(3, 3), strides=(1, 1), padding=(1, 1),
+               activation="relu")(input_tensor)
+    x = Conv2D(filters=64, kernel_size=(3, 3), strides=(1, 1), padding=(1, 1),
+               activation="relu")(x)
+    x = MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid")(x)
+    x = Flatten()(x)
+    x = Dense(128, activation="relu")(x)
+    out = Activation("softmax")(Dense(num_classes)(x))
+
+    model = Model(input_tensor, out)
+    opt = flexflow.keras.optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  batch_size=args.batch_size)
+    model.fit(x_train, y_train, epochs=args.epochs,
+              callbacks=verify_callbacks(args, ModelAccuracy.MNIST_CNN))
+
+
+if __name__ == "__main__":
+    print("Functional API, mnist cnn")
+    top_level_task(example_args())

@@ -1,0 +1,37 @@
+"""MNIST MLP, functional API (reference: examples/python/keras/func_mnist_mlp.py
+— Dense 512/512/10 + softmax, sparse-CCE, SGD)."""
+import numpy as np
+
+from flexflow.keras.models import Model
+from flexflow.keras.layers import Input, Dense, Activation
+import flexflow.keras.optimizers
+from flexflow.keras.datasets import mnist
+
+from accuracy import ModelAccuracy
+from _example_args import example_args, verify_callbacks
+
+
+def top_level_task(args):
+    num_classes = 10
+    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
+    x_train = x_train.reshape(-1, 784).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+
+    input_tensor = Input(shape=(784,))
+    x = Dense(512, activation="relu")(input_tensor)
+    x = Dense(512, activation="relu")(x)
+    x = Dense(num_classes)(x)
+    out = Activation("softmax")(x)
+
+    model = Model(input_tensor, out)
+    opt = flexflow.keras.optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  batch_size=args.batch_size)
+    model.fit(x_train, y_train, epochs=args.epochs,
+              callbacks=verify_callbacks(args, ModelAccuracy.MNIST_MLP))
+
+
+if __name__ == "__main__":
+    print("Functional API, mnist mlp")
+    top_level_task(example_args())

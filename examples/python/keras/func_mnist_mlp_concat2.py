@@ -1,0 +1,41 @@
+"""MNIST MLP: two inputs, nested concatenates (reference:
+examples/python/keras/func_mnist_mlp_concat2.py)."""
+import numpy as np
+
+from flexflow.keras.models import Model
+from flexflow.keras.layers import Input, Dense, Activation, Concatenate, concatenate
+import flexflow.keras.optimizers
+from flexflow.keras.datasets import mnist
+
+from accuracy import ModelAccuracy
+from _example_args import example_args, verify_callbacks
+
+
+def top_level_task(args):
+    num_classes = 10
+    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
+    x_train = x_train.reshape(-1, 784).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+
+    in1 = Input(shape=(784,))
+    in2 = Input(shape=(784,))
+    t1 = Dense(256, activation="relu")(in1)
+    t2 = Dense(256, activation="relu")(in2)
+    c1 = concatenate([t1, t2])
+    t3 = Dense(256, activation="relu")(in1)
+    c2 = Concatenate(axis=1)([c1, t3])
+    x = Dense(256, activation="relu")(c2)
+    out = Activation("softmax")(Dense(num_classes)(x))
+
+    model = Model([in1, in2], out)
+    opt = flexflow.keras.optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  batch_size=args.batch_size)
+    model.fit([x_train, x_train], y_train, epochs=args.epochs,
+              callbacks=verify_callbacks(args, ModelAccuracy.MNIST_MLP))
+
+
+if __name__ == "__main__":
+    print("Functional API, mnist mlp concat2")
+    top_level_task(example_args())

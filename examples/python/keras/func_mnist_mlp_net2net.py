@@ -1,0 +1,55 @@
+"""Net2Net MNIST MLP: teacher trains, student starts from teacher weights
+(reference: examples/python/keras/func_mnist_mlp_net2net.py — get_layer +
+get_weights/set_weights transfer)."""
+import numpy as np
+
+from flexflow.keras.models import Model
+from flexflow.keras.layers import Input, Dense, Activation
+import flexflow.keras.optimizers
+from flexflow.keras.datasets import mnist
+
+from accuracy import ModelAccuracy
+from _example_args import example_args, verify_callbacks
+
+
+def build(num_classes):
+    input_tensor = Input(shape=(784,))
+    x = Dense(512, activation="relu", name="dense1")(input_tensor)
+    x = Dense(512, activation="relu", name="dense2")(x)
+    x = Dense(num_classes, name="dense3")(x)
+    out = Activation("softmax")(x)
+    return Model(input_tensor, out)
+
+
+def top_level_task(args):
+    num_classes = 10
+    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
+    x_train = x_train.reshape(-1, 784).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+
+    opt = flexflow.keras.optimizers.SGD(learning_rate=0.01)
+    teacher = build(num_classes)
+    teacher.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy", "sparse_categorical_crossentropy"],
+                    batch_size=args.batch_size)
+    teacher.fit(x_train, y_train, epochs=args.epochs)
+
+    d1 = teacher.get_layer(name="dense1").get_weights(teacher.ffmodel)
+    d2 = teacher.get_layer(name="dense2").get_weights(teacher.ffmodel)
+    d3 = teacher.get_layer(name="dense3").get_weights(teacher.ffmodel)
+
+    student = build(num_classes)
+    student.compile(optimizer=flexflow.keras.optimizers.SGD(learning_rate=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy", "sparse_categorical_crossentropy"],
+                    batch_size=args.batch_size)
+    student.get_layer(name="dense1").set_weights(d1)
+    student.get_layer(name="dense2").set_weights(d2)
+    student.get_layer(name="dense3").set_weights(d3)
+    student.fit(x_train, y_train, epochs=args.epochs,
+                callbacks=verify_callbacks(args, ModelAccuracy.MNIST_MLP))
+
+
+if __name__ == "__main__":
+    print("Functional API, mnist mlp net2net")
+    top_level_task(example_args())

@@ -1,0 +1,42 @@
+"""Gather along axis 1 (reference: examples/python/keras/gather.py —
+torch.gather semantics through K.internal.gather)."""
+import numpy as np
+
+import flexflow.keras.models
+import flexflow.keras.optimizers
+from flexflow.keras.layers import Input, Dense, Reshape
+from flexflow.keras.backend.internal import gather
+
+from _example_args import example_args
+
+
+def get_modified_idx(idx, hidden):
+    return idx.reshape(-1, 1).repeat(hidden, 1).astype(np.int32)
+
+
+def top_level_task(args):
+    h = 3
+    idx = np.array([[5, 7, 9], [8, 4, 0]])
+    idx = get_modified_idx(idx, h)  # 6,3
+
+    in0 = Input(shape=(10,), dtype="float32")
+    in1 = Input(shape=idx.shape, dtype="int32")
+    x0 = Dense(30, activation="relu")(in0)
+    x0 = Reshape((10, h))(x0)
+    f0 = gather(x0, in1, axis=1)  # B,6,3
+    f0 = Reshape((18,))(f0)
+    out = Dense(1)(f0)
+
+    model = flexflow.keras.models.Model([in0, in1], out)
+    model.compile(optimizer=flexflow.keras.optimizers.Adam(learning_rate=0.001),
+                  loss="mean_squared_error", metrics=["mean_squared_error"],
+                  batch_size=args.batch_size)
+    n = args.num_samples
+    model.fit([np.random.randn(n, 10).astype(np.float32),
+               idx[None].repeat(n, 0).astype(np.int32)],
+              np.random.randn(n, 1).astype(np.float32), epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("gather")
+    top_level_task(example_args(epochs=2, num_samples=512))

@@ -1,0 +1,28 @@
+"""Identity loss: the model output IS the loss (reference:
+examples/python/keras/identity_loss.py)."""
+import numpy as np
+
+import flexflow.keras.models
+import flexflow.keras.optimizers
+from flexflow.keras.layers import Input, Dense
+from flexflow.keras import backend as K
+
+from _example_args import example_args
+
+
+def top_level_task(args):
+    in0 = Input(shape=(32,), dtype="float32")
+    x0 = Dense(20, activation="relu")(in0)
+    out = K.sum(x0, axis=1)  # B
+    model = flexflow.keras.models.Model(in0, out)
+    model.compile(optimizer=flexflow.keras.optimizers.Adam(learning_rate=0.01),
+                  loss="identity", metrics=["mean_absolute_error"],
+                  batch_size=args.batch_size)
+    n = args.num_samples
+    model.fit(np.random.randn(n, 32).astype(np.float32),
+              np.zeros((n,), np.float32), epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("identity loss")
+    top_level_task(example_args(epochs=2, num_samples=512))

@@ -1,0 +1,48 @@
+"""K.sum reductions (reference: examples/python/keras/reduce_sum.py
+test_reduce_sum1/2/3)."""
+import numpy as np
+
+import flexflow.keras.models
+import flexflow.keras.optimizers
+from flexflow.keras.layers import Input, Dense, Reshape
+from flexflow.keras import backend as K
+
+from _example_args import example_args
+
+
+def reduce_one_axis(args):
+    in0 = Input(shape=(32,), dtype="float32")
+    x0 = Dense(20, activation="relu")(in0)
+    nx0 = Reshape((10, 2))(x0)
+    out = K.sum(nx0, axis=1)  # B,2
+    model = flexflow.keras.models.Model(in0, out)
+    model.compile(optimizer=flexflow.keras.optimizers.Adam(learning_rate=0.001),
+                  loss="mean_squared_error", metrics=["mean_squared_error"],
+                  batch_size=args.batch_size)
+    n = args.num_samples
+    model.fit(np.random.randn(n, 32).astype(np.float32),
+              np.random.randn(n, 2).astype(np.float32), epochs=args.epochs)
+
+
+def reduce_two_axes(args):
+    in0 = Input(shape=(32,), dtype="float32")
+    x0 = Dense(20, activation="relu")(in0)
+    nx0 = Reshape((10, 2))(x0)
+    out = K.sum(nx0, axis=[1, 2])  # B
+    model = flexflow.keras.models.Model(in0, out)
+    model.compile(optimizer=flexflow.keras.optimizers.Adam(learning_rate=0.001),
+                  loss="mean_squared_error", metrics=["mean_squared_error"],
+                  batch_size=args.batch_size)
+    n = args.num_samples
+    model.fit(np.random.randn(n, 32).astype(np.float32),
+              np.random.randn(n).astype(np.float32), epochs=args.epochs)
+
+
+def top_level_task(args):
+    reduce_one_axis(args)
+    reduce_two_axes(args)
+
+
+if __name__ == "__main__":
+    print("K.sum reduce")
+    top_level_task(example_args(epochs=2, num_samples=512))

@@ -1,0 +1,28 @@
+"""L2 kernel regularization (reference:
+examples/python/keras/regularizer.py — flexflow.keras.regularizers.L2)."""
+import numpy as np
+
+import flexflow.keras.models
+import flexflow.keras.optimizers
+from flexflow.keras.layers import Input, Dense
+from flexflow.keras.regularizers import L2
+
+from _example_args import example_args
+
+
+def top_level_task(args):
+    in0 = Input(shape=(32,), dtype="float32")
+    x = Dense(20, activation="relu", kernel_regularizer=L2(0.001))(in0)
+    out = Dense(1)(x)
+    model = flexflow.keras.models.Model(in0, out)
+    model.compile(optimizer=flexflow.keras.optimizers.Adam(learning_rate=0.001),
+                  loss="mean_squared_error", metrics=["mean_squared_error"],
+                  batch_size=args.batch_size)
+    n = args.num_samples
+    model.fit(np.random.randn(n, 32).astype(np.float32),
+              np.random.randn(n, 1).astype(np.float32), epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("regularizer")
+    top_level_task(example_args(epochs=2, num_samples=512))

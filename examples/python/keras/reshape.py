@@ -1,0 +1,28 @@
+"""Reshape layer example (reference: examples/python/keras/reshape.py)."""
+import numpy as np
+
+from flexflow.keras.models import Model
+from flexflow.keras.layers import Input, Dense, Reshape
+import flexflow.keras.optimizers
+
+from _example_args import example_args
+
+
+def top_level_task(args):
+    in0 = Input(shape=(32,), dtype="float32")
+    x = Dense(24, activation="relu")(in0)
+    x = Reshape((6, 4))(x)
+    x = Reshape((24,))(x)
+    out = Dense(1)(x)
+    model = Model(in0, out)
+    model.compile(optimizer=flexflow.keras.optimizers.Adam(learning_rate=0.001),
+                  loss="mean_squared_error", metrics=["mean_squared_error"],
+                  batch_size=args.batch_size)
+    n = args.num_samples
+    model.fit(np.random.randn(n, 32).astype(np.float32),
+              np.random.randn(n, 1).astype(np.float32), epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("Reshape")
+    top_level_task(example_args(epochs=2, num_samples=512))

@@ -1,0 +1,29 @@
+"""rsqrt + tensor addition (reference: examples/python/keras/rsqrt.py)."""
+import numpy as np
+
+import flexflow.keras.models
+import flexflow.keras.optimizers
+from flexflow.keras.layers import Input, Dense
+from flexflow.keras.backend.internal import rsqrt
+
+from _example_args import example_args
+
+
+def top_level_task(args):
+    in1 = Input(shape=(32,), dtype="float32")
+    in2 = Input(shape=(20,), dtype="float32")
+    x = Dense(20, activation="relu")(in1)
+    out = rsqrt(x + in2)
+    model = flexflow.keras.models.Model([in1, in2], out)
+    model.compile(optimizer=flexflow.keras.optimizers.Adam(learning_rate=0.001),
+                  loss="mean_squared_error", metrics=["mean_squared_error"],
+                  batch_size=args.batch_size)
+    n = args.num_samples
+    model.fit([np.random.randn(n, 32).astype(np.float32),
+               np.ones((n, 20), np.float32)],
+              np.random.randn(n, 20).astype(np.float32), epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("rsqrt")
+    top_level_task(example_args(epochs=2, num_samples=512))

@@ -1,0 +1,42 @@
+"""CIFAR-10 CNN, Sequential API (reference:
+examples/python/keras/seq_cifar10_cnn.py)."""
+from flexflow.keras.models import Sequential
+from flexflow.keras.layers import Conv2D, MaxPooling2D, Flatten, Dense, Activation
+import flexflow.keras.optimizers
+
+from accuracy import ModelAccuracy
+from _cifar import load_cifar
+from _example_args import example_args, verify_callbacks
+
+
+def top_level_task(args):
+    num_classes = 10
+    x_train, y_train = load_cifar(args.num_samples)
+
+    model = Sequential()
+    model.add(Conv2D(filters=32, input_shape=(3, 32, 32), kernel_size=(3, 3),
+                     strides=(1, 1), padding=(1, 1), activation="relu"))
+    model.add(Conv2D(filters=32, kernel_size=(3, 3), strides=(1, 1),
+                     padding=(1, 1), activation="relu"))
+    model.add(MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid"))
+    model.add(Conv2D(filters=64, kernel_size=(3, 3), strides=(1, 1),
+                     padding=(1, 1), activation="relu"))
+    model.add(Conv2D(filters=64, kernel_size=(3, 3), strides=(1, 1),
+                     padding=(1, 1), activation="relu"))
+    model.add(MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid"))
+    model.add(Flatten())
+    model.add(Dense(512, activation="relu"))
+    model.add(Dense(num_classes))
+    model.add(Activation("softmax"))
+
+    opt = flexflow.keras.optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  batch_size=args.batch_size)
+    model.fit(x_train, y_train, epochs=args.epochs,
+              callbacks=verify_callbacks(args, ModelAccuracy.CIFAR10_CNN))
+
+
+if __name__ == "__main__":
+    print("Sequential model, cifar10 cnn")
+    top_level_task(example_args())

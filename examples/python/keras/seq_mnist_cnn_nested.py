@@ -1,0 +1,50 @@
+"""MNIST CNN as Sequential-of-models (reference:
+examples/python/keras/seq_mnist_cnn_nested.py — Sequential feature extractor
++ functional classifier nested into one Sequential)."""
+from flexflow.keras.models import Model, Sequential
+from flexflow.keras.layers import (
+    Input, Conv2D, MaxPooling2D, Flatten, Dense, Activation)
+import flexflow.keras.optimizers
+from flexflow.keras.datasets import mnist
+
+from accuracy import ModelAccuracy
+from _example_args import example_args, verify_callbacks
+
+
+def top_level_task(args):
+    num_classes = 10
+    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
+    x_train = x_train.reshape(-1, 1, 28, 28).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+
+    model1 = Sequential([
+        Conv2D(filters=32, input_shape=(1, 28, 28), kernel_size=(3, 3),
+               strides=(1, 1), padding=(1, 1), activation="relu"),
+        Conv2D(filters=64, kernel_size=(3, 3), strides=(1, 1),
+               padding=(1, 1), activation="relu"),
+        MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid"),
+        Flatten(),
+    ])
+
+    input_tensor = Input(shape=(12544,))
+    x = Dense(512, activation="relu")(input_tensor)
+    x = Dense(num_classes)(x)
+    out = Activation("softmax")(x)
+    model2 = Model(input_tensor, out)
+
+    model = Sequential()
+    model.add(model1)
+    model.add(model2)
+    print(model.summary())
+
+    opt = flexflow.keras.optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  batch_size=args.batch_size)
+    model.fit(x_train, y_train, epochs=args.epochs,
+              callbacks=verify_callbacks(args, ModelAccuracy.MNIST_CNN))
+
+
+if __name__ == "__main__":
+    print("Sequential model, mnist cnn nested model")
+    top_level_task(example_args())

@@ -1,0 +1,35 @@
+"""MNIST MLP, Sequential API (reference:
+examples/python/keras/seq_mnist_mlp.py)."""
+from flexflow.keras.models import Sequential
+from flexflow.keras.layers import Dense, Activation
+import flexflow.keras.optimizers
+from flexflow.keras.datasets import mnist
+
+from accuracy import ModelAccuracy
+from _example_args import example_args, verify_callbacks
+
+
+def top_level_task(args):
+    num_classes = 10
+    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
+    x_train = x_train.reshape(-1, 784).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+
+    model = Sequential()
+    model.add(Dense(512, input_shape=(784,), activation="relu"))
+    model.add(Dense(512, activation="relu"))
+    model.add(Dense(num_classes))
+    model.add(Activation("softmax"))
+
+    opt = flexflow.keras.optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  batch_size=args.batch_size)
+    print(model.summary())
+    model.fit(x_train, y_train, epochs=args.epochs,
+              callbacks=verify_callbacks(args, ModelAccuracy.MNIST_MLP))
+
+
+if __name__ == "__main__":
+    print("Sequential model, mnist mlp")
+    top_level_task(example_args())

@@ -1,0 +1,52 @@
+"""Net2Net MNIST MLP with Sequential API (reference:
+examples/python/keras/seq_mnist_mlp_net2net.py — weights pulled by index)."""
+from flexflow.keras.models import Sequential
+from flexflow.keras.layers import Dense, Activation
+import flexflow.keras.optimizers
+from flexflow.keras.datasets import mnist
+
+from accuracy import ModelAccuracy
+from _example_args import example_args, verify_callbacks
+
+
+def build(num_classes):
+    model = Sequential()
+    model.add(Dense(512, input_shape=(784,), activation="relu"))
+    model.add(Dense(512, activation="relu"))
+    model.add(Dense(num_classes))
+    model.add(Activation("softmax"))
+    return model
+
+
+def top_level_task(args):
+    num_classes = 10
+    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
+    x_train = x_train.reshape(-1, 784).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+
+    teacher = build(num_classes)
+    teacher.compile(optimizer=flexflow.keras.optimizers.SGD(learning_rate=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy", "sparse_categorical_crossentropy"],
+                    batch_size=args.batch_size)
+    teacher.fit(x_train, y_train, epochs=args.epochs)
+
+    d1 = teacher.get_layer(index=0).get_weights(teacher.ffmodel)
+    d2 = teacher.get_layer(index=1).get_weights(teacher.ffmodel)
+    d3 = teacher.get_layer(index=2).get_weights(teacher.ffmodel)
+
+    student = build(num_classes)
+    student.compile(optimizer=flexflow.keras.optimizers.SGD(learning_rate=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy", "sparse_categorical_crossentropy"],
+                    batch_size=args.batch_size)
+    student.get_layer(index=0).set_weights(d1)
+    student.get_layer(index=1).set_weights(d2)
+    student.get_layer(index=2).set_weights(d3)
+    student.fit(x_train, y_train, epochs=args.epochs,
+                callbacks=verify_callbacks(args, ModelAccuracy.MNIST_MLP))
+
+
+if __name__ == "__main__":
+    print("Sequential model, mnist mlp net2net")
+    top_level_task(example_args())

@@ -1,0 +1,45 @@
+"""Reuters topic MLP, Sequential API (reference:
+examples/python/keras/seq_reuters_mlp.py — bag-of-words vectorization +
+Dense 512)."""
+import numpy as np
+
+from flexflow.keras.models import Sequential
+from flexflow.keras.layers import Dense, Activation
+import flexflow.keras.optimizers
+from flexflow.keras.datasets import reuters
+
+from accuracy import ModelAccuracy
+from _example_args import example_args, verify_callbacks
+
+
+def vectorize(seqs, num_words):
+    out = np.zeros((len(seqs), num_words), dtype="float32")
+    for i, s in enumerate(seqs):
+        out[i, np.asarray(s) % num_words] = 1.0
+    return out
+
+
+def top_level_task(args):
+    num_words = 1000
+    num_classes = 46
+    (x_train, y_train), _ = reuters.load_data(num_words=num_words,
+                                              n_train=args.num_samples)
+    x_train = vectorize(x_train, num_words)
+    y_train = y_train.astype("int32").reshape(-1, 1)
+
+    model = Sequential()
+    model.add(Dense(512, input_shape=(num_words,), activation="relu"))
+    model.add(Dense(num_classes))
+    model.add(Activation("softmax"))
+
+    opt = flexflow.keras.optimizers.Adam(learning_rate=0.001)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  batch_size=args.batch_size)
+    model.fit(x_train, y_train, epochs=args.epochs,
+              callbacks=verify_callbacks(args, ModelAccuracy.REUTERS_MLP))
+
+
+if __name__ == "__main__":
+    print("Sequential model, reuters mlp")
+    top_level_task(example_args())

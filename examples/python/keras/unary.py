@@ -1,0 +1,37 @@
+"""Elementwise merge smoke tests: Add/Subtract layers and their functional
+aliases (reference: examples/python/keras/unary.py add_test/subtract_test)."""
+import numpy as np
+
+from flexflow.keras.models import Model
+from flexflow.keras.layers import Input, Dense, Add, Subtract, add, subtract
+import flexflow.keras.optimizers
+
+from _example_args import example_args
+
+
+def _run(merge, args):
+    in1 = Input(shape=(16,), dtype="float32")
+    in2 = Input(shape=(32,), dtype="float32")
+    x1 = Dense(8, activation="relu")(in1)
+    x2 = Dense(8, activation="relu")(in2)
+    out = Dense(1)(merge([x1, x2]))
+    model = Model([in1, in2], out)
+    model.compile(optimizer=flexflow.keras.optimizers.SGD(learning_rate=0.01),
+                  loss="mean_squared_error", metrics=["mean_squared_error"],
+                  batch_size=args.batch_size)
+    n = args.num_samples
+    model.fit([np.random.randn(n, 16).astype(np.float32),
+               np.random.randn(n, 32).astype(np.float32)],
+              np.random.randn(n, 1).astype(np.float32), epochs=args.epochs)
+
+
+def top_level_task(args):
+    _run(Add(), args)
+    _run(Subtract(), args)
+    _run(add, args)
+    _run(subtract, args)
+
+
+if __name__ == "__main__":
+    print("Elementwise unary/merge tests")
+    top_level_task(example_args(epochs=2, num_samples=512))

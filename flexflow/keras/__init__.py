@@ -26,6 +26,7 @@ from flexflow_tpu.frontends.keras import (  # noqa: F401
     Subtract,
 )
 from . import (  # noqa: F401
+    backend,
     callbacks,
     datasets,
     initializers,

@@ -608,6 +608,23 @@ class FFModel:
 
     optimizer_setter = set_optimizer  # cffi property-style parity
 
+    def get_learning_rate(self) -> float:
+        """Current learning rate, whatever the optimizer calls it
+        (SGDOptimizer.lr, AdamOptimizer.alpha — optimizer.h:36-117)."""
+        opt = self.optimizer
+        return opt.alpha if hasattr(opt, "alpha") else opt.lr
+
+    def set_learning_rate(self, lr: float) -> None:
+        """Set the learning rate on the compiled optimizer and invalidate
+        the jitted step (the rate is traced as a constant)."""
+        opt = self.optimizer
+        field = "alpha" if hasattr(opt, "alpha") else "lr"
+        if getattr(opt, field) == lr:
+            return
+        setattr(opt, field, lr)
+        if self.executor is not None:
+            self.executor.invalidate_step_cache()
+
     def compile(
         self,
         optimizer: Optional[Optimizer] = None,

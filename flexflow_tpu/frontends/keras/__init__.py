@@ -1,5 +1,6 @@
 """Keras-compatible frontend (reference: python/flexflow/keras/)."""
 from . import (  # noqa: F401
+    backend,
     callbacks,
     datasets,
     initializers,

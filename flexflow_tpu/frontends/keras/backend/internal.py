@@ -1,0 +1,11 @@
+"""reference: python/flexflow/keras/backend/internal.py — rsqrt/gather
+functional wrappers (+ the layer classes live in ..layers)."""
+from ..layers import BatchMatmul, Cos, Exp, Gather, Pow, ReduceSum, Rsqrt, Sin  # noqa: F401
+
+
+def rsqrt(x, name=""):
+    return Rsqrt(name=name)(x)
+
+
+def gather(x, indices, axis, name=""):
+    return Gather(axis, name=name)([x, indices])

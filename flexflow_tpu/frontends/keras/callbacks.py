@@ -85,3 +85,18 @@ class EpochVerifyMetrics(Callback):
         assert self.best >= self.threshold, (
             f"best epoch accuracy {self.best:.2f}% below {self.threshold}%"
         )
+
+
+class LearningRateScheduler(Callback):
+    """Per-epoch LR schedule (reference: keras/callbacks.py
+    LearningRateScheduler — examples/python/keras/callback.py). Mutates the
+    compiled optimizer's lr and invalidates the cached train step so the
+    next epoch re-traces with the new rate (Legion-trace ≈ jit-cache
+    analogy: a changed constant means a new trace)."""
+
+    def __init__(self, schedule):
+        self.schedule = schedule
+
+    def on_epoch_begin(self, epoch, logs=None):
+        ffmodel = getattr(self.model, "ffmodel", self.model)
+        ffmodel.set_learning_rate(self.schedule(epoch))

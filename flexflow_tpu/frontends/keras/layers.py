@@ -17,7 +17,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ...ff_types import ActiMode, AggrMode, DataType, PoolType
+from ...ff_types import ActiMode, AggrMode, DataType, PoolType, to_data_type
 
 _uid = itertools.count(1)
 
@@ -72,9 +72,9 @@ class Layer:
 
 
 def Input(shape: Sequence[int], dtype=DataType.DT_FLOAT, name: str = "") -> KerasTensor:
-    """reference: keras input_layer.Input"""
+    """reference: keras input_layer.Input (string dtypes accepted like keras)"""
     t = KerasTensor(tuple(shape), source_layer=None)
-    t.dtype = dtype
+    t.dtype = to_data_type(dtype)
     return t
 
 
@@ -424,3 +424,128 @@ class MultiHeadAttention(Layer):
         )
         self._ff_layer = ffmodel.layers[-1]
         return [t]
+
+
+def concatenate(inputs, axis=1, name=""):
+    """Functional alias (reference: keras/layers/merge.py `concatenate`)."""
+    return Concatenate(axis=axis, name=name)(inputs)
+
+
+# ---------------------------------------------------------------------------
+# Backend op layers (reference: python/flexflow/keras/backend/internal.py —
+# BatchMatmul/Sin/Cos/Exp/Pow/ReduceSum/Rsqrt/Gather layer classes backing
+# the K.* functional API)
+# ---------------------------------------------------------------------------
+
+class _UnaryOp(Layer):
+    _ff_call = ""
+
+    def build_ff(self, ffmodel, ff_inputs):
+        t = getattr(ffmodel, self._ff_call)(ff_inputs[0], name=self.name)
+        self._ff_layer = ffmodel.layers[-1]
+        return [t]
+
+
+class Sin(_UnaryOp):
+    _ff_call = "sin"
+
+
+class Cos(_UnaryOp):
+    _ff_call = "cos"
+
+
+class Exp(_UnaryOp):
+    _ff_call = "exp"
+
+
+class Rsqrt(_UnaryOp):
+    _ff_call = "rsqrt"
+
+
+class Pow(Layer):
+    def __init__(self, a: float, **kw):
+        super().__init__(**kw)
+        self.a = float(a)
+
+    def build_ff(self, ffmodel, ff_inputs):
+        t = ffmodel.pow(ff_inputs[0], self.a, name=self.name)
+        self._ff_layer = ffmodel.layers[-1]
+        return [t]
+
+
+class ReduceSum(Layer):
+    """K.sum over non-batch axes (axis counts the batch dim, like keras)."""
+
+    def __init__(self, axis, keepdims: bool = False, **kw):
+        super().__init__(**kw)
+        self.axis = [axis] if isinstance(axis, int) else list(axis)
+        self.keepdims = keepdims
+
+    def compute_output_shape(self, shapes):
+        shape = list(shapes[0])
+        # self.axis includes the batch dim at 0; tensor shape here excludes
+        # it. Negative axes count from the end of the full (batched) shape.
+        rank = len(shape) + 1
+        drop = sorted((a if a >= 0 else rank + a) - 1 for a in self.axis)
+        if self.keepdims:
+            for a in drop:
+                shape[a] = 1
+        else:
+            for a in reversed(drop):
+                del shape[a]
+        return [tuple(shape)]
+
+    def build_ff(self, ffmodel, ff_inputs):
+        t = ffmodel.reduce_sum(
+            ff_inputs[0], self.axis, keepdims=self.keepdims, name=self.name
+        )
+        self._ff_layer = ffmodel.layers[-1]
+        return [t]
+
+
+class Gather(Layer):
+    """torch.gather semantics (reference internal.py Gather → ffmodel.gather)."""
+
+    def __init__(self, axis: int, **kw):
+        super().__init__(**kw)
+        self.axis = axis
+
+    def compute_output_shape(self, shapes):
+        return [shapes[1]]
+
+    def build_ff(self, ffmodel, ff_inputs):
+        t = ffmodel.gather(ff_inputs[0], ff_inputs[1], self.axis, name=self.name)
+        self._ff_layer = ffmodel.layers[-1]
+        return [t]
+
+
+class BatchMatmul(Layer):
+    def compute_output_shape(self, shapes):
+        a, b = shapes
+        return [tuple(a[:-1]) + (b[-1],)]
+
+    def build_ff(self, ffmodel, ff_inputs):
+        t = ffmodel.batch_matmul(ff_inputs[0], ff_inputs[1], name=self.name)
+        self._ff_layer = ffmodel.layers[-1]
+        return [t]
+
+
+# functional merge aliases (reference: keras/layers/merge.py:63-132)
+
+def add(inputs, name=""):
+    return Add(name=name)(inputs)
+
+
+def subtract(inputs, name=""):
+    return Subtract(name=name)(inputs)
+
+
+def multiply(inputs, name=""):
+    return Multiply(name=name)(inputs)
+
+
+# python operators on KerasTensor (the reference's tensor wrappers support
+# `x + y` in examples, e.g. examples/python/keras/rsqrt.py)
+KerasTensor.__add__ = lambda self, other: Add()([self, other])
+KerasTensor.__sub__ = lambda self, other: Subtract()([self, other])
+KerasTensor.__mul__ = lambda self, other: Multiply()([self, other])

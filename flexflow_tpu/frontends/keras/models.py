@@ -23,6 +23,7 @@ _LOSS_MAP = {
     "sparse_categorical_crossentropy": LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
     "mean_squared_error": LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
     "mse": LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+    "identity": LossType.LOSS_IDENTITY,
 }
 
 _METRIC_MAP = {
@@ -160,9 +161,55 @@ class Model:
         print(text)
         return text
 
+    def __call__(self, inputs):
+        """Use a built model as a layer (reference: nested-model examples,
+        e.g. examples/python/keras/seq_mnist_cnn_nested.py — a Sequential /
+        functional Model is wired into another model's graph). Re-wires this
+        model's layers onto the given input tensors and returns the mapped
+        output tensor(s)."""
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        assert len(ins) == len(self.inputs), (
+            f"model {self.name} expects {len(self.inputs)} inputs, got {len(ins)}"
+        )
+        if getattr(self, "_called_as_layer", False):
+            # Layer objects are re-wired in place, so a second call would
+            # corrupt the graph built by the first (no keras-style layer
+            # sharing). Fail loudly instead of silently mis-building.
+            raise NotImplementedError(
+                f"model {self.name} was already called on tensors once; "
+                "re-calling a model (weight sharing) is not supported — "
+                "build a fresh model instead"
+            )
+        self._called_as_layer = True
+        mapping = {id(kt): new for kt, new in zip(self.inputs, ins)}
+        order = self._toposort_layers()
+        old_model_outs = list(self.outputs)
+        for layer in order:
+            old_outs = list(layer.outputs)
+            new_ins = [mapping[id(t)] for t in layer.inbound]
+            res = layer(new_ins if len(new_ins) > 1 else new_ins[0])
+            new_outs = res if isinstance(res, (list, tuple)) else [res]
+            for o, n in zip(old_outs, new_outs):
+                mapping[id(o)] = n
+        new_model_outs = [mapping[id(o)] for o in old_model_outs]
+        self.inputs = list(ins)
+        self.outputs = new_model_outs
+        return new_model_outs[0] if len(new_model_outs) == 1 else new_model_outs
+
     @property
     def layers(self) -> List[Layer]:
         return self._toposort_layers()
+
+    def get_layer(self, name: Optional[str] = None, index: Optional[int] = None):
+        """reference: base_model.py get_layer(name=, index=) — used by the
+        net2net examples to pull teacher weights."""
+        layers = self._toposort_layers()
+        if index is not None:
+            return layers[index]
+        for layer in layers:
+            if layer.name == name:
+                return layer
+        raise ValueError(f"no layer named {name!r}")
 
 
 class Sequential(Model):
@@ -178,6 +225,16 @@ class Sequential(Model):
         if isinstance(layer_or_input, KerasTensor):
             self.inputs = [layer_or_input]
             self._last = layer_or_input
+            return
+        if isinstance(layer_or_input, Model):
+            # nested model (reference: seq_mnist_cnn_nested.py)
+            m = layer_or_input
+            if not self.inputs:
+                self.inputs = list(m.inputs)
+                self._last = m.outputs[0]
+            else:
+                self._last = m(self._last)
+            self.outputs = [self._last]
             return
         if not self.inputs:
             # first layer must declare input_shape

@@ -23,7 +23,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ...ff_types import ActiMode, AggrMode, DataType, PoolType
+from ...ff_types import ActiMode, AggrMode, DataType, OperatorType, PoolType
 
 try:
     import torch
@@ -128,6 +128,8 @@ def _bn_build(ff, cfg, args, name):
 
 
 def _bn_weights(mod):
+    if mod.weight is None:  # BatchNorm2d(affine=False)
+        return None
     return [mod.weight.detach().numpy(), mod.bias.detach().numpy()]
 
 
@@ -438,9 +440,13 @@ def _replay_fn(ff, target: str, args, kwargs):
     if target == "getitem":
         if isinstance(x, (list, tuple)):
             return x[args[1]]
-        if args[1] == 0:
-            # tuple-returning torch ops (e.g. MultiheadAttention's
-            # (output, weights)) map to a single output Tensor here
+        owner_op = getattr(getattr(x, "owner_layer", None), "op_type", None)
+        if args[1] == 0 and owner_op in (
+            OperatorType.OP_MULTIHEAD_ATTENTION, OperatorType.OP_LSTM,
+        ):
+            # tuple-returning torch ops (MultiheadAttention's
+            # (output, weights), LSTM's (output, state)) map to a single
+            # output Tensor here; true tensor indexing stays a loud error
             return x
         raise NotImplementedError(f"getitem[{args[1]}] on single-output op")
     raise NotImplementedError(f"torch call {target}")

@@ -218,6 +218,16 @@ class PCGExecutor:
                 terms.append(0.5 * lam * jnp.sum(wf * wf))
         return terms
 
+    def invalidate_step_cache(self) -> None:
+        """Drop the cached jitted steps so the next build re-traces.
+
+        Needed when a traced-as-constant hyperparameter changes (e.g. the
+        learning rate from a keras LearningRateScheduler) — the Legion
+        analogy is ending a captured trace when the task graph changes."""
+        self._train_step = None
+        self._eval_step = None
+        self._fwd = None
+
     def build_train_step(self) -> Callable:
         if self._train_step is not None:
             return self._train_step

@@ -1,0 +1,37 @@
+"""Keras example-suite smoke tests (reference: tests/multi_gpu_tests.sh runs
+the examples/python/keras scripts; pass criterion is "trains without
+crashing" — SURVEY §4). A representative subset runs here with tiny sizes;
+the full tree is runnable by hand with reference-scale defaults."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples", "python", "keras")
+
+SCRIPTS = [
+    "func_mnist_mlp.py",          # functional API
+    "func_mnist_mlp_concat2.py",  # multi-input + nested concat
+    "seq_mnist_cnn_nested.py",    # Sequential-of-models nesting
+    "func_cifar10_cnn_net2net.py",  # get_layer + weight transfer
+    "reduce_sum.py",              # K.sum backend op
+    "gather.py",                  # K.internal.gather
+    "callback.py",                # LearningRateScheduler
+]
+
+
+@pytest.mark.parametrize("script", SCRIPTS)
+def test_keras_example(script):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.abspath(os.path.join(EXAMPLES, "..", "..", ".."))
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    proc = subprocess.run(
+        [sys.executable, script, "--epochs", "1", "--num-samples", "96",
+         "--batch-size", "32"],
+        cwd=EXAMPLES, env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, f"{script} failed:\n{proc.stdout}\n{proc.stderr}"
