@@ -1,0 +1,11 @@
+"""Shared MNIST loading for the example suite (counterpart of _cifar.py)."""
+from flexflow.keras.datasets import mnist
+
+
+def load_mnist(num_samples, image=False):
+    """Returns (x, y): x flat (N,784) or NCHW (N,1,28,28), y int32 (N,1)."""
+    (x_train, y_train), _ = mnist.load_data(n_train=num_samples)
+    shape = (-1, 1, 28, 28) if image else (-1, 784)
+    x_train = x_train.reshape(*shape).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+    return x_train, y_train

@@ -1,11 +1,9 @@
 """MNIST MLP with concatenated branches (reference:
 examples/python/keras/func_mnist_mlp_concat.py)."""
-import numpy as np
-
 from flexflow.keras.models import Model
 from flexflow.keras.layers import Input, Dense, Activation, Concatenate
 import flexflow.keras.optimizers
-from flexflow.keras.datasets import mnist
+from _mnist import load_mnist
 
 from accuracy import ModelAccuracy
 from _example_args import example_args, verify_callbacks
@@ -13,9 +11,7 @@ from _example_args import example_args, verify_callbacks
 
 def top_level_task(args):
     num_classes = 10
-    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
-    x_train = x_train.reshape(-1, 784).astype("float32") / 255
-    y_train = y_train.astype("int32").reshape(-1, 1)
+    x_train, y_train = load_mnist(args.num_samples)
 
     input_tensor = Input(shape=(784,))
     b1 = Dense(256, activation="relu")(input_tensor)

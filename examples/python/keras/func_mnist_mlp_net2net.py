@@ -1,12 +1,10 @@
 """Net2Net MNIST MLP: teacher trains, student starts from teacher weights
 (reference: examples/python/keras/func_mnist_mlp_net2net.py — get_layer +
 get_weights/set_weights transfer)."""
-import numpy as np
-
 from flexflow.keras.models import Model
 from flexflow.keras.layers import Input, Dense, Activation
 import flexflow.keras.optimizers
-from flexflow.keras.datasets import mnist
+from _mnist import load_mnist
 
 from accuracy import ModelAccuracy
 from _example_args import example_args, verify_callbacks
@@ -23,9 +21,7 @@ def build(num_classes):
 
 def top_level_task(args):
     num_classes = 10
-    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
-    x_train = x_train.reshape(-1, 784).astype("float32") / 255
-    y_train = y_train.astype("int32").reshape(-1, 1)
+    x_train, y_train = load_mnist(args.num_samples)
 
     opt = flexflow.keras.optimizers.SGD(learning_rate=0.01)
     teacher = build(num_classes)

@@ -5,7 +5,7 @@ from flexflow.keras.models import Model, Sequential
 from flexflow.keras.layers import (
     Input, Conv2D, MaxPooling2D, Flatten, Dense, Activation)
 import flexflow.keras.optimizers
-from flexflow.keras.datasets import mnist
+from _mnist import load_mnist
 
 from accuracy import ModelAccuracy
 from _example_args import example_args, verify_callbacks
@@ -13,9 +13,7 @@ from _example_args import example_args, verify_callbacks
 
 def top_level_task(args):
     num_classes = 10
-    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
-    x_train = x_train.reshape(-1, 1, 28, 28).astype("float32") / 255
-    y_train = y_train.astype("int32").reshape(-1, 1)
+    x_train, y_train = load_mnist(args.num_samples, image=True)
 
     model1 = Sequential([
         Conv2D(filters=32, input_shape=(1, 28, 28), kernel_size=(3, 3),

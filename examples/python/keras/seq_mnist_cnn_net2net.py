@@ -3,7 +3,7 @@ examples/python/keras/seq_mnist_cnn_net2net.py)."""
 from flexflow.keras.models import Sequential
 from flexflow.keras.layers import Conv2D, MaxPooling2D, Flatten, Dense, Activation
 import flexflow.keras.optimizers
-from flexflow.keras.datasets import mnist
+from _mnist import load_mnist
 
 from accuracy import ModelAccuracy
 from _example_args import example_args, verify_callbacks
@@ -25,9 +25,7 @@ def build(num_classes):
 
 def top_level_task(args):
     num_classes = 10
-    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
-    x_train = x_train.reshape(-1, 1, 28, 28).astype("float32") / 255
-    y_train = y_train.astype("int32").reshape(-1, 1)
+    x_train, y_train = load_mnist(args.num_samples, image=True)
 
     teacher = build(num_classes)
     teacher.compile(optimizer=flexflow.keras.optimizers.SGD(learning_rate=0.01),

@@ -1,0 +1,43 @@
+"""Train AlexNet imported from ONNX on upscaled CIFAR-10 (reference:
+examples/python/onnx/alexnet.py)."""
+import os
+import numpy as np
+
+from flexflow.core import *  # noqa: F401,F403
+from flexflow.keras.datasets import cifar10
+from flexflow.onnx.model import ONNXModel
+
+from _example_args import example_args
+from alexnet_pt import export
+
+
+def top_level_task(args, image=224):
+    ffconfig = FFConfig()
+    ffconfig.batch_size = args.batch_size
+    ffmodel = FFModel(ffconfig)
+    input1 = ffmodel.create_tensor(
+        [args.batch_size, 3, image, image], DataType.DT_FLOAT)
+
+    path = f"alexnet_pt_{image}.onnx"
+    if not os.path.exists(path):
+        export(path, image=image)
+    onnx_model = ONNXModel(path)
+    t = onnx_model.apply(ffmodel, {"input.1": input1})
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                    metrics=[MetricsType.METRICS_ACCURACY])
+    onnx_model.load_weights(ffmodel)
+
+    (x_train, y_train), _ = cifar10.load_data(n_train=args.num_samples)
+    x_train = x_train.transpose(0, 3, 1, 2).astype("float32") / 255
+    x_train = x_train.repeat(image // 32, axis=2).repeat(image // 32, axis=3)
+    y_train = y_train.astype("int32").reshape(-1, 1)
+    ffmodel.fit(x=x_train, y=y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("alexnet onnx")
+    a = example_args(num_samples=512)
+    image = 64 if a.num_samples <= 512 else 224
+    top_level_task(a, image=image)

@@ -1,0 +1,70 @@
+"""Export AlexNet to ONNX, torch layout (reference:
+examples/python/onnx/alexnet_pt.py)."""
+import numpy as np
+
+from flexflow.onnx.model import proto
+
+CONVS = [  # (name, cin, cout, k, s, p)
+    ("conv1", 3, 64, 11, 4, 2),
+    ("conv2", 64, 192, 5, 1, 2),
+    ("conv3", 192, 384, 3, 1, 1),
+    ("conv4", 384, 256, 3, 1, 1),
+    ("conv5", 256, 256, 3, 1, 1),
+]
+POOL_AFTER = {"conv1", "conv2", "conv5"}
+
+
+def export(path="alexnet_pt.onnx", seed=0, image=224):
+    rng = np.random.RandomState(seed)
+    nodes, inits = [], []
+    prev = "input.1"
+    for name, cin, cout, k, s, p in CONVS:
+        w = (rng.randn(cout, cin, k, k) / np.sqrt(cin * k * k)).astype(np.float32)
+        b = np.zeros(cout, np.float32)
+        inits += [proto.from_array(w, f"{name}.weight"),
+                  proto.from_array(b, f"{name}.bias")]
+        nodes.append(proto.make_node(
+            "Conv", [prev, f"{name}.weight", f"{name}.bias"], [name],
+            name=name, kernel_shape=[k, k], strides=[s, s], pads=[p, p, p, p]))
+        nodes.append(proto.make_node("Relu", [name], [name + "_r"],
+                                     name=name + "_relu"))
+        prev = name + "_r"
+        if name in POOL_AFTER:
+            nodes.append(proto.make_node("MaxPool", [prev], [name + "_p"],
+                                         name=name + "_pool",
+                                         kernel_shape=[3, 3], strides=[2, 2]))
+            prev = name + "_p"
+    nodes.append(proto.make_node("Flatten", [prev], ["flat"], name="flatten",
+                                 axis=1))
+    spatial = {224: 6, 64: 1}.get(image)
+    feat = 256 * spatial * spatial
+    dims = [feat, 4096, 4096, 10]
+    prev = "flat"
+    for i in range(3):
+        w = (rng.randn(dims[i + 1], dims[i]) / np.sqrt(dims[i])).astype(np.float32)
+        b = np.zeros(dims[i + 1], np.float32)
+        inits += [proto.from_array(w, f"fc{i+1}.weight"),
+                  proto.from_array(b, f"fc{i+1}.bias")]
+        nodes.append(proto.make_node(
+            "Gemm", [prev, f"fc{i+1}.weight", f"fc{i+1}.bias"], [f"g{i+1}"],
+            name=f"fc{i+1}", transB=1))
+        prev = f"g{i+1}"
+        if i < 2:
+            nodes.append(proto.make_node("Relu", [prev], [prev + "r"],
+                                         name=f"fc{i+1}_relu"))
+            prev = prev + "r"
+    nodes.append(proto.make_node("Softmax", [prev], ["output"], name="softmax",
+                                 axis=-1))
+    graph = proto.make_graph(
+        nodes, "torch_jit",
+        [proto.make_tensor_value_info("input.1", proto.TensorProto.FLOAT,
+                                      ["N", 3, image, image])],
+        [proto.make_tensor_value_info("output", proto.TensorProto.FLOAT,
+                                      ["N", 10])],
+        initializer=inits)
+    proto.save_model(proto.make_model(graph), path)
+    return path
+
+
+if __name__ == "__main__":
+    print("exported", export())

@@ -1,0 +1,58 @@
+"""Export the CIFAR-10 CNN to ONNX, torch layout (reference:
+examples/python/onnx/cifar10_cnn_pt.py)."""
+import numpy as np
+
+from flexflow.onnx.model import proto
+
+
+def _conv(rng, name, cin, cout, nodes, inits, prev, out):
+    w = (rng.randn(cout, cin, 3, 3) / np.sqrt(cin * 9)).astype(np.float32)
+    b = np.zeros(cout, np.float32)
+    inits += [proto.from_array(w, f"{name}.weight"),
+              proto.from_array(b, f"{name}.bias")]
+    nodes.append(proto.make_node(
+        "Conv", [prev, f"{name}.weight", f"{name}.bias"], [out], name=name,
+        kernel_shape=[3, 3], strides=[1, 1], pads=[1, 1, 1, 1]))
+    nodes.append(proto.make_node("Relu", [out], [out + "_r"], name=name + "_relu"))
+    return out + "_r"
+
+
+def export(path="cifar10_cnn_pt.onnx", seed=0):
+    rng = np.random.RandomState(seed)
+    nodes, inits = [], []
+    prev = "input.1"
+    prev = _conv(rng, "conv1", 3, 32, nodes, inits, prev, "c1")
+    prev = _conv(rng, "conv2", 32, 32, nodes, inits, prev, "c2")
+    nodes.append(proto.make_node("MaxPool", [prev], ["p1"], name="pool1",
+                                 kernel_shape=[2, 2], strides=[2, 2]))
+    prev = _conv(rng, "conv3", 32, 64, nodes, inits, "p1", "c3")
+    prev = _conv(rng, "conv4", 64, 64, nodes, inits, prev, "c4")
+    nodes.append(proto.make_node("MaxPool", [prev], ["p2"], name="pool2",
+                                 kernel_shape=[2, 2], strides=[2, 2]))
+    nodes.append(proto.make_node("Flatten", ["p2"], ["flat"], name="flatten", axis=1))
+    w = (rng.randn(512, 64 * 8 * 8) / 64).astype(np.float32)
+    b = np.zeros(512, np.float32)
+    w2 = (rng.randn(10, 512) / 16).astype(np.float32)
+    b2 = np.zeros(10, np.float32)
+    inits += [proto.from_array(w, "fc1.weight"), proto.from_array(b, "fc1.bias"),
+              proto.from_array(w2, "fc2.weight"), proto.from_array(b2, "fc2.bias")]
+    nodes.append(proto.make_node("Gemm", ["flat", "fc1.weight", "fc1.bias"],
+                                 ["g1"], name="fc1", transB=1))
+    nodes.append(proto.make_node("Relu", ["g1"], ["g1r"], name="fc1_relu"))
+    nodes.append(proto.make_node("Gemm", ["g1r", "fc2.weight", "fc2.bias"],
+                                 ["g2"], name="fc2", transB=1))
+    nodes.append(proto.make_node("Softmax", ["g2"], ["output"], name="softmax",
+                                 axis=-1))
+    graph = proto.make_graph(
+        nodes, "torch_jit",
+        [proto.make_tensor_value_info("input.1", proto.TensorProto.FLOAT,
+                                      ["N", 3, 32, 32])],
+        [proto.make_tensor_value_info("output", proto.TensorProto.FLOAT,
+                                      ["N", 10])],
+        initializer=inits)
+    proto.save_model(proto.make_model(graph), path)
+    return path
+
+
+if __name__ == "__main__":
+    print("exported", export())

@@ -1,0 +1,45 @@
+"""Train an MNIST MLP imported from an ONNX file (reference:
+examples/python/onnx/mnist_mlp.py — ONNXModel("mnist_mlp_pt.onnx").apply)."""
+import os
+import numpy as np
+
+from flexflow.core import *  # noqa: F401,F403
+from flexflow.keras.datasets import mnist
+from flexflow.onnx.model import ONNXModel
+
+from _example_args import example_args
+from mnist_mlp_pt import export
+
+
+def top_level_task(args):
+    ffconfig = FFConfig()
+    ffconfig.batch_size = args.batch_size
+    print("Python API batchSize(%d) workersPerNodes(%d) numNodes(%d)" % (
+        ffconfig.batch_size, ffconfig.workers_per_node, ffconfig.num_nodes))
+    ffmodel = FFModel(ffconfig)
+
+    input1 = ffmodel.create_tensor([args.batch_size, 784], DataType.DT_FLOAT)
+
+    path = "mnist_mlp_pt.onnx"
+    if not os.path.exists(path):
+        export(path)
+    onnx_model = ONNXModel(path)
+    t = onnx_model.apply(ffmodel, {"input.1": input1})
+
+    ffoptimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.optimizer = ffoptimizer
+    ffmodel.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                    metrics=[MetricsType.METRICS_ACCURACY,
+                             MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY])
+    onnx_model.load_weights(ffmodel)
+
+    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
+    x_train = x_train.reshape(-1, 784).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+
+    ffmodel.fit(x=x_train, y=y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("mnist mlp onnx")
+    top_level_task(example_args())

@@ -1,0 +1,63 @@
+"""Export + train the keras-layout ONNX MNIST MLP (reference:
+examples/python/onnx/mnist_mlp_keras.py — ONNXModelKeras; keras exports use
+MatMul with (in, out) kernels + Add bias)."""
+import numpy as np
+
+from flexflow.core import *  # noqa: F401,F403
+from flexflow.keras.datasets import mnist
+from flexflow.onnx.model import ONNXModelKeras, proto
+
+from _example_args import example_args
+
+
+def export(path="mnist_mlp_keras.onnx", seed=0):
+    rng = np.random.RandomState(seed)
+    dims = [784, 512, 512, 10]
+    nodes, inits = [], []
+    prev = "input_1"
+    for i in range(3):
+        w = (rng.randn(dims[i], dims[i + 1]) / np.sqrt(dims[i])).astype(np.float32)
+        inits.append(proto.from_array(w, f"dense_{i}/kernel"))
+        nodes.append(proto.make_node("MatMul", [prev, f"dense_{i}/kernel"],
+                                     [f"mm{i}"], name=f"MatMul_{i}"))
+        prev = f"mm{i}"
+        if i < 2:
+            nodes.append(proto.make_node("Relu", [prev], [f"relu{i}"],
+                                         name=f"Relu_{i}"))
+            prev = f"relu{i}"
+    nodes.append(proto.make_node("Softmax", [prev], ["dense_2"],
+                                 name="Softmax_0", axis=-1))
+    graph = proto.make_graph(
+        nodes, "keras_model",
+        [proto.make_tensor_value_info("input_1", proto.TensorProto.FLOAT,
+                                      ["N", 784])],
+        [proto.make_tensor_value_info("dense_2", proto.TensorProto.FLOAT,
+                                      ["N", 10])],
+        initializer=inits)
+    proto.save_model(proto.make_model(graph), path)
+    return path
+
+
+def top_level_task(args):
+    ffconfig = FFConfig()
+    ffconfig.batch_size = args.batch_size
+    ffmodel = FFModel(ffconfig)
+    input1 = ffmodel.create_tensor([args.batch_size, 784], DataType.DT_FLOAT)
+
+    onnx_model = ONNXModelKeras(export(), ffconfig, ffmodel)
+    t = onnx_model.apply(ffmodel, {"input_1": input1})
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                    metrics=[MetricsType.METRICS_ACCURACY])
+    onnx_model.load_weights(ffmodel)
+
+    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
+    x_train = x_train.reshape(-1, 784).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+    ffmodel.fit(x=x_train, y=y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("mnist mlp onnx (keras layout)")
+    top_level_task(example_args())

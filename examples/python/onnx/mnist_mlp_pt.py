@@ -1,0 +1,44 @@
+"""Export an MNIST MLP to ONNX in the layout torch.onnx.export produces
+(Gemm transB=1, torch-style names) — reference:
+examples/python/onnx/mnist_mlp_pt.py exports mnist_mlp_pt.onnx from torch.
+The `onnx`/`onnxscript` packages aren't in this image, so the ModelProto is
+written with the self-contained wire codec (flexflow_tpu.frontends.onnx.proto)
+— the output is a real protobuf .onnx file."""
+import numpy as np
+
+from flexflow.onnx.model import proto
+
+
+def export(path="mnist_mlp_pt.onnx", seed=0):
+    rng = np.random.RandomState(seed)
+    dims = [784, 512, 512, 10]
+    nodes, inits = [], []
+    prev = "input.1"
+    for i in range(3):
+        w = (rng.randn(dims[i + 1], dims[i]) / np.sqrt(dims[i])).astype(np.float32)
+        b = np.zeros(dims[i + 1], np.float32)
+        inits += [proto.from_array(w, f"fc{i+1}.weight"),
+                  proto.from_array(b, f"fc{i+1}.bias")]
+        out = f"gemm{i+1}"
+        nodes.append(proto.make_node(
+            "Gemm", [prev, f"fc{i+1}.weight", f"fc{i+1}.bias"], [out],
+            name=f"Gemm_{i}", alpha=1.0, beta=1.0, transB=1))
+        if i < 2:
+            nodes.append(proto.make_node("Relu", [out], [f"relu{i+1}"],
+                                         name=f"Relu_{i}"))
+            prev = f"relu{i+1}"
+    nodes.append(proto.make_node("Softmax", ["gemm3"], ["output"],
+                                 name="Softmax_0", axis=-1))
+    graph = proto.make_graph(
+        nodes, "torch_jit",
+        [proto.make_tensor_value_info("input.1", proto.TensorProto.FLOAT,
+                                      ["N", 784])],
+        [proto.make_tensor_value_info("output", proto.TensorProto.FLOAT,
+                                      ["N", 10])],
+        initializer=inits)
+    proto.save_model(proto.make_model(graph), path)
+    return path
+
+
+if __name__ == "__main__":
+    print("exported", export())

@@ -1,0 +1,65 @@
+"""Export a residual CNN to ONNX, torch layout (reference:
+examples/python/onnx/resnet_pt.py). Exercises BatchNormalization, residual
+Add, and GlobalAveragePool through the importer."""
+import numpy as np
+
+from flexflow.onnx.model import proto
+
+
+def _conv_bn(rng, name, cin, cout, stride, nodes, inits, prev):
+    w = (rng.randn(cout, cin, 3, 3) / np.sqrt(cin * 9)).astype(np.float32)
+    inits.append(proto.from_array(w, f"{name}.weight"))
+    nodes.append(proto.make_node(
+        "Conv", [prev, f"{name}.weight"], [name], name=name,
+        kernel_shape=[3, 3], strides=[stride, stride], pads=[1, 1, 1, 1]))
+    for suffix, arr in (("scale", np.ones(cout)), ("bias", np.zeros(cout)),
+                        ("mean", np.zeros(cout)), ("var", np.ones(cout))):
+        inits.append(proto.from_array(arr.astype(np.float32),
+                                      f"{name}.bn.{suffix}"))
+    nodes.append(proto.make_node(
+        "BatchNormalization",
+        [name, f"{name}.bn.scale", f"{name}.bn.bias", f"{name}.bn.mean",
+         f"{name}.bn.var"], [name + "_bn"], name=name + "_bn", epsilon=1e-5))
+    return name + "_bn"
+
+
+def export(path="resnet_pt.onnx", seed=0, image=32):
+    rng = np.random.RandomState(seed)
+    nodes, inits = [], []
+    prev = _conv_bn(rng, "stem", 3, 16, 1, nodes, inits, "input.1")
+    nodes.append(proto.make_node("Relu", [prev], ["stem_r"], name="stem_relu"))
+    prev = "stem_r"
+    for b in range(2):  # two residual blocks
+        skip = prev
+        h = _conv_bn(rng, f"block{b}_conv1", 16, 16, 1, nodes, inits, prev)
+        nodes.append(proto.make_node("Relu", [h], [h + "_r"], name=h + "_relu"))
+        h2 = _conv_bn(rng, f"block{b}_conv2", 16, 16, 1, nodes, inits, h + "_r")
+        nodes.append(proto.make_node("Add", [h2, skip], [f"block{b}_sum"],
+                                     name=f"block{b}_add"))
+        nodes.append(proto.make_node("Relu", [f"block{b}_sum"],
+                                     [f"block{b}_out"], name=f"block{b}_relu"))
+        prev = f"block{b}_out"
+    nodes.append(proto.make_node("GlobalAveragePool", [prev], ["gap"],
+                                 name="gap"))
+    nodes.append(proto.make_node("Flatten", ["gap"], ["flat"], name="flatten",
+                                 axis=1))
+    w = (rng.randn(10, 16) / 4).astype(np.float32)
+    b = np.zeros(10, np.float32)
+    inits += [proto.from_array(w, "fc.weight"), proto.from_array(b, "fc.bias")]
+    nodes.append(proto.make_node("Gemm", ["flat", "fc.weight", "fc.bias"],
+                                 ["logits"], name="fc", transB=1))
+    nodes.append(proto.make_node("Softmax", ["logits"], ["output"],
+                                 name="softmax", axis=-1))
+    graph = proto.make_graph(
+        nodes, "torch_jit",
+        [proto.make_tensor_value_info("input.1", proto.TensorProto.FLOAT,
+                                      ["N", 3, image, image])],
+        [proto.make_tensor_value_info("output", proto.TensorProto.FLOAT,
+                                      ["N", 10])],
+        initializer=inits)
+    proto.save_model(proto.make_model(graph), path)
+    return path
+
+
+if __name__ == "__main__":
+    print("exported", export())

@@ -1,0 +1,12 @@
+"""Accuracy thresholds for the keras example suite (reference:
+examples/python/keras import `from accuracy import ModelAccuracy`, defined in
+examples/python/native/accuracy.py)."""
+from enum import Enum
+
+
+class ModelAccuracy(Enum):
+    MNIST_MLP = 90
+    MNIST_CNN = 90
+    REUTERS_MLP = 90
+    CIFAR10_CNN = 90
+    CIFAR10_ALEXNET = 90

@@ -1,0 +1,58 @@
+"""CIFAR-10 CNN imported from PyTorch (reference:
+examples/python/pytorch/cifar10_cnn.py)."""
+import torch.nn as nn
+
+from flexflow.core import *  # noqa: F401,F403
+from flexflow.keras.datasets import cifar10
+from flexflow.torch.model import PyTorchModel
+
+from _example_args import example_args
+
+
+class CNN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 32, 3, padding=1)
+        self.conv2 = nn.Conv2d(32, 32, 3, padding=1)
+        self.pool1 = nn.MaxPool2d(2, 2)
+        self.conv3 = nn.Conv2d(32, 64, 3, padding=1)
+        self.conv4 = nn.Conv2d(64, 64, 3, padding=1)
+        self.pool2 = nn.MaxPool2d(2, 2)
+        self.flat = nn.Flatten()
+        self.linear1 = nn.Linear(64 * 8 * 8, 512)
+        self.linear2 = nn.Linear(512, 10)
+        self.relu = nn.ReLU()
+        self.softmax = nn.Softmax(dim=-1)
+
+    def forward(self, x):
+        y = self.relu(self.conv1(x))
+        y = self.pool1(self.relu(self.conv2(y)))
+        y = self.relu(self.conv3(y))
+        y = self.pool2(self.relu(self.conv4(y)))
+        y = self.relu(self.linear1(self.flat(y)))
+        return self.softmax(self.linear2(y))
+
+
+def top_level_task(args):
+    ffconfig = FFConfig()
+    ffconfig.batch_size = args.batch_size
+    ffmodel = FFModel(ffconfig)
+    input_tensor = ffmodel.create_tensor(
+        [args.batch_size, 3, 32, 32], DataType.DT_FLOAT)
+
+    torch_model = PyTorchModel(CNN())
+    output_tensors = torch_model.torch_to_ff(ffmodel, [input_tensor])
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                    metrics=[MetricsType.METRICS_ACCURACY])
+
+    (x_train, y_train), _ = cifar10.load_data(n_train=args.num_samples)
+    x_train = x_train.transpose(0, 3, 1, 2).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+    ffmodel.fit(x=x_train, y=y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("cifar10 cnn (pytorch import)")
+    top_level_task(example_args())

@@ -1,0 +1,39 @@
+"""Pure-PyTorch CPU counterpart of cifar10_cnn.py for output comparison
+(reference: examples/python/pytorch/cifar10_cnn_torch.py)."""
+import numpy as np
+import torch
+import torch.nn as nn
+
+from flexflow.keras.datasets import cifar10
+
+from _example_args import example_args
+from cifar10_cnn import CNN
+
+
+def top_level_task(args):
+    model = CNN()
+    opt = torch.optim.SGD(model.parameters(), lr=0.01)
+    loss_fn = nn.CrossEntropyLoss()
+
+    (x_train, y_train), _ = cifar10.load_data(n_train=args.num_samples)
+    x = torch.tensor(x_train.transpose(0, 3, 1, 2).astype("float32") / 255)
+    y = torch.tensor(y_train.astype("int64").reshape(-1))
+
+    bs = args.batch_size
+    for epoch in range(args.epochs):
+        correct = total = 0
+        for i in range(0, len(x) - bs + 1, bs):
+            xb, yb = x[i:i + bs], y[i:i + bs]
+            opt.zero_grad()
+            out = model(xb)
+            loss = loss_fn(out, yb)
+            loss.backward()
+            opt.step()
+            correct += (out.argmax(1) == yb).sum().item()
+            total += bs
+        print(f"epoch {epoch}: accuracy {100.0 * correct / total:.2f}%")
+
+
+if __name__ == "__main__":
+    print("cifar10 cnn (pure torch)")
+    top_level_task(example_args())

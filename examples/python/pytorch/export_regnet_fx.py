@@ -1,0 +1,14 @@
+"""Export the RegNet fx graph to the flexflow file format (reference:
+examples/python/pytorch/export_regnet_fx.py — torch_to_flexflow on
+torchvision regnet)."""
+from flexflow.torch.model import torch_to_flexflow
+
+from regnet import regnet
+
+
+def export(path="regnet.ff"):
+    return torch_to_flexflow(regnet(), path)
+
+
+if __name__ == "__main__":
+    print("exported", export())

@@ -1,0 +1,39 @@
+"""Train the MNIST MLP replayed from a .ff file (reference:
+examples/python/pytorch/mnist_mlp.py — PyTorchModel.file_to_ff)."""
+import os
+
+from flexflow.core import *  # noqa: F401,F403
+from flexflow.keras.datasets import mnist
+from flexflow.torch.model import PyTorchModel
+
+from _example_args import example_args
+from mnist_mlp_torch import export
+
+
+def top_level_task(args):
+    ffconfig = FFConfig()
+    ffconfig.batch_size = args.batch_size
+    print("Python API batchSize(%d) workersPerNodes(%d) numNodes(%d)" % (
+        ffconfig.batch_size, ffconfig.workers_per_node, ffconfig.num_nodes))
+    ffmodel = FFModel(ffconfig)
+
+    input_tensor = ffmodel.create_tensor([args.batch_size, 784], DataType.DT_FLOAT)
+
+    if not os.path.exists("mlp.ff"):
+        export("mlp.ff")
+    output_tensors = PyTorchModel.file_to_ff("mlp.ff", ffmodel, [input_tensor])
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                    metrics=[MetricsType.METRICS_ACCURACY,
+                             MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY])
+
+    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
+    x_train = x_train.reshape(-1, 784).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+    ffmodel.fit(x=x_train, y=y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("mnist mlp")
+    top_level_task(example_args())

@@ -1,0 +1,29 @@
+"""Define the MNIST MLP in PyTorch and export it to the flexflow file format
+(reference: examples/python/pytorch/mnist_mlp_torch.py — torch_to_flexflow
+writes mlp.ff for mnist_mlp.py to replay)."""
+import torch.nn as nn
+
+from flexflow.torch.model import torch_to_flexflow
+
+
+class MLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.linear1 = nn.Linear(784, 512)
+        self.linear2 = nn.Linear(512, 512)
+        self.linear3 = nn.Linear(512, 10)
+        self.relu = nn.ReLU()
+        self.softmax = nn.Softmax(dim=-1)
+
+    def forward(self, x):
+        y = self.relu(self.linear1(x))
+        y = self.relu(self.linear2(y))
+        return self.softmax(self.linear3(y))
+
+
+def export(path="mlp.ff"):
+    return torch_to_flexflow(MLP(), path)
+
+
+if __name__ == "__main__":
+    print("exported", export())

@@ -1,0 +1,33 @@
+"""Train the MNIST MLP via a LIVE torch.fx trace (reference:
+examples/python/pytorch/mnist_mlp_torch2.py — PyTorchModel(mod).torch_to_ff,
+weights carried over from the torch module)."""
+from flexflow.core import *  # noqa: F401,F403
+from flexflow.keras.datasets import mnist
+from flexflow.torch.model import PyTorchModel
+
+from _example_args import example_args
+from mnist_mlp_torch import MLP
+
+
+def top_level_task(args):
+    ffconfig = FFConfig()
+    ffconfig.batch_size = args.batch_size
+    ffmodel = FFModel(ffconfig)
+    input_tensor = ffmodel.create_tensor([args.batch_size, 784], DataType.DT_FLOAT)
+
+    torch_model = PyTorchModel(MLP())
+    output_tensors = torch_model.torch_to_ff(ffmodel, [input_tensor])
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                    metrics=[MetricsType.METRICS_ACCURACY])
+
+    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
+    x_train = x_train.reshape(-1, 784).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+    ffmodel.fit(x=x_train, y=y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("mnist mlp torch2 (live trace)")
+    top_level_task(example_args())

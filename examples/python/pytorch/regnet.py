@@ -1,0 +1,73 @@
+"""RegNet-style grouped-conv network via torch import (reference:
+examples/python/pytorch/regnet.py uses torchvision regnet_x; torchvision is
+not in this image so the X-block stack is declared inline with the same
+structure: stem + stages of grouped-bottleneck blocks)."""
+import torch.nn as nn
+
+from flexflow.core import *  # noqa: F401,F403
+from flexflow.keras.datasets import cifar10
+from flexflow.torch.model import PyTorchModel
+
+from _example_args import example_args
+
+
+class XBlock(nn.Module):
+    def __init__(self, cin, cout, group_width=8, stride=1):
+        super().__init__()
+        groups = max(1, cout // group_width)
+        self.conv1 = nn.Conv2d(cin, cout, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(cout)
+        self.conv2 = nn.Conv2d(cout, cout, 3, stride=stride, padding=1,
+                               groups=groups, bias=False)
+        self.bn2 = nn.BatchNorm2d(cout)
+        self.conv3 = nn.Conv2d(cout, cout, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(cout)
+        self.relu = nn.ReLU()
+        self.down = (
+            nn.Conv2d(cin, cout, 1, stride=stride, bias=False)
+            if (stride != 1 or cin != cout) else None
+        )
+
+    def forward(self, x):
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        skip = self.down(x) if self.down is not None else x
+        return self.relu(y + skip)
+
+
+def regnet(widths=(24, 56, 152), depths=(1, 2, 4), num_classes=10):
+    mods = [nn.Conv2d(3, 16, 3, padding=1, bias=False),
+            nn.BatchNorm2d(16), nn.ReLU()]
+    cin = 16
+    for w, d in zip(widths, depths):
+        for i in range(d):
+            mods.append(XBlock(cin, w, stride=2 if i == 0 else 1))
+            cin = w
+    mods += [nn.AdaptiveAvgPool2d(1), nn.Flatten(),
+             nn.Linear(cin, num_classes), nn.Softmax(dim=-1)]
+    return nn.Sequential(*mods)
+
+
+def top_level_task(args):
+    ffconfig = FFConfig()
+    ffconfig.batch_size = args.batch_size
+    ffmodel = FFModel(ffconfig)
+    input_tensor = ffmodel.create_tensor(
+        [args.batch_size, 3, 32, 32], DataType.DT_FLOAT)
+
+    output_tensors = PyTorchModel(regnet()).torch_to_ff(ffmodel, [input_tensor])
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                    metrics=[MetricsType.METRICS_ACCURACY])
+
+    (x_train, y_train), _ = cifar10.load_data(n_train=args.num_samples)
+    x_train = x_train.transpose(0, 3, 1, 2).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+    ffmodel.fit(x=x_train, y=y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("regnet (pytorch import)")
+    top_level_task(example_args())

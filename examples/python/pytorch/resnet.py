@@ -1,0 +1,81 @@
+"""ResNet (basic blocks) imported from PyTorch (reference:
+examples/python/pytorch/resnet.py). Depth is configurable; the default
+matches ResNet-18's [2,2,2,2] layout scaled to CIFAR-sized inputs."""
+import torch
+import torch.nn as nn
+
+from flexflow.core import *  # noqa: F401,F403
+from flexflow.keras.datasets import cifar10
+from flexflow.torch.model import PyTorchModel
+
+from _example_args import example_args
+
+
+class BasicBlock(nn.Module):
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.conv1 = nn.Conv2d(cin, cout, 3, stride=stride, padding=1, bias=False)
+        self.bn1 = nn.BatchNorm2d(cout)
+        self.conv2 = nn.Conv2d(cout, cout, 3, padding=1, bias=False)
+        self.bn2 = nn.BatchNorm2d(cout)
+        self.relu = nn.ReLU()
+        self.down = (
+            nn.Conv2d(cin, cout, 1, stride=stride, bias=False)
+            if (stride != 1 or cin != cout) else None
+        )
+
+    def forward(self, x):
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        skip = self.down(x) if self.down is not None else x
+        return self.relu(y + skip)
+
+
+class ResNet(nn.Module):
+    def __init__(self, layers=(2, 2, 2, 2), width=16, num_classes=10):
+        super().__init__()
+        self.stem = nn.Conv2d(3, width, 3, padding=1, bias=False)
+        self.bn = nn.BatchNorm2d(width)
+        self.relu = nn.ReLU()
+        blocks = []
+        cin = width
+        for stage, n in enumerate(layers):
+            cout = width * (2 ** stage)
+            for i in range(n):
+                blocks.append(BasicBlock(cin, cout, stride=2 if (i == 0 and stage > 0) else 1))
+                cin = cout
+        self.blocks = nn.Sequential(*blocks)
+        self.pool = nn.AvgPool2d(4)
+        self.flat = nn.Flatten()
+        self.fc = nn.Linear(cin, num_classes)
+        self.softmax = nn.Softmax(dim=-1)
+
+    def forward(self, x):
+        y = self.relu(self.bn(self.stem(x)))
+        y = self.blocks(y)
+        return self.softmax(self.fc(self.flat(self.pool(y))))
+
+
+def top_level_task(args, layers=(2, 2, 2, 2)):
+    ffconfig = FFConfig()
+    ffconfig.batch_size = args.batch_size
+    ffmodel = FFModel(ffconfig)
+    input_tensor = ffmodel.create_tensor(
+        [args.batch_size, 3, 32, 32], DataType.DT_FLOAT)
+
+    torch_model = PyTorchModel(ResNet(layers=layers))
+    output_tensors = torch_model.torch_to_ff(ffmodel, [input_tensor])
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                    metrics=[MetricsType.METRICS_ACCURACY])
+
+    (x_train, y_train), _ = cifar10.load_data(n_train=args.num_samples)
+    x_train = x_train.transpose(0, 3, 1, 2).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+    ffmodel.fit(x=x_train, y=y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("resnet (pytorch import)")
+    top_level_task(example_args())

@@ -1,0 +1,46 @@
+"""ResNet-152 data-parallel training (reference:
+examples/python/pytorch/resnet152_DDP_training.py — DistributedDataParallel
+over N GPUs). The DDP wrapper maps to this framework's data-parallel mesh
+axis: set --only-data-parallel / data_parallelism_degree and the executor
+shards the batch over devices with gradient psum — no process groups or
+wrappers needed."""
+import argparse
+
+from flexflow.core import *  # noqa: F401,F403
+from flexflow.keras.datasets import cifar10
+from flexflow.torch.model import PyTorchModel
+
+from resnet152_training import resnet152
+
+
+def top_level_task(args):
+    ffconfig = FFConfig()
+    ffconfig.batch_size = args.batch_size
+    ffconfig.only_data_parallel = True  # the DDP equivalent
+    ffmodel = FFModel(ffconfig)
+    input_tensor = ffmodel.create_tensor(
+        [args.batch_size, 3, 32, 32], DataType.DT_FLOAT)
+
+    layers = (3, 8, 36, 3) if args.scale == 1 else (1, 1, 1, 1)
+    model = resnet152(width=64 // args.scale, layers=layers)
+    output_tensors = PyTorchModel(model).torch_to_ff(ffmodel, [input_tensor])
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                    metrics=[MetricsType.METRICS_ACCURACY])
+
+    (x_train, y_train), _ = cifar10.load_data(n_train=args.num_samples)
+    x_train = x_train.transpose(0, 3, 1, 2).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+    ffmodel.fit(x=x_train, y=y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("-e", "--epochs", type=int, default=1)
+    p.add_argument("--num-samples", type=int, default=512)
+    p.add_argument("-b", "--batch-size", type=int, default=32)
+    p.add_argument("--scale", type=int, default=1)
+    args, _ = p.parse_known_args()
+    print("resnet152 DDP-style (data parallel)")
+    top_level_task(args)

@@ -1,0 +1,86 @@
+"""ResNet-152-style training via torch import (reference:
+examples/python/pytorch/resnet152_training.py — torchvision resnet152 on one
+device). torchvision is not in this image, so the [3,8,36,3] bottleneck
+stack is declared inline; --scale shrinks width/depth for smoke runs."""
+import argparse
+
+import torch.nn as nn
+
+from flexflow.core import *  # noqa: F401,F403
+from flexflow.keras.datasets import cifar10
+from flexflow.torch.model import PyTorchModel
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, cin, planes, stride=1):
+        super().__init__()
+        cout = planes * self.expansion
+        self.conv1 = nn.Conv2d(cin, planes, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(planes)
+        self.conv2 = nn.Conv2d(planes, planes, 3, stride=stride, padding=1, bias=False)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.conv3 = nn.Conv2d(planes, cout, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(cout)
+        self.relu = nn.ReLU()
+        self.down = (
+            nn.Conv2d(cin, cout, 1, stride=stride, bias=False)
+            if (stride != 1 or cin != cout) else None
+        )
+
+    def forward(self, x):
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        skip = self.down(x) if self.down is not None else x
+        return self.relu(y + skip)
+
+
+def resnet152(width=64, layers=(3, 8, 36, 3), num_classes=10):
+    mods = [nn.Conv2d(3, width, 3, padding=1, bias=False),
+            nn.BatchNorm2d(width), nn.ReLU()]
+    cin = width
+    for stage, n in enumerate(layers):
+        planes = width * (2 ** stage)
+        for i in range(n):
+            mods.append(Bottleneck(cin, planes,
+                                   stride=2 if (i == 0 and stage > 0) else 1))
+            cin = planes * Bottleneck.expansion
+    mods += [nn.AdaptiveAvgPool2d(1), nn.Flatten(),
+             nn.Linear(cin, num_classes), nn.Softmax(dim=-1)]
+    return nn.Sequential(*mods)
+
+
+def top_level_task(args):
+    ffconfig = FFConfig()
+    ffconfig.batch_size = args.batch_size
+    ffmodel = FFModel(ffconfig)
+    input_tensor = ffmodel.create_tensor(
+        [args.batch_size, 3, 32, 32], DataType.DT_FLOAT)
+
+    layers = (3, 8, 36, 3) if args.scale == 1 else (1, 1, 1, 1)
+    width = 64 // args.scale
+    model = resnet152(width=width, layers=layers)
+    output_tensors = PyTorchModel(model).torch_to_ff(ffmodel, [input_tensor])
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                    metrics=[MetricsType.METRICS_ACCURACY])
+
+    (x_train, y_train), _ = cifar10.load_data(n_train=args.num_samples)
+    x_train = x_train.transpose(0, 3, 1, 2).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+    ffmodel.fit(x=x_train, y=y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("-e", "--epochs", type=int, default=1)
+    p.add_argument("--num-samples", type=int, default=512)
+    p.add_argument("-b", "--batch-size", type=int, default=32)
+    p.add_argument("--scale", type=int, default=1,
+                   help=">1 shrinks the net for smoke tests")
+    args, _ = p.parse_known_args()
+    print("resnet152 training")
+    top_level_task(args)

@@ -1,0 +1,37 @@
+"""Pure-PyTorch CPU counterpart of resnet.py (reference:
+examples/python/pytorch/resnet_torch.py)."""
+import torch
+import torch.nn as nn
+
+from flexflow.keras.datasets import cifar10
+
+from _example_args import example_args
+from resnet import ResNet
+
+
+def top_level_task(args):
+    model = ResNet()
+    opt = torch.optim.SGD(model.parameters(), lr=0.01)
+    loss_fn = nn.CrossEntropyLoss()
+
+    (x_train, y_train), _ = cifar10.load_data(n_train=args.num_samples)
+    x = torch.tensor(x_train.transpose(0, 3, 1, 2).astype("float32") / 255)
+    y = torch.tensor(y_train.astype("int64").reshape(-1))
+
+    bs = args.batch_size
+    for epoch in range(args.epochs):
+        correct = total = 0
+        for i in range(0, len(x) - bs + 1, bs):
+            xb, yb = x[i:i + bs], y[i:i + bs]
+            opt.zero_grad()
+            out = model(xb)
+            loss_fn(out, yb).backward()
+            opt.step()
+            correct += (out.argmax(1) == yb).sum().item()
+            total += bs
+        print(f"epoch {epoch}: accuracy {100.0 * correct / total:.2f}%")
+
+
+if __name__ == "__main__":
+    print("resnet (pure torch)")
+    top_level_task(example_args())

@@ -1,0 +1,35 @@
+"""Import a torchvision model (reference:
+examples/python/pytorch/torch_vision.py). torchvision is optional — absent
+in this image, the script explains and exits cleanly; with it installed any
+fx-traceable tv model imports the same way."""
+import sys
+
+from flexflow.core import *  # noqa: F401,F403
+from flexflow.torch.model import PyTorchModel
+
+from _example_args import example_args
+
+try:
+    import torchvision.models as tv
+except ImportError:
+    print("torchvision not installed — run examples/python/pytorch/resnet.py "
+          "or regnet.py for the equivalent inline-defined models")
+    sys.exit(0)
+
+
+def top_level_task(args):
+    ffconfig = FFConfig()
+    ffconfig.batch_size = args.batch_size
+    ffmodel = FFModel(ffconfig)
+    input_tensor = ffmodel.create_tensor(
+        [args.batch_size, 3, 224, 224], DataType.DT_FLOAT)
+    model = tv.resnet18(weights=None)
+    PyTorchModel(model).torch_to_ff(ffmodel, [input_tensor])
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                    metrics=[MetricsType.METRICS_ACCURACY])
+    print("torchvision resnet18 imported:", len(ffmodel.layers), "layers")
+
+
+if __name__ == "__main__":
+    top_level_task(example_args())

@@ -1,2 +1,3 @@
-"""Shim: reference python/flexflow/onnx/model.py (ONNXModel)."""
-from flexflow_tpu.frontends.onnx.model import *  # noqa: F401,F403
+"""Shim: reference python/flexflow/onnx/model.py (ONNXModel, ONNXModelKeras)."""
+from flexflow_tpu.frontends.onnx.model import ONNXModel, ONNXModelKeras  # noqa: F401
+from flexflow_tpu.frontends.onnx import proto  # noqa: F401

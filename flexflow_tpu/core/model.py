@@ -623,7 +623,7 @@ class FFModel:
             return
         setattr(opt, field, lr)
         if self.executor is not None:
-            self.executor.invalidate_step_cache()
+            self.executor.invalidate_step_cache(train_only=True)
 
     def compile(
         self,

@@ -58,8 +58,10 @@ class VerifyMetrics(Callback):
     """Asserts final accuracy reaches a threshold (reference:
     keras/callbacks.py VerifyMetrics + examples accuracy.py ModelAccuracy)."""
 
-    def __init__(self, accuracy_threshold: float):
-        self.threshold = accuracy_threshold
+    def __init__(self, accuracy_threshold):
+        # the reference passes ModelAccuracy enum members; unwrap to the
+        # numeric threshold (examples accuracy.py ModelAccuracy.value)
+        self.threshold = getattr(accuracy_threshold, "value", accuracy_threshold)
 
     def on_train_end(self, logs=None):
         pm = self.model.ffmodel.get_perf_metrics()
@@ -73,8 +75,8 @@ class EpochVerifyMetrics(Callback):
     """Asserts accuracy threshold reached by some epoch (reference:
     keras/callbacks.py EpochVerifyMetrics)."""
 
-    def __init__(self, accuracy_threshold: float):
-        self.threshold = accuracy_threshold
+    def __init__(self, accuracy_threshold):
+        self.threshold = getattr(accuracy_threshold, "value", accuracy_threshold)
         self.best = 0.0
 
     def on_epoch_end(self, epoch, logs=None):

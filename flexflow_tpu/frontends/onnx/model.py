@@ -68,14 +68,22 @@ class ONNXModel:
 
     def __init__(self, model):
         if isinstance(model, (str, bytes)):
-            assert HAS_ONNX, "onnx package not available to load from file"
-            model = onnx.load(model)
+            if HAS_ONNX and isinstance(model, str):
+                model = onnx.load(model)
+            else:
+                # self-contained wire-format parser (proto.py) — real
+                # protobuf .onnx files load without the onnx package
+                from . import proto
+                model = proto.load_model(model)
         self.model = model
         self.initializers: Dict[str, np.ndarray] = {}
         for init in model.graph.initializer:
-            if numpy_helper is not None:
+            if numpy_helper is not None and not hasattr(init, "dumps"):
                 self.initializers[init.name] = numpy_helper.to_array(init)
-            else:
+            elif hasattr(init, "dims"):  # our TensorProto (or onnx's, sans pkg)
+                from . import proto
+                self.initializers[init.name] = proto.to_array(init)
+            else:  # lightweight test double carrying .data
                 self.initializers[init.name] = np.asarray(init.data)
         self._weight_loads = []
 
@@ -249,6 +257,17 @@ class ONNXModel:
         self._weight_loads.append((ff.layers[-1], arrays))
         return out
 
+    def handle_Constant(self, ff, node, env):
+        """keras2onnx-style Constant weight nodes: decode the value tensor
+        into the initializer map so MatMul/Gemm consume it as a weight."""
+        from . import proto
+
+        a = next((x for x in node.attribute if x.name == "value"), None)
+        assert a is not None, "Constant node without value attribute"
+        arr = proto.to_array(a.t)
+        self.initializers[node.output[0]] = arr
+        return arr
+
     def handle_Identity(self, ff, node, env):
         return ff.identity(env[node.input[0]])
 
@@ -292,3 +311,15 @@ class ONNXModel:
             arr = np.broadcast_to(np.ravel(slope), tuple(alpha_decl.dims))
             self._weight_loads.append((ff.layers[-1], [arr]))
         return out
+
+
+class ONNXModelKeras(ONNXModel):
+    """Keras-exported ONNX graphs (reference: onnx/model.py ONNXModelKeras —
+    same walker, but keras exports carry Const/Identity weight nodes and
+    dense kernels already (in, out)-oriented, which the stock handlers
+    accept; ffconfig/ffmodel ctor args kept for signature parity)."""
+
+    def __init__(self, model, ffconfig=None, ffmodel=None):
+        super().__init__(model)
+        self.ffconfig = ffconfig
+        self.ffmodel = ffmodel

@@ -229,20 +229,31 @@ _MODULE_BUILDERS = {
 class PyTorchModel:
     """reference: torch/model.py:2408 PyTorchModel"""
 
-    def __init__(self, module, is_hf_model: bool = False, batch_size: int = 1):
+    def __init__(self, module, is_hf_model: bool = False, input_names=None,
+                 batch_size: int = 1, seq_length=None):
         assert HAS_TORCH, "torch is not available"
         self.module = module
         self.is_hf_model = is_hf_model
+        self.input_names = input_names
         self.batch_size = batch_size
+        self.seq_length = seq_length
         self._weight_loads = []  # (ff_layer, [np arrays]) applied post-compile
 
     def _trace(self):
         """reference: model.py:2427 _trace_model (HF variant uses
-        transformers.utils.fx; plain variant torch.fx)."""
+        transformers.utils.fx with input_names/batch/seq; plain variant
+        torch.fx)."""
         if self.is_hf_model:
             from transformers.utils import fx as hf_fx
 
-            return hf_fx.symbolic_trace(self.module)
+            kw = {"input_names": self.input_names}
+            if self.seq_length is not None:
+                kw["sequence_length"] = self.seq_length
+            try:
+                return hf_fx.symbolic_trace(self.module, **kw)
+            except TypeError:  # older/newer hf signatures
+                return hf_fx.symbolic_trace(self.module,
+                                            input_names=self.input_names)
         return torch.fx.symbolic_trace(self.module)
 
     # ------------------------------------------------------------------

@@ -218,15 +218,18 @@ class PCGExecutor:
                 terms.append(0.5 * lam * jnp.sum(wf * wf))
         return terms
 
-    def invalidate_step_cache(self) -> None:
-        """Drop the cached jitted steps so the next build re-traces.
+    def invalidate_step_cache(self, train_only: bool = False) -> None:
+        """Drop cached jitted steps so the next build re-traces.
 
         Needed when a traced-as-constant hyperparameter changes (e.g. the
         learning rate from a keras LearningRateScheduler) — the Legion
-        analogy is ending a captured trace when the task graph changes."""
+        analogy is ending a captured trace when the task graph changes.
+        `train_only` keeps the eval/forward traces, which don't see the
+        optimizer's hyperparameters."""
         self._train_step = None
-        self._eval_step = None
-        self._fwd = None
+        if not train_only:
+            self._eval_step = None
+            self._fwd = None
 
     def build_train_step(self) -> Callable:
         if self._train_step is not None:
