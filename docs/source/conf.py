@@ -1,0 +1,7 @@
+# Sphinx configuration (reference: docs/source/conf.py)
+project = "flexflow-tpu"
+author = "flexflow-tpu developers"
+extensions = ["sphinx.ext.autodoc", "sphinx.ext.napoleon",
+              "sphinx.ext.viewcode"]
+html_theme = "alabaster"
+exclude_patterns = []
