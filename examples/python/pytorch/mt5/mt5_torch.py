@@ -1,0 +1,50 @@
+"""Pure-PyTorch mt5 training counterpart (reference:
+examples/python/pytorch/mt5/mt5_torch.py, minus the HF dataset download)."""
+import numpy as np
+import torch
+
+
+def set_seed(seed=42):
+    np.random.seed(seed)
+    torch.manual_seed(seed)
+
+
+def synthetic_batches(vocab_size, n, seq, seed=0):
+    rng = np.random.RandomState(seed)
+    src = rng.randint(3, vocab_size, (n, seq)).astype(np.int64)
+    tgt = rng.randint(3, vocab_size, (n, seq)).astype(np.int64)
+    return src, tgt
+
+
+def small_mt5_config():
+    from transformers import MT5Config
+
+    return MT5Config(
+        d_model=64, d_ff=128, num_layers=2, num_decoder_layers=2,
+        num_heads=4, d_kv=16, vocab_size=512, decoder_start_token_id=0,
+        dropout_rate=0.0,
+    )
+
+
+def top_level_task(epochs=1, n=64, seq=24, batch=8):
+    from transformers import MT5ForConditionalGeneration
+
+    model = MT5ForConditionalGeneration(small_mt5_config())
+    opt = torch.optim.SGD(model.parameters(), lr=0.01)
+    src, tgt = synthetic_batches(512, n, seq)
+    for epoch in range(epochs):
+        total = 0.0
+        for i in range(0, n - batch + 1, batch):
+            s = torch.tensor(src[i:i + batch])
+            t = torch.tensor(tgt[i:i + batch])
+            opt.zero_grad()
+            out = model(input_ids=s, labels=t)
+            out.loss.backward()
+            opt.step()
+            total += out.loss.item()
+        print(f"epoch {epoch}: loss {total / max(1, n // batch):.4f}")
+
+
+if __name__ == "__main__":
+    set_seed()
+    top_level_task()

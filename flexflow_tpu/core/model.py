@@ -29,6 +29,7 @@ from ..ff_types import (
     OperatorType,
     PoolType,
     RegularizerMode,
+    to_data_type,
 )
 from ..ops.attention import MultiHeadAttentionParams
 from ..ops.batch_matmul import BatchMatmulParams
@@ -90,7 +91,8 @@ class FFModel:
         self._last_logits = None
         self._pending_grads = None
         self._dataloaders: List[object] = []
-        self._constant_values: Dict[int, float] = {}  # Tensor.guid -> value
+        # Tensor.guid -> scalar fill value OR baked np.ndarray contents
+        self._constant_values: Dict[int, Union[float, np.ndarray]] = {}
         self._rng = jax.random.PRNGKey(self.config.seed)
 
     # ------------------------------------------------------------------
@@ -1017,6 +1019,15 @@ class FFModel:
         of fit()'s batch inputs (reference: flexflow_cffi.py:941)."""
         t = self.create_tensor(dims, data_type, create_grad=False)
         self._constant_values[t.guid] = float(value)
+        return t
+
+    def create_constant_tensor(self, array, data_type=None):
+        """Constant tensor with arbitrary (non-trainable) contents — used by
+        the torch frontend to bake traced masks/indices into the graph."""
+        arr = np.asarray(array)
+        dt = to_data_type(arr.dtype) if data_type is None else data_type
+        t = self.create_tensor(arr.shape, dt, create_grad=False)
+        self._constant_values[t.guid] = arr.astype(dt.np_dtype)
         return t
 
     def get_layers(self) -> Dict[int, Layer]:

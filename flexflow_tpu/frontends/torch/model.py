@@ -286,9 +286,19 @@ class PyTorchModel:
             elif node.op == "output":
                 def collect(a):
                     if isinstance(a, torch.fx.Node):
-                        outputs.append(env[a.name])
+                        v = env[a.name]
+                        if _is_ff_tensor(v):
+                            outputs.append(v)
+                        elif _concrete_np(v) is not None:
+                            # concrete output (e.g. a mask that never met
+                            # the graph): lift so arity/order match torch
+                            outputs.append(_lift(ffmodel, v))
+                        # None (unused HF ModelOutput fields) is dropped
                     elif isinstance(a, (tuple, list)):
                         for x in a:
+                            collect(x)
+                    elif isinstance(a, dict):  # HF ModelOutput dataclasses
+                        for x in a.values():
                             collect(x)
                 collect(node.args[0])
         self._ffmodel = ffmodel
@@ -306,6 +316,18 @@ class PyTorchModel:
         spec = _MODULE_BUILDERS.get(tname)
         if spec is None:
             raise NotImplementedError(f"torch module {tname}")
+        if node.kwargs:
+            # builders bind positionally; silently dropping kwargs (e.g.
+            # MultiheadAttention's key_padding_mask) would lose semantics —
+            # same loud failure as the file-export path
+            raise NotImplementedError(
+                f"module {tname} called with kwargs {sorted(node.kwargs)}"
+            )
+        # concrete tensor args (e.g. Embedding over eagerly-computed
+        # relative-position buckets) enter the graph as baked constants
+        args = [
+            _lift(ff, a) if _concrete_np(a) is not None else a for a in args
+        ]
         export, build, weights = spec
         out = build(ff, export(mod), args, node.name)
         if weights is not None:
@@ -315,20 +337,26 @@ class PyTorchModel:
         return out
 
     # -- functions -------------------------------------------------------
-    def _function_to_ff(self, ff, node, env):
-        def val(a):
-            return env[a.name] if isinstance(a, torch.fx.Node) else a
+    @staticmethod
+    def _resolve(node, env):
+        """Map fx Nodes to runtime values through nested args (tuples,
+        lists, dicts, AND slice bounds — fx puts Nodes inside slices)."""
+        args = torch.fx.node.map_arg(node.args, lambda n: env[n.name])
+        kwargs = torch.fx.node.map_arg(node.kwargs, lambda n: env[n.name])
+        return list(args), dict(kwargs)
 
-        args = [val(a) for a in node.args]
-        kwargs = {k: val(v) for k, v in node.kwargs.items()}
+    def _function_to_ff(self, ff, node, env):
+        args, kwargs = self._resolve(node, env)
+        if not _any_ff(args) and not _any_ff(kwargs):
+            # fully concrete (mask/position arithmetic): evaluate eagerly
+            # with the real torch function — exact semantics for free
+            return node.target(*args, **kwargs)
         return _replay_fn(ff, _fn_name(node.target), args, kwargs)
 
     def _method_to_ff(self, ff, node, env):
-        def val(a):
-            return env[a.name] if isinstance(a, torch.fx.Node) else a
-
-        args = [val(a) for a in node.args]
-        kwargs = {k: val(v) for k, v in node.kwargs.items()}
+        args, kwargs = self._resolve(node, env)
+        if not _any_ff(args) and not _any_ff(kwargs):
+            return getattr(args[0], node.target)(*args[1:], **kwargs)
         return _replay_fn(ff, node.target, args, kwargs)
 
     # ------------------------------------------------------------------
@@ -391,6 +419,109 @@ def _fn_name(fn) -> str:
     return fn if isinstance(fn, str) else fn.__name__
 
 
+# ---------------------------------------------------------------------------
+# hybrid replay: FF graph tensors vs concrete values
+# ---------------------------------------------------------------------------
+# HF traces (T5/mt5, BERT) interleave real tensor compute with attention-mask
+# and relative-position arithmetic on constants. Under static shapes the
+# latter is fully concrete at import time, so the replay keeps two value
+# kinds: FF Tensors build graph ops; everything else (torch tensors, numpy,
+# ints) evaluates eagerly with torch, and is lifted to a baked constant
+# tensor only at the point it meets the graph (reference: torch/model.py
+# special-cases these nodes per-class; eager evaluation covers them all).
+
+
+def _is_ff_tensor(v) -> bool:
+    return hasattr(v, "guid") and hasattr(v, "dims") and hasattr(v, "data_type")
+
+
+def _any_ff(v) -> bool:
+    if _is_ff_tensor(v):
+        return True
+    if isinstance(v, (list, tuple)):
+        return any(_any_ff(x) for x in v)
+    if isinstance(v, dict):
+        return any(_any_ff(x) for x in v.values())
+    return False
+
+
+def _concrete_np(v):
+    """numpy view of a concrete (non-FF) tensor-like value, else None."""
+    if isinstance(v, np.ndarray):
+        return v
+    if HAS_TORCH and isinstance(v, torch.Tensor):
+        return v.detach().cpu().numpy()
+    return None
+
+
+def _lift(ff, v):
+    """Bake a concrete array (or scalar) into the graph as a constant."""
+    if _is_ff_tensor(v):
+        return v
+    arr = _concrete_np(v)
+    if arr is None and isinstance(v, (bool, int, float, np.number)):
+        # python float defaults to f64 — keep constants in the f32/i32
+        # world jax runs in (x64 is off)
+        dt = np.float32 if isinstance(v, float) else None
+        arr = np.asarray(v, dt)
+    assert arr is not None, f"cannot lift {type(v)} into the graph"
+    if arr.ndim == 0:
+        arr = arr.reshape((1,))  # rank-1 broadcasts everywhere; no 0-d PCG
+    return ff.create_constant_tensor(arr)
+
+
+_TORCH_TO_DT = {}
+if HAS_TORCH:
+    _TORCH_TO_DT = {
+        torch.float32: DataType.DT_FLOAT,
+        torch.float64: DataType.DT_DOUBLE,
+        torch.float16: DataType.DT_HALF,
+        torch.bfloat16: DataType.DT_BF16,
+        torch.int32: DataType.DT_INT32,
+        torch.int64: DataType.DT_INT64,
+        torch.bool: DataType.DT_BOOLEAN,
+    }
+
+
+def _as_dt(dtype) -> DataType:
+    if isinstance(dtype, DataType):
+        return dtype
+    if HAS_TORCH and dtype in _TORCH_TO_DT:
+        return _TORCH_TO_DT[dtype]
+    from ...ff_types import to_data_type
+
+    return to_data_type(dtype)
+
+
+def _slice_is_identity(x, idx) -> bool:
+    """True when x[idx] would return x unchanged (static shapes), e.g. the
+    T5 `position_bias[:, :, -seq_len:, :]` no-cache slice."""
+    items = idx if isinstance(idx, tuple) else (idx,)
+    if sum(1 for it in items if it is Ellipsis) > 1:
+        return False
+    if any(it is Ellipsis for it in items):
+        # expand ... to full slices so positions after it hit TRAILING dims
+        pos = items.index(Ellipsis)
+        n_missing = len(x.dims) - (len(items) - 1)
+        if n_missing < 0:
+            return False
+        items = (items[:pos] + (slice(None),) * n_missing + items[pos + 1:])
+    if len(items) > len(x.dims) or any(not isinstance(it, slice) for it in items):
+        return False
+    for dim, sl in zip(x.dims, items):
+        try:
+            bounds = slice(
+                None if sl.start is None else int(sl.start),
+                None if sl.stop is None else int(sl.stop),
+                None if sl.step is None else int(sl.step),
+            ).indices(dim)
+        except (TypeError, ValueError):
+            return False
+        if bounds != (0, dim, 1):
+            return False
+    return True
+
+
 def _replay_fn(ff, target: str, args, kwargs):
     """The single call_function/call_method dispatch, shared by the live fx
     walk (torch_to_ff) and file replay (file_to_ff). Targets are normalized
@@ -407,9 +538,19 @@ def _replay_fn(ff, target: str, args, kwargs):
                       "div": ff.scalar_true_divide}
         pair_ops = {"add": ff.add, "sub": ff.subtract, "mul": ff.multiply,
                     "truediv": ff.divide, "div": ff.divide}
-        if _is_scalar(args[1]):
-            return scalar_ops[key](x, float(args[1]))
-        return pair_ops[key](x, args[1])
+        a, b = args[0], args[1]
+        if _is_scalar(b) and _is_ff_tensor(a):
+            return scalar_ops[key](a, float(b))
+        if _is_scalar(a) and _is_ff_tensor(b):
+            # reversed scalar op: c - t = -t + c; c / t via pow(-1)
+            if key == "add":
+                return ff.scalar_add(b, float(a))
+            if key == "mul":
+                return ff.scalar_multiply(b, float(a))
+            if key == "sub":
+                return ff.scalar_add(ff.scalar_multiply(b, -1.0), float(a))
+            return ff.scalar_multiply(ff.pow(b, -1.0), float(a))
+        return pair_ops[key](_lift(ff, a), _lift(ff, b))
     if target in ("relu", "gelu", "sigmoid", "tanh", "elu", "exp", "sin",
                   "cos", "rsqrt", "sqrt", "log"):
         return getattr(ff, target)(x)
@@ -422,7 +563,88 @@ def _replay_fn(ff, target: str, args, kwargs):
     if target in ("flatten", "flat"):
         return ff.flat(x)
     if target in ("matmul", "bmm"):
-        return ff.batch_matmul(x, args[1])
+        return ff.batch_matmul(_lift(ff, x), _lift(ff, args[1]))
+    if target in ("min", "max") and len(args) > 1:
+        op = ff.min if target == "min" else ff.max
+        return op(_lift(ff, x), _lift(ff, args[1]))
+    if target == "where":
+        return ff.where(_lift(ff, args[0]), _lift(ff, args[1]),
+                        _lift(ff, args[2]))
+    if target == "masked_fill":
+        # x[mask] = value ⇒ where(mask, full(value), x); mask is concrete
+        # in HF traces (causal / padding masks)
+        mask = _concrete_np(args[1])
+        assert mask is not None, "masked_fill with a traced mask tensor"
+        # keep the mask at its traced (usually broadcastable) shape and the
+        # fill at rank-1 — OP_WHERE broadcast-infers, so baking full-size
+        # copies per attention layer would only waste HBM
+        fill = np.full((1,), float(args[2]), x.data_type.np_dtype)
+        return ff.where(ff.create_constant_tensor(mask.astype(np.bool_)),
+                        ff.create_constant_tensor(fill), x)
+    if target == "neg":
+        return ff.scalar_multiply(x, -1.0)
+    if target == "abs":
+        return ff.max(x, ff.scalar_multiply(x, -1.0, inplace=False))
+    if target == "dropout":
+        p = kwargs.get("p", args[1] if len(args) > 1 else 0.5)
+        training = kwargs.get("training", args[2] if len(args) > 2 else True)
+        if not training:  # F.dropout(..., training=False) is a no-op
+            return x
+        return ff.dropout(x, rate=float(p))
+    if target in ("zeros_like", "full_like", "ones_like") and _is_ff_tensor(x):
+        fill = {"zeros_like": 0.0, "ones_like": 1.0}.get(
+            target, float(args[1]) if len(args) > 1 else 0.0
+        )
+        # stays concrete: downstream use lifts it back if needed
+        return np.full(tuple(x.dims), fill, x.data_type.np_dtype)
+    if target in ("to", "type_as", "float", "half", "double", "type"):
+        if target == "float":
+            return ff.cast(_lift(ff, x), DataType.DT_FLOAT)
+        if target == "half":
+            return ff.cast(_lift(ff, x), DataType.DT_HALF)
+        if target == "double":
+            return ff.cast(_lift(ff, x), DataType.DT_DOUBLE)
+        other = kwargs.get("dtype", args[1] if len(args) > 1 else None)
+        if other is None:
+            return x
+        if _is_ff_tensor(other):
+            return ff.cast(_lift(ff, x), other.data_type)
+        c = _concrete_np(other)
+        if c is not None:  # type_as(concrete tensor)
+            return ff.cast(_lift(ff, x), _as_dt(c.dtype))
+        if isinstance(other, str) or (
+            HAS_TORCH and isinstance(other, torch.device)
+        ):
+            return x  # .to(device): placement is XLA's job
+        return ff.cast(_lift(ff, x), _as_dt(other))  # loud on unknown dtypes
+    if target == "dim":
+        return len(x.dims)
+    if target == "unsqueeze":
+        return ff.unsqueeze(x, [args[1]])
+    if target == "squeeze":
+        dim = kwargs.get("dim", args[1] if len(args) > 1 else None)
+        return ff.squeeze(x, () if dim is None else [dim])
+    if target in ("expand", "expand_as", "broadcast_to"):
+        # rely on downstream broadcasting (XLA handles it); sizes already
+        # compatible by torch semantics
+        return x
+    if target == "getattr" and _is_ff_tensor(x):
+        attr = args[1]
+        if attr == "shape":
+            return tuple(x.dims)
+        if attr == "dtype":
+            # as a torch.dtype so both eager torch consumers
+            # (mask.to(hidden.dtype)) and the graph-side cast handler
+            # (_as_dt) accept it
+            for tdt, fdt in _TORCH_TO_DT.items():
+                if fdt == x.data_type:
+                    return tdt
+            return x.data_type
+        if attr == "ndim":
+            return len(x.dims)
+        if attr == "device":
+            return "cpu"  # import-time eager ops run on host
+        raise NotImplementedError(f"getattr({attr}) on graph tensor")
     if target == "pow":
         return ff.pow(x, float(args[1]))
     if target == "mean":
@@ -451,15 +673,25 @@ def _replay_fn(ff, target: str, args, kwargs):
     if target == "getitem":
         if isinstance(x, (list, tuple)):
             return x[args[1]]
+        idx = args[1]
+        if _slice_is_identity(x, idx):
+            # e.g. T5's position_bias[:, :, -seq_len:, :] with no KV cache
+            return x
+        if isinstance(idx, tuple) and any(it is None for it in idx):
+            # newaxis-only indexing → unsqueeze at the None positions
+            if all(it is None or (isinstance(it, slice) and it == slice(None))
+                   for it in idx):
+                axes = [i for i, it in enumerate(idx) if it is None]
+                return ff.unsqueeze(x, axes)
         owner_op = getattr(getattr(x, "owner_layer", None), "op_type", None)
-        if args[1] == 0 and owner_op in (
+        if idx == 0 and owner_op in (
             OperatorType.OP_MULTIHEAD_ATTENTION, OperatorType.OP_LSTM,
         ):
             # tuple-returning torch ops (MultiheadAttention's
             # (output, weights), LSTM's (output, state)) map to a single
             # output Tensor here; true tensor indexing stays a loud error
             return x
-        raise NotImplementedError(f"getitem[{args[1]}] on single-output op")
+        raise NotImplementedError(f"getitem[{idx}] on single-output op")
     raise NotImplementedError(f"torch call {target}")
 
 

@@ -79,9 +79,10 @@ class PCGExecutor:
         self.graph = graph
         self.mesh = mesh
         self.remat = remat
-        # guid -> (ParallelTensor, python float): inputs materialized as
-        # jnp.full at trace time, excluded from batch inputs
-        # (reference: flexflow_constant_create, flexflow_cffi.py:941)
+        # guid -> (ParallelTensor, python float OR baked np.ndarray):
+        # materialized as jnp.full / jnp.asarray at trace time, excluded
+        # from batch inputs (reference: flexflow_constant_create,
+        # flexflow_cffi.py:941)
         self.constants = constants or {}
         self.optimizer = optimizer
         self.loss_type = loss_type
@@ -149,9 +150,12 @@ class PCGExecutor:
         Differentiable aux losses (MoE balance) are appended to aux_out."""
         vals: Dict[int, jax.Array] = dict(inputs)
         for guid, (pt, value) in self.constants.items():
-            vals[guid] = jnp.full(
-                pt.material_shape(), value, pt.data_type.jnp_dtype
-            )
+            if isinstance(value, np.ndarray):  # baked array constant
+                vals[guid] = jnp.asarray(value, pt.data_type.jnp_dtype)
+            else:
+                vals[guid] = jnp.full(
+                    pt.material_shape(), value, pt.data_type.jnp_dtype
+                )
         for op in self.topo:
             ins = [vals[t.guid] for t in op.inputs]
             if op.is_parallel_op:
