@@ -1,0 +1,12 @@
+"""Shared argv handling (see examples/python/keras/_example_args.py)."""
+import argparse
+
+
+def example_args(epochs=3, num_samples=2048, batch_size=64):
+    p = argparse.ArgumentParser()
+    p.add_argument("-e", "--epochs", type=int, default=epochs)
+    p.add_argument("--num-samples", type=int, default=num_samples)
+    p.add_argument("-b", "--batch-size", type=int, default=batch_size)
+    p.add_argument("--verify", action="store_true")
+    args, _ = p.parse_known_args()
+    return args

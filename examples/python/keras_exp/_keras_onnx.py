@@ -1,0 +1,99 @@
+"""Build keras2onnx-style ONNX graphs without tensorflow (reference:
+examples/python/keras_exp/* drive tf.keras → keras2onnx; here the same
+graphs are emitted directly with the self-contained proto codec, so the
+keras_exp pipeline — ONNXModelKeras lowering + FFModel training — runs
+unchanged in a TF-free environment)."""
+import numpy as np
+
+from flexflow_tpu.frontends.onnx import proto
+
+
+class GraphBuilder:
+    """Accumulates nodes/initializers in keras2onnx conventions: dense
+    kernels are (in, out) MatMul weights, convs carry (M, C, kH, kW)."""
+
+    def __init__(self, seed=0):
+        self.rng = np.random.RandomState(seed)
+        self.nodes = []
+        self.inits = []
+        self.inputs = []
+        self.n = 0
+
+    def _name(self, kind):
+        self.n += 1
+        return f"{kind}_{self.n}"
+
+    def input(self, shape, name=None):
+        name = name or f"input_{len(self.inputs) + 1}"
+        self.inputs.append(
+            proto.make_tensor_value_info(name, proto.TensorProto.FLOAT,
+                                         ["N"] + list(shape)))
+        return name
+
+    def dense(self, x, fan_in, units, activation=None, name=None):
+        name = name or self._name("dense")
+        w = (self.rng.randn(fan_in, units) / np.sqrt(fan_in)).astype(np.float32)
+        b = np.zeros(units, np.float32)
+        self.inits.append(proto.from_array(w, f"{name}/kernel"))
+        self.inits.append(proto.from_array(b, f"{name}/bias"))
+        mm = self._name("MatMul")
+        self.nodes.append(proto.make_node("MatMul", [x, f"{name}/kernel"],
+                                          [mm], name=mm))
+        out = self._name("Add")
+        self.nodes.append(proto.make_node("Add", [mm, f"{name}/bias"], [out],
+                                          name=out))
+        return self._activation(out, activation)
+
+    def conv2d(self, x, in_channels, filters, kernel, stride=1,
+               activation=None, name=None):
+        name = name or self._name("conv")
+        w = (self.rng.randn(filters, in_channels, kernel, kernel)
+             / np.sqrt(in_channels * kernel * kernel)).astype(np.float32)
+        b = np.zeros(filters, np.float32)
+        self.inits.append(proto.from_array(w, f"{name}/kernel"))
+        self.inits.append(proto.from_array(b, f"{name}/bias"))
+        out = self._name("Conv")
+        self.nodes.append(proto.make_node(
+            "Conv", [x, f"{name}/kernel", f"{name}/bias"], [out], name=out,
+            kernel_shape=[kernel, kernel], strides=[stride, stride],
+            pads=[0, 0, 0, 0]))
+        return self._activation(out, activation)
+
+    def maxpool(self, x, pool=2, stride=2):
+        out = self._name("MaxPool")
+        self.nodes.append(proto.make_node(
+            "MaxPool", [x], [out], name=out, kernel_shape=[pool, pool],
+            strides=[stride, stride], pads=[0, 0, 0, 0]))
+        return out
+
+    def flatten(self, x):
+        out = self._name("Flatten")
+        self.nodes.append(proto.make_node("Flatten", [x], [out], name=out))
+        return out
+
+    def concat(self, xs, axis=1):
+        out = self._name("Concat")
+        self.nodes.append(proto.make_node("Concat", list(xs), [out], name=out,
+                                          axis=axis))
+        return out
+
+    def activation(self, x, kind):
+        return self._activation(x, kind)
+
+    def _activation(self, x, activation):
+        if activation is None:
+            return x
+        op = {"relu": "Relu", "softmax": "Softmax", "sigmoid": "Sigmoid",
+              "tanh": "Tanh"}[activation]
+        out = self._name(op)
+        kw = {"axis": -1} if op == "Softmax" else {}
+        self.nodes.append(proto.make_node(op, [x], [out], name=out, **kw))
+        return out
+
+    def model(self, outputs, out_dim):
+        outs = [proto.make_tensor_value_info(o, proto.TensorProto.FLOAT,
+                                             ["N", out_dim])
+                for o in (outputs if isinstance(outputs, list) else [outputs])]
+        graph = proto.make_graph(self.nodes, "keras_model", self.inputs,
+                                 outs, initializer=self.inits)
+        return proto.make_model(graph)

@@ -1,0 +1,55 @@
+"""CIFAR-10 CNN through the experimental Keras frontend (reference:
+examples/python/keras_exp/func_cifar10_cnn.py — Conv32×2/pool/Conv64×2/pool/
+Dense512/Dense10, channels_first)."""
+from types import SimpleNamespace
+
+import numpy as np
+
+from flexflow.core import FFConfig
+from flexflow.keras_exp.models import Model
+from flexflow.keras.datasets import cifar10
+
+from _example_args import example_args
+from _keras_onnx import GraphBuilder
+
+
+def build_cnn_graph(g, num_classes=10):
+    t = g.input((3, 32, 32))
+    t = g.conv2d(t, 3, 32, 3, activation="relu")
+    t = g.conv2d(t, 32, 32, 3, activation="relu")
+    t = g.maxpool(t)
+    t = g.conv2d(t, 32, 64, 3, activation="relu")
+    t = g.conv2d(t, 64, 64, 3, activation="relu")
+    t = g.maxpool(t)
+    t = g.flatten(t)
+    t = g.dense(t, 64 * 5 * 5, 512, activation="relu")
+    t = g.dense(t, 512, num_classes)
+    return g.activation(t, "softmax")
+
+
+def top_level_task(args):
+    num_classes = 10
+    (x_train, y_train), _ = cifar10.load_data(args.num_samples)
+    x_train = x_train.transpose(0, 3, 1, 2).astype("float32") / 255  # NCHW
+    y_train = y_train.astype("int32").reshape(-1, 1)
+    print("shape: ", x_train.shape)
+
+    g = GraphBuilder()
+    out = build_cnn_graph(g, num_classes)
+
+    ffconfig = FFConfig()
+    ffconfig.batch_size = args.batch_size
+    model = Model(
+        inputs={1: SimpleNamespace(shape=(None, 3, 32, 32), dtype="float32")},
+        onnx_model=g.model(out, num_classes),
+        ffconfig=ffconfig,
+    )
+    model.compile(optimizer="SGD", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"])
+    print(model.summary())
+    model.fit(x_train, y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("Functional API, cifar10 cnn")
+    top_level_task(example_args(num_samples=512))

@@ -1,0 +1,57 @@
+"""Nested-model CIFAR-10 CNN through the experimental Keras frontend
+(reference: examples/python/keras_exp/func_cifar10_cnn_nested.py — model1
+(conv block) feeding model2 (conv block + head), composed into one model;
+under the ONNX export path nesting flattens into a single graph)."""
+from types import SimpleNamespace
+
+import numpy as np
+
+from flexflow.core import FFConfig
+from flexflow.keras_exp.models import Model
+from flexflow.keras.datasets import cifar10
+
+from _example_args import example_args
+from _keras_onnx import GraphBuilder
+
+
+def model1_block(g, t):
+    t = g.conv2d(t, 3, 32, 3, activation="relu", name="m1_conv1")
+    t = g.conv2d(t, 32, 32, 3, activation="relu", name="m1_conv2")
+    return g.maxpool(t)
+
+
+def model2_block(g, t, num_classes):
+    t = g.conv2d(t, 32, 64, 3, activation="relu", name="m2_conv1")
+    t = g.conv2d(t, 64, 64, 3, activation="relu", name="m2_conv2")
+    t = g.maxpool(t)
+    t = g.flatten(t)
+    t = g.dense(t, 64 * 5 * 5, 512, activation="relu")
+    t = g.dense(t, 512, num_classes)
+    return g.activation(t, "softmax")
+
+
+def top_level_task(args):
+    num_classes = 10
+    (x_train, y_train), _ = cifar10.load_data(args.num_samples)
+    x_train = x_train.transpose(0, 3, 1, 2).astype("float32") / 255  # NCHW
+    y_train = y_train.astype("int32").reshape(-1, 1)
+
+    g = GraphBuilder()
+    t = g.input((3, 32, 32), name="input_3")
+    out = model2_block(g, model1_block(g, t), num_classes)
+
+    ffconfig = FFConfig()
+    ffconfig.batch_size = args.batch_size
+    model = Model(
+        inputs={3: SimpleNamespace(shape=(None, 3, 32, 32), dtype="float32")},
+        onnx_model=g.model(out, num_classes),
+        ffconfig=ffconfig,
+    )
+    model.compile(optimizer="SGD", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"])
+    model.fit(x_train, y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("Functional API, cifar10 cnn nested")
+    top_level_task(example_args(num_samples=512))

@@ -1,0 +1,45 @@
+"""Functional MNIST MLP through the experimental Keras frontend (reference:
+examples/python/keras_exp/func_mnist_mlp.py — tf.keras Dense stack via
+keras2onnx; here the same graph is emitted TF-free, see _keras_onnx.py)."""
+from types import SimpleNamespace
+
+import numpy as np
+
+from flexflow.core import FFConfig
+from flexflow.keras_exp.models import Model
+from flexflow.keras.datasets import mnist
+
+from _example_args import example_args
+from _keras_onnx import GraphBuilder
+
+
+def top_level_task(args):
+    num_classes = 10
+    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
+    x_train = x_train.reshape(-1, 784).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+    print("shape: ", x_train.shape)
+
+    g = GraphBuilder()
+    t = g.input((784,))
+    t = g.dense(t, 784, 512, activation="relu")
+    t = g.dense(t, 512, 512, activation="relu")
+    t = g.dense(t, 512, num_classes)
+    t = g.activation(t, "softmax")
+
+    ffconfig = FFConfig()
+    ffconfig.batch_size = args.batch_size
+    model = Model(
+        inputs={1: SimpleNamespace(shape=(None, 784), dtype="float32")},
+        onnx_model=g.model(t, num_classes),
+        ffconfig=ffconfig,
+    )
+    print(model.summary())
+    model.compile(optimizer="SGD", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"])
+    model.fit(x_train, y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("Functional API, mnist mlp")
+    top_level_task(example_args())

@@ -1,0 +1,49 @@
+"""Two-input concat MLP through the experimental Keras frontend (reference:
+examples/python/keras_exp/func_mnist_mlp_concat.py — four 2-layer Dense
+towers over two shared inputs, Concatenate(axis=1), Dense head)."""
+from types import SimpleNamespace
+
+import numpy as np
+
+from flexflow.core import FFConfig
+from flexflow.keras_exp.models import Model
+from flexflow.keras.datasets import mnist
+
+from _example_args import example_args
+from _keras_onnx import GraphBuilder
+
+
+def top_level_task(args):
+    num_classes = 10
+    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
+    x_train = x_train.reshape(-1, 784).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+
+    g = GraphBuilder()
+    in1 = g.input((784,), name="input_5")
+    in2 = g.input((784,), name="input_6")
+    towers = []
+    for i, src in enumerate([in1, in1, in2, in2]):
+        t = g.dense(src, 784, 512, activation="relu", name=f"dense{i}")
+        t = g.dense(t, 512, 512, activation="relu", name=f"dense{i}{i}")
+        towers.append(t)
+    out = g.concat(towers, axis=1)
+    out = g.dense(out, 2048, num_classes)
+    out = g.activation(out, "softmax")
+
+    ffconfig = FFConfig()
+    ffconfig.batch_size = args.batch_size
+    model = Model(
+        inputs={5: SimpleNamespace(shape=(None, 784), dtype="float32"),
+                6: SimpleNamespace(shape=(None, 784), dtype="float32")},
+        onnx_model=g.model(out, num_classes),
+        ffconfig=ffconfig,
+    )
+    model.compile(optimizer="SGD", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"])
+    model.fit([x_train, x_train], y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("Functional API, mnist mlp concat")
+    top_level_task(example_args())

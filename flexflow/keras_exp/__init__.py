@@ -1,0 +1,2 @@
+"""Shim: reference python/flexflow/keras_exp/ (experimental Keras frontend)."""
+from flexflow_tpu.frontends.keras_exp import models  # noqa: F401

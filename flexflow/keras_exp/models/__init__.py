@@ -1,0 +1,7 @@
+"""Shim: reference python/flexflow/keras_exp/models/__init__.py"""
+from flexflow_tpu.frontends.keras_exp.models import (  # noqa: F401
+    BaseModel,
+    Model,
+    Sequential,
+    Tensor,
+)

@@ -87,12 +87,63 @@ class ONNXModel:
                 self.initializers[init.name] = np.asarray(init.data)
         self._weight_loads = []
 
+    def _plan_bias_folds(self):
+        """keras2onnx dense layout is MatMul(x, W_init) → Add(mm, b_init):
+        fold each such pair into ONE dense(use_bias=True) so the bias stays
+        a *trainable* weight. (The reference's ONNXModelKeras instead drops
+        dense biases entirely, onnx/model.py:343-345.) Returns
+        ({id(matmul_node): (add_node, bias_name)}, {id(add_node), ...})."""
+        consumers: Dict[str, list] = {}
+        for node in self.model.graph.node:
+            for i in node.input:
+                consumers.setdefault(i, []).append(node)
+        graph_outs = {o.name for o in self.model.graph.output}
+        folds, skip = {}, set()
+        for node in self.model.graph.node:
+            if (node.op_type != "MatMul"
+                    or node.input[1] not in self.initializers):
+                continue
+            cons = consumers.get(node.output[0], [])
+            if (len(cons) != 1 or cons[0].op_type != "Add"
+                    or node.output[0] in graph_outs):  # pre-bias tap exposed
+                continue
+            add = cons[0]
+            other = (add.input[1] if add.input[0] == node.output[0]
+                     else add.input[0])
+            bias = self.initializers.get(other)
+            w = self.initializers[node.input[1]]
+            # only a true per-unit bias folds; broadcastable scalar adds
+            # must stay constants, not become trainable parameters
+            if bias is None or bias.shape != (w.shape[1],):
+                continue
+            folds[id(node)] = (add, other)
+            skip.add(id(add))
+        return folds, skip
+
     def apply(self, ffmodel, input_tensors: Dict[str, object]):
         """Walk graph.node, building FFModel ops. input_tensors maps graph
         input names to FFModel tensors."""
         env: Dict[str, object] = dict(input_tensors)
         outputs = []
+        # register Constant-node values up front so the fold planner (and
+        # the MatMul/Gemm weight path) see them before the walk reaches the
+        # Constant node; the walk's handle_Constant re-registers harmlessly
         for node in self.model.graph.node:
+            if node.op_type == "Constant":
+                self.handle_Constant(None, node, env)
+        folds, skip = self._plan_bias_folds()
+        for node in self.model.graph.node:
+            if id(node) in skip:
+                continue  # bias Add folded into its dense
+            if id(node) in folds:
+                add, bias_name = folds[id(node)]
+                w = self.initializers[node.input[1]]
+                t = ffmodel.dense(env[node.input[0]], w.shape[1],
+                                  use_bias=True)
+                self._weight_loads.append(
+                    (ffmodel.layers[-1], [w, self.initializers[bias_name]]))
+                env[add.output[0]] = t  # mm output has no other reader
+                continue
             handler = getattr(self, f"handle_{node.op_type}", None)
             if handler is None:
                 raise NotImplementedError(f"ONNX op {node.op_type}")
@@ -207,10 +258,25 @@ class ONNXModel:
         return self._binary(ff, node, env, "divide")
 
     def _binary(self, ff, node, env, opname):
-        a, b = env.get(node.input[0]), env.get(node.input[1])
-        assert a is not None and b is not None, (
-            f"ONNX {opname} with constant operand not yet supported"
-        )
+        def resolve(name):
+            if name in env:
+                v = env[name]
+                if isinstance(v, np.ndarray):
+                    # Constant-node operand: its handler leaves a raw array
+                    # in env; bake it the same way as an initializer
+                    return ff.create_constant_tensor(np.atleast_1d(v))
+                return v
+            # constant operand (keras-export bias Add, scale Mul, ...):
+            # bake the initializer as a constant tensor; elementwise ops
+            # broadcast-infer the output shape
+            arr = self.initializers.get(name)
+            assert arr is not None, (
+                f"ONNX {opname}: operand {name!r} is neither a graph value "
+                "nor an initializer"
+            )
+            return ff.create_constant_tensor(np.atleast_1d(arr))
+
+        a, b = resolve(node.input[0]), resolve(node.input[1])
         return getattr(ff, opname)(a, b)
 
     def handle_Concat(self, ff, node, env):

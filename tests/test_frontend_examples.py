@@ -19,6 +19,8 @@ CASES = [
     ("onnx", "mnist_mlp.py"),           # torch-layout Gemm transB
     ("onnx", "mnist_mlp_keras.py"),     # keras-layout MatMul
     ("onnx", "resnet.py"),              # Conv/BN/Add/GlobalAveragePool
+    ("keras_exp", "func_mnist_mlp.py"),  # keras_exp Model over ONNX export
+    ("keras_exp", "func_cifar10_cnn_concat.py"),  # + conv towers, Concat
 ]
 
 

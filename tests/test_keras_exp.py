@@ -1,0 +1,81 @@
+"""keras_exp frontend (reference: python/flexflow/keras_exp/models/model.py,
+examples/python/keras_exp/func_mnist_mlp.py). The reference path is
+tf.keras → keras2onnx → ONNXModelKeras; TF isn't installed here, so these
+tests exercise the same BaseModel/Model pipeline from a pre-exported ONNX
+ModelProto built with the self-contained proto codec."""
+from types import SimpleNamespace
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.frontends.keras_exp.models import Model
+from flexflow_tpu.frontends.onnx import proto
+
+
+def _mlp_proto(dims=(784, 64, 10), seed=0):
+    """keras2onnx-style MLP: MatMul with (in, out) kernels + Relu + Softmax."""
+    rng = np.random.RandomState(seed)
+    nodes, inits = [], []
+    prev = "input_1"
+    for i in range(len(dims) - 1):
+        w = (rng.randn(dims[i], dims[i + 1]) / np.sqrt(dims[i])).astype(
+            np.float32)
+        inits.append(proto.from_array(w, f"dense_{i}/kernel"))
+        nodes.append(proto.make_node("MatMul", [prev, f"dense_{i}/kernel"],
+                                     [f"mm{i}"], name=f"MatMul_{i}"))
+        prev = f"mm{i}"
+        if i < len(dims) - 2:
+            nodes.append(proto.make_node("Relu", [prev], [f"relu{i}"],
+                                         name=f"Relu_{i}"))
+            prev = f"relu{i}"
+    nodes.append(proto.make_node("Softmax", [prev], ["out"], name="Softmax_0",
+                                 axis=-1))
+    graph = proto.make_graph(
+        nodes, "keras_model",
+        [proto.make_tensor_value_info("input_1", proto.TensorProto.FLOAT,
+                                      ["N", dims[0]])],
+        [proto.make_tensor_value_info("out", proto.TensorProto.FLOAT,
+                                      ["N", dims[-1]])],
+        initializer=inits)
+    return proto.make_model(graph)
+
+
+def test_keras_exp_mnist_mlp_trains():
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    model = Model(
+        inputs={1: SimpleNamespace(shape=(None, 784), dtype="float32")},
+        onnx_model=_mlp_proto(),
+        ffconfig=cfg,
+    )
+    model.compile(optimizer="SGD", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"])
+    assert "MatMul" in model.summary()
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 784).astype(np.float32)
+    y = rng.randint(0, 10, (64, 1)).astype(np.int32)
+    pm0 = model.fit(x, y, batch_size=16, epochs=1)
+    loss0 = pm0.sparse_cce_loss
+    pm = model.fit(x, y, epochs=3)
+    assert pm.sparse_cce_loss < loss0, (pm.sparse_cce_loss, loss0)
+
+
+def test_keras_exp_tf_optimizer_duck_typing():
+    """A tf.keras-style optimizer object (hyperparams exposing .numpy())
+    converts without tensorflow installed."""
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    fake_var = SimpleNamespace(numpy=lambda: 0.05)
+    tf_like_sgd = type("SGD", (), {"learning_rate": fake_var,
+                                   "momentum": SimpleNamespace(numpy=lambda: 0.9),
+                                   "nesterov": False})()
+    model = Model(
+        inputs={1: SimpleNamespace(shape=(None, 784), dtype="float32")},
+        onnx_model=_mlp_proto(),
+        ffconfig=cfg,
+    )
+    model.compile(optimizer=tf_like_sgd, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    opt = model._base_model._ffoptimizer
+    assert opt.learning_rate == 0.05 and opt.momentum == 0.9

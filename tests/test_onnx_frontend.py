@@ -82,3 +82,111 @@ def test_onnx_mlp_import():
     e = np.exp(ref - ref.max(-1, keepdims=True))
     ref = e / e.sum(-1, keepdims=True)
     np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+def _apply_graph(graph, in_shape=(8, 16)):
+    cfg = FFConfig()
+    cfg.batch_size = in_shape[0]
+    ff = FFModel(cfg)
+    x = ff.create_tensor(list(in_shape), DataType.DT_FLOAT)
+    om = ONNXModel(ModelDouble(graph))
+    out = om.apply(ff, {"x": x})
+    ff.compile(optimizer=SGDOptimizer(lr=0.0),
+               loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               metrics=[])
+    om.load_weights(ff)
+    return ff, om
+
+
+def test_onnx_bias_fold_trainable():
+    """keras2onnx dense layout MatMul→Add(1-D bias) folds to ONE dense layer
+    with a trainable bias (the reference's ONNXModelKeras drops these
+    biases, onnx/model.py:343-345)."""
+    rng = np.random.RandomState(0)
+    w = rng.randn(16, 4).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    graph = GraphDouble(
+        nodes=[Node("MatMul", ["x", "w"], ["mm"]),
+               Node("Add", ["mm", "b"], ["y"])],
+        initializers=[Init("w", w), Init("b", b)],
+        outputs=["y"],
+    )
+    ff, om = _apply_graph(graph)
+    dense_layers = [l for l in ff.layers if len(l.weights) == 2]
+    assert len(dense_layers) == 1, "MatMul+Add should fold to one dense"
+    xv = rng.randn(8, 16).astype(np.float32)
+    np.testing.assert_allclose(ff.predict(xv, batch_size=8), xv @ w + b,
+                               atol=1e-5)
+
+
+def test_onnx_scalar_add_stays_constant():
+    """A broadcastable shape-(1,) Add operand must NOT fold into a
+    trainable bias — it stays a baked constant."""
+    rng = np.random.RandomState(1)
+    w = rng.randn(16, 4).astype(np.float32)
+    c = np.array([2.5], np.float32)
+    graph = GraphDouble(
+        nodes=[Node("MatMul", ["x", "w"], ["mm"]),
+               Node("Add", ["mm", "c"], ["y"])],
+        initializers=[Init("w", w), Init("c", c)],
+        outputs=["y"],
+    )
+    ff, om = _apply_graph(graph)
+    xv = rng.randn(8, 16).astype(np.float32)
+    np.testing.assert_allclose(ff.predict(xv, batch_size=8), xv @ w + 2.5,
+                               atol=1e-5)
+
+
+def test_onnx_prebias_tap_not_folded():
+    """When the MatMul output itself is a graph output, the fold must not
+    alias the pre-bias name to the post-bias tensor."""
+    rng = np.random.RandomState(2)
+    w = rng.randn(16, 4).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    graph = GraphDouble(
+        nodes=[Node("MatMul", ["x", "w"], ["mm"]),
+               Node("Add", ["mm", "b"], ["y"])],
+        initializers=[Init("w", w), Init("b", b)],
+        outputs=["mm", "y"],
+    )
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    ff = FFModel(cfg)
+    x = ff.create_tensor([8, 16], DataType.DT_FLOAT)
+    om = ONNXModel(ModelDouble(graph))
+    outs = om.apply(ff, {"x": x})
+    assert isinstance(outs, list) and len(outs) == 2
+    assert outs[0] is not outs[1], "pre-bias tap aliased to biased output"
+
+
+def test_onnx_constant_node_weights_fold_and_lift():
+    """Constant-node weights (the other keras2onnx layout): pre-scan
+    registers them before the fold planner, so MatMul+Add(bias) still
+    folds; a non-bias Constant Add operand lifts to a baked constant
+    instead of crashing on the raw ndarray left in env."""
+    from flexflow_tpu.frontends.onnx import proto
+
+    rng = np.random.RandomState(3)
+    w = rng.randn(16, 4).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+
+    def const_node(arr, out):
+        t = proto.from_array(arr, out)
+        return Node("Constant", [], [out],
+                    attrs=[type("A", (), {"name": "value", "t": t})()])
+
+    graph = GraphDouble(
+        nodes=[const_node(w, "w"), const_node(b, "b"),
+               const_node(np.array([1.5], np.float32), "c"),
+               Node("MatMul", ["x", "w"], ["mm"]),
+               Node("Add", ["mm", "b"], ["y"]),
+               Node("Add", ["y", "c"], ["z"])],
+        initializers=[],
+        outputs=["z"],
+    )
+    ff, om = _apply_graph(graph)
+    dense_layers = [l for l in ff.layers if len(l.weights) == 2]
+    assert len(dense_layers) == 1, "Constant-node MatMul+Add should fold"
+    xv = rng.randn(8, 16).astype(np.float32)
+    np.testing.assert_allclose(ff.predict(xv, batch_size=8),
+                               xv @ w + b + 1.5, atol=1e-5)
