@@ -47,7 +47,6 @@ def main():
         metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR],
     )
     ex = model.executor
-    step = ex.build_train_step()
     in_pt = ex.input_pts[0]
     rng = np.random.RandomState(0)
     x = ex.shard_batch(in_pt, rng.randn(*in_pt.material_shape()).astype(np.float32))
@@ -71,15 +70,31 @@ def main():
     def sync(st):
         return float(np.asarray(probe(st.params)))
 
-    # warmup (compile)
-    for _ in range(3):
-        state, partials = step(state, [x], y, key)
+    # Measure through the multi-step scan driver (executor.build_train_scan
+    # — the Legion trace-replay analog): per-step host dispatch is folded
+    # into one XLA program, so the number reflects device throughput, not
+    # the remote-tunnel round-trip latency. The reference's bench likewise
+    # replays a Legion trace per iteration (flexflow_cffi.py:2093-2102).
+    scan = ex.build_train_scan()
+    spd = 25  # steps per dispatch
+    xs = [jax.numpy.broadcast_to(x, (spd,) + x.shape)]
+    ys = jax.numpy.broadcast_to(y, (spd,) + y.shape)
+    keys = jax.random.split(key, spd)
+
+    # warmup: TWO calls, not one — the first compiles against the
+    # init-time param layouts, and its donated output comes back in the
+    # executable's preferred layouts, which triggers ONE more compile on
+    # the next call; the second warmup absorbs it so the timed loop only
+    # measures steady-state execution.
+    for _ in range(2):
+        state, partials = scan(state, xs, ys, keys)
     sync(state)
 
-    iters = 20
+    chunks = 6
+    iters = spd * chunks
     t0 = time.perf_counter()
-    for _ in range(iters):
-        state, partials = step(state, [x], y, key)
+    for _ in range(chunks):
+        state, partials = scan(state, xs, ys, keys)
     sync(state)
     elapsed = time.perf_counter() - t0
 

@@ -85,6 +85,9 @@ class FFConfig:
     device_mem: int = 0  # bytes of HBM per chip for the memory-aware search
     seed: int = 0
     iterations: int = 1
+    # Steps fused into one XLA dispatch by fit() (lax.scan driver — the
+    # Legion trace-replay analog). 1 = one host dispatch per batch.
+    iterations_per_dispatch: int = 1
 
     def __post_init__(self):
         if self.workersPerNode == 0:
@@ -155,6 +158,8 @@ class FFConfig:
                     self.simulator_work_space_size = int(take()); i += 1
                 elif a == "--iterations":
                     self.iterations = int(take()); i += 1
+                elif a == "--iterations-per-dispatch":
+                    self.iterations_per_dispatch = int(take()); i += 1
                 # silently skip unknown flags (Legion-style passthrough)
             except (IndexError, ValueError):
                 pass

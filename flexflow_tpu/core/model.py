@@ -864,6 +864,8 @@ class FFModel:
             for op_name, t in sorted(times.items(), key=lambda kv: -kv[1]):
                 print(f"[profiling] {op_name}: {t*1e3:.3f} ms")
         label_dt = self.label_tensor.data_type.jnp_dtype
+        spd = max(1, self.config.iterations_per_dispatch)
+        scan_fn = self.executor.build_train_scan() if spd > 1 else None
         self.perf_metrics = PerfMetrics()
         start = time.time()
         num_samples = 0
@@ -874,20 +876,55 @@ class FFModel:
             # Keep partials on device during the epoch so host dispatch stays
             # ahead of the chip (no per-batch sync); fold once at epoch end.
             device_partials = []
-            for batch in self._batches(list(xs) + [y], bs):
-                bx = [
-                    self.executor.shard_batch(pt, np.asarray(a, pt.data_type.np_dtype))
-                    for pt, a in zip(in_pts, batch[:-1])
+            chunk: List[list] = []
+
+            def flush(chunk):
+                # fuse the chunk's steps into ONE dispatch (lax.scan driver
+                # — the Legion trace-replay analog); partials come back
+                # stacked on a steps axis
+                bxs = [
+                    self.executor.shard_batch_stack(
+                        pt,
+                        np.stack([np.asarray(b[i], pt.data_type.np_dtype)
+                                  for b in chunk]),
+                    )
+                    for i, pt in enumerate(in_pts)
                 ]
-                by = jnp.asarray(batch[-1], label_dt)
-                self._rng, sub = jax.random.split(self._rng)
-                self.state, partials = step_fn(self.state, bx, by, sub)
+                bys = jnp.asarray(np.stack([b[-1] for b in chunk]), label_dt)
+                # one key per step, split exactly like the stepwise path so
+                # dropout masks are identical whatever the dispatch grouping
+                subs = []
+                for _ in chunk:
+                    self._rng, sub = jax.random.split(self._rng)
+                    subs.append(sub)
+                self.state, partials = scan_fn(
+                    self.state, bxs, bys, jnp.stack(subs)
+                )
                 device_partials.append(partials)
+
+            for batch in self._batches(list(xs) + [y], bs):
+                if spd > 1:
+                    chunk.append(batch)
+                    if len(chunk) == spd:
+                        flush(chunk)
+                        chunk = []
+                else:
+                    bx = [
+                        self.executor.shard_batch(pt, np.asarray(a, pt.data_type.np_dtype))
+                        for pt, a in zip(in_pts, batch[:-1])
+                    ]
+                    by = jnp.asarray(batch[-1], label_dt)
+                    self._rng, sub = jax.random.split(self._rng)
+                    self.state, partials = step_fn(self.state, bx, by, sub)
+                    device_partials.append(partials)
                 num_samples += bs
+            if chunk:  # tail chunk shorter than spd (own compiled shape)
+                flush(chunk)
             folded = jax.tree_util.tree_map(
-                lambda *vs: sum(float(v) for v in vs), *device_partials
+                lambda *vs: sum(float(np.sum(np.asarray(v))) for v in vs),
+                *device_partials,
             )
-            last_loss = float(device_partials[-1]["loss"])
+            last_loss = float(np.asarray(device_partials[-1]["loss"]).ravel()[-1])
             folded.pop("loss", None)
             self.perf_metrics.update(folded)
             if verbose:

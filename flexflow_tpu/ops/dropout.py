@@ -32,7 +32,10 @@ def _forward(params: DropoutParams, weights, inputs, ctx):
     if not ctx.training or params.rate <= 0.0 or ctx.rng is None:
         return [x]
     keep = 1.0 - params.rate
-    mask = jax.random.bernoulli(ctx.rng, keep, x.shape)
+    # per-op seed param folds into the step key (reference: dropout.cc
+    # seeds the cuDNN dropout state per layer)
+    rng = jax.random.fold_in(ctx.rng, params.seed)
+    mask = jax.random.bernoulli(rng, keep, x.shape)
     return [jnp.where(mask, x / keep, 0).astype(x.dtype)]
 
 

@@ -101,6 +101,7 @@ class PCGExecutor:
         assert outs, "graph has no output tensor"
         self.logits_pt = outs[-1]
         self._train_step = None
+        self._train_scan = None
         self._eval_step = None
         self._fwd = None
 
@@ -156,15 +157,23 @@ class PCGExecutor:
                 vals[guid] = jnp.full(
                     pt.material_shape(), value, pt.data_type.jnp_dtype
                 )
+        compute_idx = 0
         for op in self.topo:
             ins = [vals[t.guid] for t in op.inputs]
             if op.is_parallel_op:
                 outs = par_ops.execute(op, ins, self.mesh)
             else:
                 opdef = get_op_def(op.op_type)
+                # fold in the op's index among COMPUTE ops, not its guid
+                # (process-global counter — a rebuilt model would draw
+                # different dropout masks for the same seed) and not its
+                # raw topo position (the search inserts partition/combine
+                # ops per mesh, which would make masks mesh-dependent)
                 op_rng = (
-                    jax.random.fold_in(rng, op.guid) if rng is not None else None
+                    jax.random.fold_in(rng, compute_idx)
+                    if rng is not None else None
                 )
+                compute_idx += 1
                 ctx = FwdCtx(
                     training=training,
                     rng=op_rng,
@@ -231,14 +240,12 @@ class PCGExecutor:
         `train_only` keeps the eval/forward traces, which don't see the
         optimizer's hyperparameters."""
         self._train_step = None
+        self._train_scan = None
         if not train_only:
             self._eval_step = None
             self._fwd = None
 
-    def build_train_step(self) -> Callable:
-        if self._train_step is not None:
-            return self._train_step
-
+    def _make_step(self):
         def step(state: TrainState, batch_inputs, labels, rng):
             def loss_of(params):
                 aux: list = []
@@ -267,8 +274,40 @@ class PCGExecutor:
                 partials,
             )
 
-        self._train_step = jax.jit(step, donate_argnums=(0,))
+        return step
+
+    def build_train_step(self) -> Callable:
+        if self._train_step is None:
+            self._train_step = jax.jit(self._make_step(), donate_argnums=(0,))
         return self._train_step
+
+    def build_train_scan(self) -> Callable:
+        """Multi-step driver: lax.scan over pre-staged batches in ONE XLA
+        program — the TPU-native analog of the reference's Legion trace
+        replay around each training iteration (flexflow_cffi.py:2093-2102
+        begin_trace/end_trace), amortizing per-step host dispatch. Takes
+        (state, stacked_inputs, stacked_labels, rngs) where every batch
+        array AND the rng keys carry a leading steps axis — the caller
+        supplies one key per step, so stochastic ops (dropout) see the
+        exact same streams as the one-dispatch-per-step path. Returns the
+        final state and per-step-stacked metric partials."""
+        if self._train_scan is not None:
+            return self._train_scan
+        step = self._make_step()
+
+        def multi(state, stacked_inputs, stacked_labels, rngs):
+            def body(st, xs):
+                ins, lab, key = xs
+                st2, partials = step(st, ins, lab, key)
+                return st2, partials
+
+            state, partials = jax.lax.scan(
+                body, state, (list(stacked_inputs), stacked_labels, rngs)
+            )
+            return state, partials
+
+        self._train_scan = jax.jit(multi, donate_argnums=(0,))
+        return self._train_scan
 
     def build_eval_step(self) -> Callable:
         if self._eval_step is not None:
@@ -303,3 +342,11 @@ class PCGExecutor:
     def shard_batch(self, pt, array) -> jax.Array:
         sharding = sharding_for_parallel_tensor(pt, self.mesh)
         return jax.device_put(array, sharding)
+
+    def shard_batch_stack(self, pt, array) -> jax.Array:
+        """Place a (steps, *batch_shape) stack for build_train_scan: the
+        leading steps axis is unsharded, per-step dims shard as usual."""
+        spec = pspec_for_parallel_tensor(pt, self.mesh)
+        return jax.device_put(
+            array, NamedSharding(self.mesh, PartitionSpec(None, *spec))
+        )
