@@ -46,6 +46,8 @@ from flexflow_tpu.core.optimizers import (
 )
 from flexflow_tpu.ff_types import RegularizerMode  # noqa: F401
 
+from .flexflow_logger import fflogger  # noqa: F401
+
 
 def _drop_ffmodel(args):
     """The reference cffi optimizers take the FFModel as first arg

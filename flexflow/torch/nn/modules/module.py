@@ -1,0 +1,37 @@
+"""torch.nn.Module subclass owning an FFConfig/FFModel pair (reference:
+python/flexflow/torch/nn/modules/module.py). The reference version imports a
+`flexflow.torch.fx` module that does not exist in its tree (dead prototype);
+here symbolic_trace() goes through the working PyTorch-FX importer
+(PyTorchModel), so subclasses can trace themselves onto their FFModel."""
+import torch.nn as nn
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.core.model import FFModel
+from flexflow_tpu.frontends.torch.model import PyTorchModel
+
+
+class Module(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self._ffconfig = FFConfig()
+        self._ffmodel = FFModel(self._ffconfig)
+        self._graph = None
+
+    @property
+    def ffconfig(self):
+        return self._ffconfig
+
+    @property
+    def ffmodel(self):
+        return self._ffmodel
+
+    def symbolic_trace(self):
+        """Trace this module with torch.fx and keep the importer around;
+        call torch_to_ff(input_tensors) to build onto the owned FFModel."""
+        self._graph = PyTorchModel(self)
+        return self._graph
+
+    def torch_to_ff(self, input_tensors):
+        if self._graph is None:
+            self.symbolic_trace()
+        return self._graph.torch_to_ff(self._ffmodel, input_tensors)

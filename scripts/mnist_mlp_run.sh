@@ -1,0 +1,10 @@
+#!/bin/bash
+# reference: scripts/mnist_mlp_run.sh — launch the native mnist_mlp example.
+# The reference needs the flexflow_python Legion interpreter + conda env +
+# -ll:* Legion flags; here plain python is the interpreter (the reference's
+# FF_USE_NATIVE_PYTHON mode) and device setup is jax's job. Extra args pass
+# through (e.g. -b 64 --epochs 3 --iterations-per-dispatch 8).
+set -euo pipefail
+cd "$(dirname "${BASH_SOURCE[0]}")/.."
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python examples/python/mnist_mlp.py "$@"

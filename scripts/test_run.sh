@@ -1,0 +1,9 @@
+#!/bin/bash
+# reference: scripts/test_run.sh — build + run the op unit-test batch. The
+# reference version rebuilds protobuf/GASNet/Legion and runs each C++ op
+# test binary; here the whole stack is Python/XLA, so the equivalent is the
+# pytest suite on a virtual 8-device CPU mesh (tests/conftest.py forces the
+# cpu platform, so no TPU is needed).
+set -euo pipefail
+cd "$(dirname "${BASH_SOURCE[0]}")/.."
+python -m pytest tests/ -q "$@"

@@ -176,3 +176,41 @@ def test_l2_regularizer_affects_gradients():
     k_reg = train_once(0.5)
     # with zero data gradient, L2 shrinks weights: w' = w - lr*lam*w
     assert np.allclose(k_reg, k_plain * (1 - 0.5 * 0.5), atol=1e-5)
+
+
+def test_flexflow_logger_and_torch_nn_shims():
+    """reference: python/flexflow/core/flexflow_logger.py (fflogger) and
+    python/flexflow/torch/nn/modules/module.py (nn.Module owning an
+    FFConfig/FFModel; the reference's version imports a nonexistent
+    flexflow.torch.fx — here the trace goes through PyTorchModel)."""
+    torch = pytest.importorskip("torch")
+
+    from flexflow.core.flexflow_logger import fflogger
+    assert fflogger.name == "fflogger"
+
+    import flexflow.torch.nn as ffnn
+    from flexflow.core import DataType, LossType, MetricsType
+    from flexflow_tpu.core.optimizers import SGDOptimizer
+
+    class MLP(ffnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.l1 = torch.nn.Linear(8, 16)
+            self.l2 = torch.nn.Linear(16, 3)
+
+        def forward(self, x):
+            return torch.softmax(self.l2(torch.relu(self.l1(x))), dim=-1)
+
+    m = MLP()
+    m.ffconfig.batch_size = 4
+    x = m.ffmodel.create_tensor([4, 8], DataType.DT_FLOAT)
+    m.torch_to_ff([x])
+    m.ffmodel.compile(
+        optimizer=SGDOptimizer(lr=0.1),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY],
+    )
+    m._graph.load_weights(m.ffmodel)
+    xs = np.random.RandomState(0).rand(8, 8).astype(np.float32)
+    ys = np.random.RandomState(1).randint(0, 3, (8, 1)).astype(np.int32)
+    m.ffmodel.fit(xs, ys, epochs=1, verbose=False)
