@@ -1,0 +1,11 @@
+"""Accuracy thresholds for example-driven integration tests
+(reference: examples/python/native/accuracy.py ModelAccuracy)."""
+from enum import Enum
+
+
+class ModelAccuracy(Enum):
+    MNIST_MLP = 90
+    MNIST_CNN = 90
+    REUTERS_MLP = 90
+    CIFAR10_CNN = 90
+    CIFAR10_ALEXNET = 90

@@ -1,0 +1,52 @@
+"""BERT-proxy encoder stack through the native-python core API (reference:
+examples/python/native/bert_proxy_native.py; network from models/misc)."""
+import argparse
+
+from flexflow.core import *  # noqa: F401,F403
+import numpy as np
+
+from flexflow_tpu.models.misc import build_bert_proxy
+
+
+def top_level_task(args):
+    ffconfig = FFConfig()
+    ffmodel = FFModel(ffconfig)
+
+    input_tensor, _ = build_bert_proxy(
+        ffmodel, batch_size=ffconfig.batch_size, seq_length=args.seq_length,
+        hidden_size=args.hidden_size, num_heads=args.num_heads,
+        num_layers=args.num_layers)
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(
+        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    label_tensor = ffmodel.label_tensor
+
+    n = args.num_samples
+    shape = (n, args.seq_length, args.hidden_size)
+    rng = np.random.RandomState(0)
+    dl_x = ffmodel.create_data_loader(
+        input_tensor, rng.rand(*shape).astype("float32"))
+    dl_y = ffmodel.create_data_loader(
+        label_tensor, rng.rand(*shape).astype("float32"))
+
+    ffmodel.init_layers()
+    ts_start = ffconfig.get_current_time()
+    ffmodel.fit(x=dl_x, y=dl_y, epochs=ffconfig.epochs)
+    ts_end = ffconfig.get_current_time()
+    run_time = 1e-6 * (ts_end - ts_start)
+    print("epochs %d, ELAPSED TIME = %.4fs, THROUGHPUT = %.2f samples/s\n" % (
+        ffconfig.epochs, run_time, n * ffconfig.epochs / run_time))
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-length", type=int, default=64)
+    p.add_argument("--hidden-size", type=int, default=128)
+    p.add_argument("--num-heads", type=int, default=4)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--num-samples", type=int, default=64)
+    args, _ = p.parse_known_args()
+    print("bert proxy")
+    top_level_task(args)

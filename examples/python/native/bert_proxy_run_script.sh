@@ -1,0 +1,5 @@
+#!/bin/bash
+# reference: examples/python/native/bert_proxy_run_script.sh
+set -euo pipefail
+cd "$(dirname "${BASH_SOURCE[0]}")"
+PYTHONPATH="$(cd ../../.. && pwd)" python bert_proxy_native.py "$@"

@@ -1,0 +1,55 @@
+"""CIFAR-10 CNN driven by the stepwise loop with per-batch set_tensor
+(reference: examples/python/native/cifar10_cnn_attach.py)."""
+from flexflow.core import *  # noqa: F401,F403
+import numpy as np
+from flexflow.keras.datasets import cifar10
+
+from cifar10_cnn import build_cnn
+
+
+def next_batch(idx, arr, tensor, ffconfig, ffmodel):
+    start = idx * ffconfig.batch_size
+    tensor.set_tensor(ffmodel, arr[start:start + ffconfig.batch_size])
+
+
+def top_level_task(num_samples=1024, epochs=None):
+    ffconfig = FFConfig()
+    ffmodel = FFModel(ffconfig)
+
+    input_tensor = ffmodel.create_tensor(
+        [ffconfig.batch_size, 3, 32, 32], DataType.DT_FLOAT)
+    build_cnn(ffmodel, input_tensor)
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY,
+                 MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY])
+    label_tensor = ffmodel.label_tensor
+
+    (x_train, y_train), _ = cifar10.load_data(num_samples)
+    x_train = x_train.transpose(0, 3, 1, 2).astype("float32") / 255  # NCHW
+    y_train = y_train.astype("int32").reshape(-1, 1)
+
+    ffmodel.init_layers()
+    epochs = epochs or ffconfig.epochs
+
+    ts_start = ffconfig.get_current_time()
+    for epoch in range(epochs):
+        ffmodel.reset_metrics()
+        for it in range(num_samples // ffconfig.batch_size):
+            next_batch(it, x_train, input_tensor, ffconfig, ffmodel)
+            next_batch(it, y_train, label_tensor, ffconfig, ffmodel)
+            ffmodel.forward()
+            ffmodel.zero_gradients()
+            ffmodel.backward()
+            ffmodel.update()
+    ts_end = ffconfig.get_current_time()
+    run_time = 1e-6 * (ts_end - ts_start)
+    print("epochs %d, ELAPSED TIME = %.4fs, THROUGHPUT = %.2f samples/s\n" % (
+        epochs, run_time, num_samples * epochs / run_time))
+
+
+if __name__ == "__main__":
+    print("cifar10 cnn attach")
+    top_level_task()

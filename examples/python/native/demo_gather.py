@@ -1,0 +1,43 @@
+"""Gather demo: dense features indexed by a neighbor table, trained with
+attached arrays through the stepwise loop (reference:
+examples/python/native/demo_gather.py)."""
+from flexflow.core import *  # noqa: F401,F403
+import numpy as np
+
+
+def top_level_task(iters=20):
+    ffconfig = FFConfig()
+    ffmodel = FFModel(ffconfig)
+    bs = ffconfig.batch_size
+
+    inp = ffmodel.create_tensor([bs, 6, 10], DataType.DT_FLOAT)
+    index = ffmodel.create_tensor([bs, 6, 5], DataType.DT_INT32,
+                                  create_grad=False)
+    x0 = ffmodel.dense(inp, 5, ActiMode.AC_MODE_NONE, False)
+    x1 = ffmodel.gather(x0, index, 1)
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(
+        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    ffmodel.init_layers()
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(bs, 6, 10).astype("float32")
+    neighbors = rng.randint(0, 6, (bs, 6, 5)).astype("int32")
+    y = rng.rand(bs, 6, 5).astype("float32")
+
+    inp.attach_numpy_array(ffmodel, ffconfig, x)
+    index.attach_numpy_array(ffmodel, ffconfig, neighbors)
+    ffmodel.label_tensor.attach_numpy_array(ffmodel, ffconfig, y)
+
+    for _ in range(iters):
+        ffmodel.forward()
+        ffmodel.backward()
+        ffmodel.update()
+    print("final logits shape:", np.asarray(ffmodel._last_logits).shape)
+
+
+if __name__ == "__main__":
+    print("Demo Gather")
+    top_level_task()

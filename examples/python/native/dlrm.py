@@ -1,0 +1,50 @@
+"""DLRM through the native-python core API (reference:
+examples/python/native/dlrm.py; network from models/dlrm)."""
+from flexflow.core import *  # noqa: F401,F403
+import numpy as np
+
+from flexflow_tpu.models.dlrm import build_dlrm
+
+
+def top_level_task(num_samples=1024, epochs=None):
+    ffconfig = FFConfig()
+    ffmodel = FFModel(ffconfig)
+
+    embedding_sizes = (1000,) * 4
+    inputs, _ = build_dlrm(
+        ffmodel, batch_size=ffconfig.batch_size,
+        embedding_sizes=embedding_sizes)
+    sparse_inputs, dense_input = inputs[:-1], inputs[-1]
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY])
+    label_tensor = ffmodel.label_tensor
+
+    rng = np.random.RandomState(0)
+    loaders = [
+        ffmodel.create_data_loader(
+            s, rng.randint(0, 1000,
+                           (num_samples, s.dims[1])).astype("int32"))
+        for s in sparse_inputs
+    ]
+    loaders.append(ffmodel.create_data_loader(
+        dense_input,
+        rng.rand(num_samples, dense_input.dims[1]).astype("float32")))
+    dl_y = ffmodel.create_data_loader(
+        label_tensor, rng.randint(0, 2, (num_samples, 1)).astype("int32"))
+
+    ffmodel.init_layers()
+    epochs = epochs or ffconfig.epochs
+    ts_start = ffconfig.get_current_time()
+    ffmodel.fit(x=loaders, y=dl_y, epochs=epochs)
+    ts_end = ffconfig.get_current_time()
+    run_time = 1e-6 * (ts_end - ts_start)
+    print("epochs %d, ELAPSED TIME = %.4fs, THROUGHPUT = %.2f samples/s\n" % (
+        epochs, run_time, num_samples * epochs / run_time))
+
+
+if __name__ == "__main__":
+    print("dlrm")
+    top_level_task()

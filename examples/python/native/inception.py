@@ -1,0 +1,42 @@
+"""InceptionV3 through the native-python core API (reference:
+examples/python/native/inception.py; network from models/inception)."""
+from flexflow.core import *  # noqa: F401,F403
+import numpy as np
+
+from flexflow_tpu.models.inception import build_inception_v3
+
+
+def top_level_task(num_samples=64, epochs=None):
+    ffconfig = FFConfig()
+    ffmodel = FFModel(ffconfig)
+
+    input_tensor, _ = build_inception_v3(
+        ffmodel, batch_size=ffconfig.batch_size, num_classes=10)
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY,
+                 MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY])
+    label_tensor = ffmodel.label_tensor
+
+    rng = np.random.RandomState(0)
+    x_train = rng.rand(num_samples, 3, 299, 299).astype("float32")
+    y_train = rng.randint(0, 10, (num_samples, 1)).astype("int32")
+
+    dl_x = ffmodel.create_data_loader(input_tensor, x_train)
+    dl_y = ffmodel.create_data_loader(label_tensor, y_train)
+
+    ffmodel.init_layers()
+    epochs = epochs or ffconfig.epochs
+    ts_start = ffconfig.get_current_time()
+    ffmodel.fit(x=dl_x, y=dl_y, epochs=epochs)
+    ts_end = ffconfig.get_current_time()
+    run_time = 1e-6 * (ts_end - ts_start)
+    print("epochs %d, ELAPSED TIME = %.4fs, THROUGHPUT = %.2f samples/s\n" % (
+        epochs, run_time, num_samples * epochs / run_time))
+
+
+if __name__ == "__main__":
+    print("inception")
+    top_level_task()

@@ -1,0 +1,62 @@
+"""MNIST CNN through the native-python core API (reference:
+examples/python/native/mnist_cnn.py — conv32/conv64/pool/dense128/dense10)."""
+from flexflow.core import *  # noqa: F401,F403
+import numpy as np
+from flexflow.keras.datasets import mnist
+
+from accuracy import ModelAccuracy
+
+
+def top_level_task(num_samples=None, epochs=None):
+    ffconfig = FFConfig()
+    print("Python API batchSize(%d) workersPerNodes(%d) numNodes(%d)" % (
+        ffconfig.batch_size, ffconfig.workers_per_node, ffconfig.num_nodes))
+    ffmodel = FFModel(ffconfig)
+
+    input_tensor = ffmodel.create_tensor(
+        [ffconfig.batch_size, 1, 28, 28], DataType.DT_FLOAT)
+
+    t = ffmodel.conv2d(input_tensor, 32, 3, 3, 1, 1, 1, 1,
+                       ActiMode.AC_MODE_RELU, True)
+    t = ffmodel.conv2d(t, 64, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU, True)
+    t = ffmodel.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ffmodel.flat(t)
+    t = ffmodel.dense(t, 128, ActiMode.AC_MODE_RELU)
+    t = ffmodel.dense(t, 10)
+    t = ffmodel.softmax(t)
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY,
+                 MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY])
+    label_tensor = ffmodel.label_tensor
+
+    (x_train, y_train), _ = mnist.load_data()
+    n = num_samples or x_train.shape[0]
+    x_train = x_train[:n].reshape(n, 1, 28, 28).astype("float32") / 255
+    y_train = y_train[:n].astype("int32").reshape(-1, 1)
+
+    dataloader_input = ffmodel.create_data_loader(input_tensor, x_train)
+    dataloader_label = ffmodel.create_data_loader(label_tensor, y_train)
+
+    ffmodel.init_layers()
+    epochs = epochs or ffconfig.epochs
+
+    ts_start = ffconfig.get_current_time()
+    ffmodel.fit(x=dataloader_input, y=dataloader_label, epochs=epochs)
+    ts_end = ffconfig.get_current_time()
+    run_time = 1e-6 * (ts_end - ts_start)
+    print("epochs %d, ELAPSED TIME = %.4fs, THROUGHPUT = %.2f samples/s\n" % (
+        epochs, run_time, n * epochs / run_time))
+    return ffmodel.get_perf_metrics()
+
+
+def test_accuracy():
+    perf = top_level_task()
+    assert perf.get_accuracy() >= ModelAccuracy.MNIST_CNN.value
+
+
+if __name__ == "__main__":
+    print("mnist cnn")
+    top_level_task()

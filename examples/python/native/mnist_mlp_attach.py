@@ -1,0 +1,73 @@
+"""MNIST MLP driven by the stepwise cffi loop with per-batch tensor attach
+(reference: examples/python/native/mnist_mlp_attach.py — input/label bound
+via set_tensor each iteration, then forward / zero_gradients / backward /
+update)."""
+from flexflow.core import *  # noqa: F401,F403
+import numpy as np
+from flexflow.keras.datasets import mnist
+
+
+def next_batch(idx, x_train, tensor, ffconfig, ffmodel):
+    start = idx * ffconfig.batch_size
+    tensor.set_tensor(ffmodel, x_train[start:start + ffconfig.batch_size])
+
+
+def top_level_task(num_samples=2048, epochs=None):
+    ffconfig = FFConfig()
+    print("Python API batchSize(%d) workersPerNodes(%d) numNodes(%d)" % (
+        ffconfig.batch_size, ffconfig.workers_per_node, ffconfig.num_nodes))
+    ffmodel = FFModel(ffconfig)
+
+    input_tensor = ffmodel.create_tensor(
+        [ffconfig.batch_size, 784], DataType.DT_FLOAT)
+
+    t = ffmodel.dense(input_tensor, 512, ActiMode.AC_MODE_RELU)
+    t = ffmodel.dense(t, 512, ActiMode.AC_MODE_RELU)
+    t = ffmodel.dense(t, 10)
+    t = ffmodel.softmax(t)
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY,
+                 MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY])
+    label_tensor = ffmodel.label_tensor
+
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train[:num_samples].reshape(-1, 784).astype("float32") / 255
+    y_train = y_train[:num_samples].astype("int32").reshape(-1, 1)
+
+    next_batch(0, x_train, input_tensor, ffconfig, ffmodel)
+    next_batch(0, y_train, label_tensor, ffconfig, ffmodel)
+
+    ffmodel.init_layers()
+    epochs = epochs or ffconfig.epochs
+
+    ts_start = ffconfig.get_current_time()
+    for epoch in range(epochs):
+        ffmodel.reset_metrics()
+        iterations = num_samples // ffconfig.batch_size
+        for it in range(iterations):
+            ffconfig.begin_trace(111)
+            next_batch(it, x_train, input_tensor, ffconfig, ffmodel)
+            next_batch(it, y_train, label_tensor, ffconfig, ffmodel)
+            ffmodel.forward()
+            ffmodel.zero_gradients()
+            ffmodel.backward()
+            ffmodel.update()
+            ffconfig.end_trace(111)
+    ts_end = ffconfig.get_current_time()
+    run_time = 1e-6 * (ts_end - ts_start)
+    print("epochs %d, ELAPSED TIME = %.4fs, THROUGHPUT = %.2f samples/s\n" % (
+        epochs, run_time, num_samples * epochs / run_time))
+
+    # weight introspection after training (reference: get_layer_by_id /
+    # get_bias_tensor tail of mnist_mlp_attach.py)
+    dense1 = ffmodel.get_layer_by_id(0)
+    bias = dense1.get_bias_tensor()
+    print("dense1 bias shape:", bias.get_weights(ffmodel).shape)
+
+
+if __name__ == "__main__":
+    print("mnist mlp attach")
+    top_level_task()

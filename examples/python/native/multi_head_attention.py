@@ -1,0 +1,62 @@
+"""Hand-built multi-head attention from primitive ops (reference:
+examples/python/native/multi_head_attention.py — q/k/v dense, reshape to
+heads, transpose, batch_matmul score/value products, merge, MLP head)."""
+import argparse
+
+from flexflow.core import *  # noqa: F401,F403
+import numpy as np
+
+
+def top_level_task(args):
+    ffconfig = FFConfig()
+    print("Python API: batch_size(%d) workers/node(%d) nodes(%d)" % (
+        ffconfig.batch_size, ffconfig.workers_per_node, ffconfig.num_nodes))
+    ffmodel = FFModel(ffconfig)
+    bs, seq, hid, heads = (ffconfig.batch_size, args.seq_length,
+                           args.hidden_size, args.num_heads)
+    hd = hid // heads
+
+    inp = ffmodel.create_tensor([bs, seq, hid], DataType.DT_FLOAT)
+    q = ffmodel.dense(inp, hid)
+    k = ffmodel.dense(inp, hid)
+    v = ffmodel.dense(inp, hid)
+    q = ffmodel.reshape(q, shape=(bs, seq, heads, hd))
+    k = ffmodel.reshape(k, shape=(bs, seq, heads, hd))
+    v = ffmodel.reshape(v, shape=(bs, seq, heads, hd))
+    q = ffmodel.transpose(q, perm=(0, 2, 1, 3))
+    k = ffmodel.transpose(k, perm=(0, 2, 3, 1))
+    v = ffmodel.transpose(v, perm=(0, 2, 1, 3))
+    logits = ffmodel.batch_matmul(q, k)
+    out = ffmodel.batch_matmul(logits, v)
+    out = ffmodel.transpose(out, perm=(0, 2, 1, 3))
+    out = ffmodel.reshape(out, shape=(bs, seq, hid))
+    out = ffmodel.dense(out, hid, ActiMode.AC_MODE_RELU)
+    out = ffmodel.dense(out, hid)
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel)
+    ffmodel.compile(
+        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    label_tensor = ffmodel.label_tensor
+
+    n = bs * 4
+    x = np.random.rand(n, seq, hid).astype("float32")
+    y = np.random.rand(n, seq, hid).astype("float32")
+    dl_x = ffmodel.create_data_loader(inp, x)
+    dl_y = ffmodel.create_data_loader(label_tensor, y)
+
+    ffmodel.init_layers()
+    ts_start = ffconfig.get_current_time()
+    ffmodel.fit(x=dl_x, y=dl_y, epochs=ffconfig.epochs)
+    ts_end = ffconfig.get_current_time()
+    print("ELAPSED TIME = %.4fs" % (1e-6 * (ts_end - ts_start)))
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-length", type=int, default=16)
+    p.add_argument("--hidden-size", type=int, default=64)
+    p.add_argument("--num-heads", type=int, default=4)
+    args, _ = p.parse_known_args()
+    print("multi-head attention")
+    top_level_task(args)

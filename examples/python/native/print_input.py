@@ -1,0 +1,35 @@
+"""Input-tensor inspection demo (reference:
+examples/python/native/print_input.py — attach a batch to the input tensor,
+inline_map it, print the array)."""
+from flexflow.core import *  # noqa: F401,F403
+import numpy as np
+
+
+def top_level_task():
+    ffconfig = FFConfig()
+    ffmodel = FFModel(ffconfig)
+    bs = ffconfig.batch_size
+
+    input_tensor = ffmodel.create_tensor([bs, 16], DataType.DT_FLOAT)
+    t = ffmodel.dense(input_tensor, 8, ActiMode.AC_MODE_RELU)
+    t = ffmodel.dense(t, 4)
+    t = ffmodel.softmax(t)
+
+    ffmodel.compile(
+        optimizer=SGDOptimizer(ffmodel, 0.01),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY])
+
+    batch = np.arange(bs * 16, dtype=np.float32).reshape(bs, 16)
+    input_tensor.attach_numpy_array(ffmodel, ffconfig, batch)
+
+    input_tensor.inline_map(ffmodel, ffconfig)
+    arr = input_tensor.get_array(ffmodel, ffconfig)
+    print("input:", arr.shape)
+    print(arr[0, :8])
+    input_tensor.inline_unmap(ffmodel, ffconfig)
+
+
+if __name__ == "__main__":
+    print("print input")
+    top_level_task()

@@ -1,0 +1,50 @@
+"""Layer/tensor introspection demo (reference:
+examples/python/native/print_layers.py — inline_map/get_array on the label,
+get_layer_by_id + get_bias_tensor + set_weights on a conv)."""
+from flexflow.core import *  # noqa: F401,F403
+import numpy as np
+
+
+def top_level_task():
+    ffconfig = FFConfig()
+    ffmodel = FFModel(ffconfig)
+    bs = ffconfig.batch_size
+
+    input1 = ffmodel.create_tensor([bs, 3, 229, 229], DataType.DT_FLOAT)
+    input2 = ffmodel.create_tensor([bs, 16], DataType.DT_FLOAT)
+
+    t1 = ffmodel.conv2d(input1, 64, 11, 11, 4, 4, 2, 2)
+    t2 = ffmodel.dense(input2, 8, ActiMode.AC_MODE_RELU)
+    t = ffmodel.concat([ffmodel.flat(t1), t2], 1)
+    t = ffmodel.dense(t, 10)
+    t = ffmodel.softmax(t)
+
+    ffmodel.compile(
+        optimizer=SGDOptimizer(ffmodel, 0.01),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY,
+                 MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY])
+    label = ffmodel.label_tensor
+
+    label.inline_map(ffmodel, ffconfig)
+    label_array = label.get_array(ffmodel, ffconfig)
+    label_array *= 0
+    label_array += 1
+    print(label_array.shape)
+    print(label_array[:2])
+    label.inline_unmap(ffmodel, ffconfig)
+
+    conv_2d1 = ffmodel.get_layer_by_id(0)
+    cbias_tensor = conv_2d1.get_bias_tensor()
+    np_array = np.full((64,), 22.222, dtype=np.float32)
+    cbias_tensor.set_weights(ffmodel, np_array)
+    print("conv bias after set_weights:",
+          cbias_tensor.get_weights(ffmodel)[:4])
+
+    for i, layer in ffmodel.get_layers().items():
+        print(i, layer)
+
+
+if __name__ == "__main__":
+    print("print layers")
+    top_level_task()

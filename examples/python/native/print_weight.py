@@ -1,0 +1,39 @@
+"""Weight inspection demo (reference:
+examples/python/native/print_weight.py — train one step, then inline_map a
+dense layer's kernel and print it)."""
+from flexflow.core import *  # noqa: F401,F403
+import numpy as np
+
+
+def top_level_task():
+    ffconfig = FFConfig()
+    ffmodel = FFModel(ffconfig)
+    bs = ffconfig.batch_size
+
+    input_tensor = ffmodel.create_tensor([bs, 784], DataType.DT_FLOAT)
+    t = ffmodel.dense(input_tensor, 128, ActiMode.AC_MODE_RELU)
+    t = ffmodel.dense(t, 10)
+    t = ffmodel.softmax(t)
+
+    ffmodel.compile(
+        optimizer=SGDOptimizer(ffmodel, 0.01),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY])
+    ffmodel.init_layers()
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(bs * 4, 784).astype("float32")
+    y = rng.randint(0, 10, (bs * 4, 1)).astype("int32")
+    ffmodel.fit(x, y, epochs=1, verbose=False)
+
+    dense1 = ffmodel.get_layer_by_id(0)
+    kernel = dense1.get_weight_tensor()
+    kernel.inline_map(ffmodel, ffconfig)
+    arr = kernel.get_array(ffmodel, ffconfig)
+    print("dense1 kernel:", arr.shape, "mean", float(arr.mean()))
+    kernel.inline_unmap(ffmodel, ffconfig)
+
+
+if __name__ == "__main__":
+    print("print weight")
+    top_level_task()

@@ -1,0 +1,44 @@
+"""ResNet-50 through the native-python core API (reference:
+examples/python/native/resnet.py; network from models/resnet)."""
+from flexflow.core import *  # noqa: F401,F403
+import numpy as np
+
+from flexflow_tpu.models.resnet import build_resnet
+
+
+def top_level_task(num_samples=256, epochs=None, height=64, width=64):
+    ffconfig = FFConfig()
+    ffmodel = FFModel(ffconfig)
+
+    input_tensor, _ = build_resnet(
+        ffmodel, batch_size=ffconfig.batch_size, num_classes=10,
+        height=height, width=width)
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY,
+                 MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY])
+    label_tensor = ffmodel.label_tensor
+
+    rng = np.random.RandomState(0)
+    x_train = rng.rand(num_samples, 3, height, width).astype("float32")
+    y_train = rng.randint(0, 10, (num_samples, 1)).astype("int32")
+
+    dl_x = ffmodel.create_data_loader(input_tensor, x_train)
+    dl_y = ffmodel.create_data_loader(label_tensor, y_train)
+
+    ffmodel.init_layers()
+    epochs = epochs or ffconfig.epochs
+    ts_start = ffconfig.get_current_time()
+    ffmodel.fit(x=dl_x, y=dl_y, epochs=epochs)
+    ts_end = ffconfig.get_current_time()
+    run_time = 1e-6 * (ts_end - ts_start)
+    print("epochs %d, ELAPSED TIME = %.4fs, THROUGHPUT = %.2f samples/s\n" % (
+        epochs, run_time, num_samples * epochs / run_time))
+    return ffmodel.get_perf_metrics()
+
+
+if __name__ == "__main__":
+    print("resnet")
+    top_level_task()

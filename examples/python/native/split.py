@@ -1,0 +1,56 @@
+"""Concat + split demo net on CIFAR-10 (reference:
+examples/python/native/split.py — three conv towers concat'd on channels,
+split back into three, trunk continues from the middle split)."""
+from flexflow.core import *  # noqa: F401,F403
+import numpy as np
+from flexflow.keras.datasets import cifar10
+
+
+def top_level_task(num_samples=4096, epochs=None):
+    ffconfig = FFConfig()
+    ffmodel = FFModel(ffconfig)
+
+    input_tensor = ffmodel.create_tensor(
+        [ffconfig.batch_size, 3, 32, 32], DataType.DT_FLOAT)
+
+    t1 = ffmodel.conv2d(input_tensor, 32, 3, 3, 1, 1, 1, 1,
+                        ActiMode.AC_MODE_RELU)
+    t2 = ffmodel.conv2d(input_tensor, 32, 3, 3, 1, 1, 1, 1,
+                        ActiMode.AC_MODE_RELU)
+    t3 = ffmodel.conv2d(input_tensor, 32, 3, 3, 1, 1, 1, 1,
+                        ActiMode.AC_MODE_RELU)
+    t = ffmodel.concat([t1, t2, t3], 1)
+    ts = ffmodel.split(t, 3, 1)
+    t = ffmodel.conv2d(ts[1], 32, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ffmodel.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ffmodel.conv2d(t, 64, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ffmodel.conv2d(t, 64, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ffmodel.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ffmodel.flat(t)
+    t = ffmodel.dense(t, 512, ActiMode.AC_MODE_RELU)
+    t = ffmodel.dense(t, 10)
+    t = ffmodel.softmax(t)
+
+    ffmodel.optimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.compile(
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY,
+                 MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY])
+    label_tensor = ffmodel.label_tensor
+
+    (x_train, y_train), _ = cifar10.load_data(num_samples)
+    x_train = x_train.transpose(0, 3, 1, 2).astype("float32") / 255  # NCHW
+    y_train = y_train.astype("int32").reshape(-1, 1)
+
+    dataloader_input = ffmodel.create_data_loader(input_tensor, x_train)
+    dataloader_label = ffmodel.create_data_loader(label_tensor, y_train)
+
+    ffmodel.init_layers()
+    epochs = epochs or ffconfig.epochs
+    ffmodel.fit(x=dataloader_input, y=dataloader_label, epochs=epochs)
+    return ffmodel.get_perf_metrics()
+
+
+if __name__ == "__main__":
+    print("split test")
+    top_level_task()

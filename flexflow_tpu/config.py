@@ -194,6 +194,15 @@ class FFConfig:
 
         return time.time() * 1e6  # microseconds, like Realm::Clock
 
+    def begin_trace(self, trace_id: int) -> None:
+        """reference: flexflow_cffi.py:2093 (Legion trace capture around a
+        training iteration). XLA's compiled-executable cache plays that
+        role here — the first jitted call traces, later ones replay — so
+        these are accepted no-ops for drop-in script compat."""
+
+    def end_trace(self, trace_id: int) -> None:
+        """See begin_trace."""
+
 
 @dataclasses.dataclass
 class FFIterationConfig:

@@ -670,12 +670,17 @@ class FFModel:
         # order is stable under copy, so positions survive).
         pre_inputs = self.graph.input_tensors()
         pre_pos = {pt.guid: i for i, pt in enumerate(pre_inputs)}
-        self._input_positions = [
-            pre_pos[self._tensor_map[t.guid]]
+        # one pass builds BOTH the positional map and the user-Tensor list
+        # so attach_numpy_array / set_tensor slots stay element-wise
+        # aligned with the executor's input order by construction
+        _fit_pairs = [
+            (t, pre_pos[self._tensor_map[t.guid]])
             for t in self.input_tensors
             if self._tensor_map.get(t.guid) in pre_pos
             and t.guid not in self._constant_values
         ]
+        self._fit_input_tensors = [t for t, _ in _fit_pairs]
+        self._input_positions = [i for _, i in _fit_pairs]
         self._constant_positions = {
             pre_pos[self._tensor_map[t.guid]]: self._constant_values[t.guid]
             for t in self.input_tensors
@@ -983,12 +988,25 @@ class FFModel:
     def set_iteration_batch(self, inputs: List[np.ndarray], label: np.ndarray):
         self._current_batch = (inputs, label)
 
+    def _bound_inputs(self) -> List:
+        inputs, _ = self._current_batch
+        for i, a in enumerate(inputs):
+            assert a is not None, (
+                f"input tensor '{self._fit_input_tensors[i].name or i}' was "
+                "never attached — call set_tensor/attach_numpy_array first"
+            )
+        return inputs
+
     def forward(self, seq_length: int = -1):
         assert self.executor is not None and self._current_batch is not None
-        inputs, _ = self._current_batch
         fwd = self.executor.build_forward()
-        bx = [jnp.asarray(a) for a in inputs]
+        bx = [jnp.asarray(a) for a in self._bound_inputs()]
         self._last_logits = fwd(self.state.params, bx)
+        # The stepwise loop is synchronous like the reference's per-phase
+        # Legion tasks. Blocking also keeps two sharded programs with
+        # collectives from running concurrently, which can wedge the
+        # CPU-mesh in-process all-reduce rendezvous.
+        jax.block_until_ready(self._last_logits)
         return self._last_logits
 
     def zero_gradients(self):
@@ -996,17 +1014,19 @@ class FFModel:
 
     def backward(self, seq_length: int = -1):
         assert self.executor is not None and self._current_batch is not None
-        inputs, label = self._current_batch
-        bx = [jnp.asarray(a) for a in inputs]
+        _, label = self._current_batch
+        assert label is not None, (
+            "label tensor was never attached — call set_tensor/"
+            "attach_numpy_array on ffmodel.label_tensor first"
+        )
+        bx = [jnp.asarray(a) for a in self._bound_inputs()]
         by = jnp.asarray(label, self.label_tensor.data_type.jnp_dtype)
-
-        ex = self.executor
-
-        def loss_of(params):
-            vals = ex.apply(params, ex._input_vals(bx), training=True, rng=None)
-            return ex.loss_fn(vals[ex.logits_pt.guid], by)
-
-        self._pending_grads = jax.grad(loss_of)(self.state.params)
+        # one jitted program (not eager per-op sharded execution, which
+        # loses fusion and can wedge the CPU-mesh in-process collectives);
+        # cached + invalidated on the executor like the other step traces
+        grad_fn = self.executor.build_grad_step()
+        self._pending_grads = grad_fn(self.state.params, bx, by)
+        jax.block_until_ready(self._pending_grads)  # see forward()
 
     def update(self):
         assert self._pending_grads is not None, "call backward() first"
@@ -1108,11 +1128,22 @@ class FFModel:
         slot = self._find_weight_slot(t)
         if slot is not None:
             return np.asarray(self.state.params[slot[0]][slot[1]])
+        if self._current_batch is not None:
+            ins, lab = self._current_batch
+            if (self.label_tensor is not None
+                    and t.guid == self.label_tensor.guid and lab is not None):
+                return np.asarray(lab)
+            for i, ft in enumerate(self._fit_input_tensors):
+                if ft.guid == t.guid and ins[i] is not None:
+                    return np.asarray(ins[i])
         raise KeyError(f"tensor {t} is not a weight; activations are not retained")
 
     def _set_tensor_value(self, t: Tensor, value: np.ndarray):
         slot = self._find_weight_slot(t)
-        assert slot is not None, f"tensor {t} is not a weight"
+        if slot is None:
+            # input or label tensor: bind the batch for the stepwise loop
+            # (reference: mnist_mlp_attach.py input.set_tensor per batch)
+            return self._attach_array(t, value)
         op_name, w_name = slot
         old = self.state.params[op_name][w_name]
         assert tuple(value.shape) == tuple(old.shape), (
@@ -1120,6 +1151,28 @@ class FFModel:
         )
         self.state.params[op_name][w_name] = jax.device_put(
             value.astype(old.dtype), old.sharding
+        )
+
+    def _attach_array(self, t: Tensor, arr) -> None:
+        """Bind a numpy array to an input/label tensor for the stepwise
+        forward/backward/update loop (reference: attach_numpy_array,
+        flexflow_cffi.py — zero-copy Legion attach; here the array feeds
+        the next jitted call)."""
+        assert self.executor is not None, "attach needs compile() first"
+        arr = np.asarray(arr)
+        n = len(self.executor.input_pts)
+        ins, lab = self._current_batch or ([None] * n, None)
+        ins = list(ins)
+        if self.label_tensor is not None and t.guid == self.label_tensor.guid:
+            self._current_batch = (ins, arr)
+            return
+        for i, ft in enumerate(self._fit_input_tensors):
+            if ft.guid == t.guid:
+                ins[i] = arr
+                self._current_batch = (ins, lab)
+                return
+        raise KeyError(
+            f"tensor {t} is neither a weight, a graph input, nor the label"
         )
 
     def create_data_loader(self, batch_tensor: Tensor, full_array: np.ndarray):

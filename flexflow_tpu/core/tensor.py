@@ -61,6 +61,43 @@ class Tensor:
         model = ffmodel or self._model
         model._set_tensor_value(self, np.asarray(value))
 
+    # reference: flexflow_cffi.py Tensor.attach_numpy_array (zero-copy
+    # Legion attach) / inline_map / get_array / inline_unmap. Here the
+    # "mapped" view is a host numpy buffer; inline_unmap writes it back.
+    def attach_numpy_array(self, ffmodel=None, ffconfig=None, array=None):
+        model = ffmodel or self._model
+        model._attach_array(self, array)
+
+    def detach_numpy_array(self, ffmodel=None, ffconfig=None):
+        pass  # nothing pinned host-side
+
+    def inline_map(self, ffmodel=None, ffconfig=None):
+        model = ffmodel or self._model
+        try:
+            self._inline_buf = np.array(model._get_tensor_value(self))
+        except KeyError:
+            # not yet bound (e.g. the label before any batch): fresh zeros
+            self._inline_buf = np.zeros(self.dims, self.data_type.np_dtype)
+
+    def get_array(self, ffmodel=None, ffconfig=None, data_type=None):
+        assert getattr(self, "_inline_buf", None) is not None, (
+            "call inline_map first"
+        )
+        return self._inline_buf
+
+    def inline_unmap(self, ffmodel=None, ffconfig=None):
+        model = ffmodel or self._model
+        model._set_tensor_value(self, self._inline_buf)
+        self._inline_buf = None
+
+    # weight aliases (reference: flexflow_cffi.py Parameter.set_weights /
+    # get_weights)
+    def set_weights(self, ffmodel, value):
+        self.set_tensor(ffmodel, value)
+
+    def get_weights(self, ffmodel=None):
+        return self.get_tensor(ffmodel)
+
     # numpy-style niceties used by frontends
     @property
     def shape(self):
@@ -95,6 +132,18 @@ class Layer:
 
     def get_output_tensor(self, idx: int = 0) -> Tensor:
         return self.outputs[idx]
+
+    # reference: flexflow_cffi.py Op.get_input_tensor / get_weight_tensor /
+    # get_bias_tensor (weights[0] is the kernel, weights[1] the bias)
+    def get_input_tensor(self, idx: int = 0) -> Tensor:
+        return self.inputs[idx]
+
+    def get_weight_tensor(self, idx: int = 0) -> Tensor:
+        return self.weights[idx]
+
+    def get_bias_tensor(self) -> Tensor:
+        assert len(self.weights) > 1, f"layer {self.name} has no bias weight"
+        return self.weights[1]
 
     def __repr__(self):
         return f"Layer({self.name}, {self.op_type.name})"

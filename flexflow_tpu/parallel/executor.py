@@ -102,6 +102,7 @@ class PCGExecutor:
         self.logits_pt = outs[-1]
         self._train_step = None
         self._train_scan = None
+        self._grad_step = None
         self._eval_step = None
         self._fwd = None
 
@@ -241,6 +242,7 @@ class PCGExecutor:
         optimizer's hyperparameters."""
         self._train_step = None
         self._train_scan = None
+        self._grad_step = None
         if not train_only:
             self._eval_step = None
             self._fwd = None
@@ -308,6 +310,33 @@ class PCGExecutor:
 
         self._train_scan = jax.jit(multi, donate_argnums=(0,))
         return self._train_scan
+
+    def build_grad_step(self) -> Callable:
+        """Gradient-only step for the cffi-parity stepwise loop
+        (FFModel.backward). Uses the SAME loss as the fused train step —
+        including MoE aux losses and regularizer penalties — so stepwise
+        training matches fit() exactly."""
+        if self._grad_step is not None:
+            return self._grad_step
+
+        def grad_of(params, batch_inputs, labels):
+            def loss_of(p):
+                aux: list = []
+                vals = self.apply(
+                    p, self._input_vals(batch_inputs), training=True,
+                    rng=None, aux_out=aux,
+                )
+                loss = self.loss_fn(vals[self.logits_pt.guid], labels)
+                for a in aux:
+                    loss = loss + a
+                for r in self._reg_penalty(p):
+                    loss = loss + r
+                return loss
+
+            return jax.grad(loss_of)(params)
+
+        self._grad_step = jax.jit(grad_of)
+        return self._grad_step
 
     def build_eval_step(self) -> Callable:
         if self._eval_step is not None:

@@ -11,6 +11,20 @@ import os
 
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# Subprocess-launching tests (example smoke tests) must not inherit a
+# remote-TPU backend either — a wedged tunnel would hang the child at jax
+# init. Export the CPU-mesh env so children match the in-process config.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+if os.environ.get("JAX_PLATFORMS", "axon") == "axon":
+    # ambient axon (remote-TPU) config can't work once the pool IPs are
+    # dropped; anything else (an operator's explicit cpu/tpu) is honored
+    os.environ["JAX_PLATFORMS"] = "cpu"
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
 import jax  # noqa: E402
 
 try:

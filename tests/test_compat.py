@@ -214,3 +214,55 @@ def test_flexflow_logger_and_torch_nn_shims():
     xs = np.random.RandomState(0).rand(8, 8).astype(np.float32)
     ys = np.random.RandomState(1).randint(0, 3, (8, 1)).astype(np.int32)
     m.ffmodel.fit(xs, ys, epochs=1, verbose=False)
+
+
+def test_attach_and_introspection_api():
+    """reference: flexflow_cffi.py attach_numpy_array / inline_map /
+    get_array / inline_unmap / set_weights / get_weights and
+    Op.get_{input,weight,bias}_tensor (driven by the native print_* and
+    *_attach examples)."""
+    from flexflow.core import (
+        ActiMode, DataType, FFConfig, FFModel, LossType, MetricsType,
+        SGDOptimizer,
+    )
+
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    m = FFModel(cfg)
+    x = m.create_tensor([8, 4], DataType.DT_FLOAT)
+    t = m.dense(x, 16, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, 3)
+    t = m.softmax(t)
+    m.compile(optimizer=SGDOptimizer(lr=0.1),
+              loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              metrics=[MetricsType.METRICS_ACCURACY])
+
+    # layer introspection
+    dense1 = m.get_layer_by_id(0)
+    assert dense1.get_input_tensor().guid == x.guid
+    kernel, bias = dense1.get_weight_tensor(), dense1.get_bias_tensor()
+    assert tuple(kernel.dims) == (4, 16) and tuple(bias.dims) == (16,)
+
+    # weight set/get round trip (+ inline_map view writeback)
+    newb = np.full((16,), 2.5, np.float32)
+    bias.set_weights(m, newb)
+    np.testing.assert_array_equal(bias.get_weights(m), newb)
+    kernel.inline_map(m, cfg)
+    arr = kernel.get_array(m, cfg)
+    arr *= 0.0
+    kernel.inline_unmap(m, cfg)
+    assert np.all(kernel.get_weights(m) == 0.0)
+
+    # input/label attach drives the stepwise loop
+    rng = np.random.RandomState(0)
+    xb = rng.rand(8, 4).astype(np.float32)
+    yb = rng.randint(0, 3, (8, 1)).astype(np.int32)
+    x.attach_numpy_array(m, cfg, xb)
+    m.label_tensor.attach_numpy_array(m, cfg, yb)
+    np.testing.assert_array_equal(x.get_tensor(m), xb)
+    m.forward()
+    m.zero_gradients()
+    m.backward()
+    m.update()
+    # bias moved off the zeroed kernel's dead state? at least params changed
+    assert not np.array_equal(bias.get_weights(m), newb)

@@ -21,6 +21,10 @@ CASES = [
     ("onnx", "resnet.py"),              # Conv/BN/Add/GlobalAveragePool
     ("keras_exp", "func_mnist_mlp.py"),  # keras_exp Model over ONNX export
     ("keras_exp", "func_cifar10_cnn_concat.py"),  # + conv towers, Concat
+    ("native", "mnist_mlp_attach.py"),  # stepwise loop + per-batch attach
+    ("native", "demo_gather.py"),       # gather + attached index/label
+    ("native", "print_layers.py"),      # inline_map / set_weights APIs
+    ("native", "tensor_attach.py"),     # attach round trip
 ]
 
 
