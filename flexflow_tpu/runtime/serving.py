@@ -19,6 +19,58 @@ from typing import Dict, List, Optional
 import numpy as np
 
 
+def greedy_generate(
+    model,
+    encoder_ids: np.ndarray,
+    *,
+    max_new_tokens: Optional[int] = None,
+    start_token_id: int = 0,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
+) -> np.ndarray:
+    """Greedy autoregressive seq2seq decode over a compiled encoder-decoder
+    FFModel (e.g. an imported MT5ForConditionalGeneration) whose two graph
+    inputs are (encoder_ids, decoder_ids) and whose output is per-position
+    vocab logits.
+
+    The compiled graph is static-shape, so each step re-runs the SAME
+    jitted forward with the decoder prefix grown by one token — the causal
+    mask guarantees position t sees only tokens <= t, so the padded tail
+    cannot leak. No KV cache: one full forward per token (O(L) calls of
+    one cached executable). The reference has no generation API at all —
+    its serving story is the Triton prototype's single forward — so this
+    is a capability upgrade on the serving side.
+    """
+    assert model.executor is not None, "compile() the model first"
+    fwd = model.executor.build_forward()
+    enc_t, dec_t = model._fit_input_tensors[:2]
+    bs, dec_len = dec_t.dims[0], dec_t.dims[1]
+    assert tuple(encoder_ids.shape) == tuple(enc_t.dims), (
+        f"encoder_ids shape {tuple(encoder_ids.shape)} != compiled input "
+        f"shape {tuple(enc_t.dims)}"
+    )
+    want = dec_len - 1 if max_new_tokens is None else max_new_tokens
+    steps = min(want, dec_len - 1)
+
+    dec = np.full((bs, dec_len), pad_token_id,
+                  dec_t.data_type.np_dtype)
+    dec[:, 0] = start_token_id
+    if steps <= 0:
+        return dec[:, :1]
+    enc = np.asarray(encoder_ids, enc_t.data_type.np_dtype)
+    finished = np.zeros(bs, bool)
+    for t in range(steps):
+        logits = np.asarray(fwd(model.state.params, [enc, dec]))
+        nxt = logits[:, t].argmax(-1)
+        if eos_token_id is not None:
+            nxt = np.where(finished, pad_token_id, nxt)
+            finished |= nxt == eos_token_id
+        dec[:, t + 1] = nxt
+        if eos_token_id is not None and finished.all():
+            break
+    return dec[:, : t + 2]
+
+
 class InferenceRequest:
     def __init__(self, inputs: List[np.ndarray]):
         self.id = uuid.uuid4().hex

@@ -266,3 +266,43 @@ def test_attach_and_introspection_api():
     m.update()
     # bias moved off the zeroed kernel's dead state? at least params changed
     assert not np.array_equal(bias.get_weights(m), newb)
+
+
+def test_stepwise_backward_matches_fit_with_regularizer():
+    """The stepwise loop's grad step shares the fused train step's loss —
+    including L2 regularizer penalties — so forward/backward/update and
+    fit() converge identically (reference: both paths run the same
+    Legion tasks)."""
+    from flexflow.core import (
+        DataType, FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
+    )
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 4).astype(np.float32)
+    y = rng.rand(8, 2).astype(np.float32)
+
+    def build():
+        cfg = FFConfig()
+        cfg.batch_size = 8
+        m = FFModel(cfg)
+        t_in = m.create_tensor([8, 4], DataType.DT_FLOAT)
+        m.dense(t_in, 2, kernel_regularizer=("l2", 0.3))
+        m.compile(optimizer=SGDOptimizer(lr=0.5),
+                  loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+                  metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR])
+        return m, t_in
+
+    m1, _ = build()
+    m1.fit(x, y, epochs=1, verbose=False)
+
+    m2, t_in = build()
+    t_in.set_tensor(m2, x)
+    m2.label_tensor.set_tensor(m2, y)
+    m2.forward()
+    m2.zero_gradients()
+    m2.backward()
+    m2.update()
+
+    k1 = np.asarray(m1.state.params["op_linear_0"]["kernel"])
+    k2 = np.asarray(m2.state.params["op_linear_0"]["kernel"])
+    np.testing.assert_allclose(k1, k2, rtol=1e-6, atol=1e-7)

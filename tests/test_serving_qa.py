@@ -163,3 +163,52 @@ def test_e2e_concurrent_clients_get_own_results():
         assert sched.stats["padded_slots"] > 0
     finally:
         sched.stop()
+
+
+def test_greedy_generate_matches_hf():
+    """greedy_generate on an imported MT5ForConditionalGeneration produces
+    token-for-token the same sequences as transformers' own greedy
+    generate on the identical weights (serving-side capability upgrade;
+    the reference's Triton prototype has no generation API)."""
+    pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    from flexflow_tpu import (DataType, FFConfig, FFModel, LossType,
+                              MetricsType, SGDOptimizer)
+    from flexflow_tpu.frontends.torch.model import PyTorchModel
+    from flexflow_tpu.runtime.serving import greedy_generate
+
+    torch.manual_seed(0)
+    cfg_hf = transformers.MT5Config(
+        d_model=32, d_ff=64, num_layers=1, num_decoder_layers=1,
+        num_heads=2, d_kv=16, vocab_size=64, decoder_start_token_id=0,
+        pad_token_id=0, eos_token_id=1, dropout_rate=0.0,
+    )
+    mod = transformers.MT5ForConditionalGeneration(cfg_hf).eval()
+
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    ff = FFModel(cfg)
+    seq, dec_len = 6, 5
+    enc_in = ff.create_tensor([2, seq], DataType.DT_INT64)
+    dec_in = ff.create_tensor([2, dec_len], DataType.DT_INT64)
+    tm = PyTorchModel(mod, is_hf_model=True,
+                      input_names=["input_ids", "decoder_input_ids"])
+    tm.torch_to_ff(ff, [enc_in, dec_in])
+    ff.compile(optimizer=SGDOptimizer(lr=0.0),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    tm.load_weights(ff)
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(2, 64, (2, seq)).astype(np.int64)
+
+    ours = greedy_generate(ff, x, max_new_tokens=4, start_token_id=0,
+                           eos_token_id=1, pad_token_id=0)
+    with torch.no_grad():
+        theirs = mod.generate(
+            torch.tensor(x), max_new_tokens=4, do_sample=False, num_beams=1,
+        ).numpy()
+    assert ours.shape == theirs.shape, (ours.shape, theirs.shape)
+    np.testing.assert_array_equal(ours, theirs)
