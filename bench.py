@@ -9,12 +9,48 @@ equivalent of the reference's f32 cuDNN path, since bf16 is the MXU's native
 input type.
 """
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 
+def wait_for_backend(max_wait_s: float = 600.0) -> None:
+    """The remote-TPU ("axon") tunnel can wedge — a stuck lease makes jax
+    backend init block forever IN-PROCESS, where no timeout can save us.
+    Probe it in subprocesses (killable) and retry until healthy; if the
+    tunnel never recovers, exit loudly instead of hanging the driver."""
+    platforms = os.environ.get("JAX_PLATFORMS", "axon")
+    if "axon" not in platforms.split(","):
+        return  # explicit cpu/tpu config: nothing to probe
+    deadline = time.monotonic() + max_wait_s
+    while True:
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=90, capture_output=True, text=True,
+            )
+            if r.returncode == 0:
+                return
+            # fast non-zero exit = config/import error, not a wedged
+            # tunnel: surface the real traceback and stop immediately
+            print(r.stderr, file=sys.stderr)
+            print("bench: jax backend init failed (see traceback above)",
+                  file=sys.stderr)
+            sys.exit(1)
+        except subprocess.TimeoutExpired:
+            pass
+        if time.monotonic() > deadline:
+            print("bench: TPU backend unreachable (axon tunnel wedged); "
+                  "no measurement possible", file=sys.stderr)
+            sys.exit(1)
+        time.sleep(20)
+
+
 def main():
+    wait_for_backend()
     import jax
 
     from flexflow_tpu import (
