@@ -71,6 +71,84 @@ def greedy_generate(
     return dec[:, : t + 2]
 
 
+def _log_softmax(x: np.ndarray) -> np.ndarray:
+    m = x.max(axis=-1, keepdims=True)
+    e = np.exp(x - m)
+    return (x - m) - np.log(e.sum(axis=-1, keepdims=True))
+
+
+def beam_generate(
+    model,
+    encoder_ids: np.ndarray,
+    *,
+    num_beams: int = 4,
+    max_new_tokens: Optional[int] = None,
+    start_token_id: int = 0,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
+) -> np.ndarray:
+    """Beam-search decode over the same compiled forward as greedy_generate
+    (scores are sum of per-token log-probs; no length penalty). Each step
+    runs the beams of ONE sample as a batch-shaped forward, so the
+    compiled batch size must be >= num_beams; samples decode sequentially.
+    num_beams=1 degenerates to greedy."""
+    assert model.executor is not None, "compile() the model first"
+    fwd = model.executor.build_forward()
+    enc_t, dec_t = model._fit_input_tensors[:2]
+    bs, dec_len = dec_t.dims[0], dec_t.dims[1]
+    assert num_beams <= bs, (
+        f"num_beams {num_beams} > compiled batch {bs}; recompile with a "
+        "larger batch"
+    )
+    assert tuple(encoder_ids.shape[1:]) == tuple(enc_t.dims[1:]), (
+        f"encoder_ids row shape {tuple(encoder_ids.shape[1:])} != compiled "
+        f"{tuple(enc_t.dims[1:])}"
+    )
+    want = dec_len - 1 if max_new_tokens is None else max_new_tokens
+    steps = min(want, dec_len - 1)
+    n_rows = encoder_ids.shape[0]
+    if steps <= 0:
+        return np.full((n_rows, 1), start_token_id, dec_t.data_type.np_dtype)
+
+    outs = []
+    for row in np.asarray(encoder_ids, enc_t.data_type.np_dtype):
+        # beams packed into the compiled batch; unused slots repeat beam 0
+        enc = np.broadcast_to(row, (bs,) + row.shape).copy()
+        beams = np.full((num_beams, dec_len), pad_token_id,
+                        dec_t.data_type.np_dtype)
+        beams[:, 0] = start_token_id
+        scores = np.full(num_beams, -np.inf)
+        scores[0] = 0.0  # all beams identical at t=0: keep one alive
+        done = np.zeros(num_beams, bool)
+        for t in range(steps):
+            dec = np.full((bs, dec_len), pad_token_id, beams.dtype)
+            dec[:num_beams] = beams
+            logp = _log_softmax(
+                np.asarray(fwd(model.state.params, [enc, dec]))[:num_beams, t]
+            )
+            vocab = logp.shape[-1]
+            # finished beams propagate unchanged via a single pad candidate
+            cand = scores[:, None] + np.where(done[:, None], -np.inf, logp)
+            for b in np.nonzero(done)[0]:
+                cand[b, pad_token_id] = scores[b]
+            # top-k via argpartition (O(n), not a full sort of beams*vocab)
+            flat = np.argpartition(cand.ravel(), -num_beams)[-num_beams:]
+            flat = flat[np.argsort(cand.ravel()[flat])[::-1]]
+            src, tok = flat // vocab, flat % vocab
+            beams = beams[src]
+            beams[:, t + 1] = tok
+            scores = cand.ravel()[flat]
+            done = done[src]
+            if eos_token_id is not None:
+                done = done | (tok == eos_token_id)
+                if done.all():
+                    break
+        # fixed width for every sample (early-stopped rows carry pad after
+        # EOS) so the batch stacks even when samples finish at different t
+        outs.append(beams[int(np.argmax(scores)), : steps + 1])
+    return np.stack(outs, axis=0)
+
+
 class InferenceRequest:
     def __init__(self, inputs: List[np.ndarray]):
         self.id = uuid.uuid4().hex
