@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Tuple
 from ..pcg.graph import Graph
 from ..pcg.machine_view import MachineResource, MachineView, enumerate_machine_views
 from ..pcg.op import PCGOp
+from ..utils.recursive_logger import search_logger as _rlog
 from .cost_model import CostModel
 
 
@@ -122,6 +123,20 @@ class SearchHelper:
     def _compute(self, ops, bounds, fixed, res, graph) -> GraphCostResult:
         if not ops:
             return GraphCostResult(0.0, {})
+        # Disconnected subgraph → nonsequence split FIRST (reference: a
+        # dominator-based bottleneck cannot exist across components, and
+        # only this path considers running towers concurrently on machine
+        # halves). Must precede the pair fast-path and the bottleneck scan,
+        # both of which would otherwise price the towers sequentially.
+        if len(ops) > 1:
+            comps = self._components(ops, graph)
+            if len(comps) > 1:
+                a, b = comps[0], [o for c in comps[1:] for o in c]
+                with _rlog.enter("horizontal split: %d | %d ops",
+                                 len(comps[0]), len(b)):
+                    return self._nonsequence(
+                        tuple(a), tuple(b), bounds, fixed, res, graph
+                    )
         if len(ops) == 1:
             op = ops[0]
             views = [fixed[op.guid]] if op.guid in fixed else self.valid_views(op, res)
@@ -130,6 +145,25 @@ class SearchHelper:
                 c = self.node_cost(op, v, bounds)
                 if c < best.cost:
                     best = GraphCostResult(c, {op.guid: v})
+            return best
+        if len(ops) == 2:
+            # exhaustive CONNECTED-pair enumeration (disconnected pairs took
+            # the nonsequence path above) — the recursion's base case after
+            # sequence splits, so chains stay exactly optimal (the greedy
+            # fallback below would pick op0's view blind to op1)
+            a, b = ops
+            va = [fixed[a.guid]] if a.guid in fixed else self.valid_views(a, res)
+            vb = [fixed[b.guid]] if b.guid in fixed else self.valid_views(b, res)
+            best = GraphCostResult.infinity()
+            for v0 in va:
+                c0 = self.node_cost(a, v0, bounds)
+                mid = dict(bounds)
+                for t in a.outputs:
+                    mid[t.guid] = v0
+                for v1 in vb:
+                    c = c0 + self.node_cost(b, v1, mid)
+                    if c < best.cost:
+                        best = GraphCostResult(c, {a.guid: v0, b.guid: v1})
             return best
 
         # 1. bottleneck sequence split (reference: find_split_node /
@@ -145,42 +179,49 @@ class SearchHelper:
                 if prod and prod[0].guid in own_guids:
                     j = idx_of[prod[0].guid]
                     max_reach[j] = max(max_reach[j], i)
-        run_max = 0
+        # op i is a bottleneck iff no edge from ops[0..i-1] crosses past i:
+        # edges FROM i itself into the suffix are fine (post sees the
+        # bottleneck's fixed view via post_bounds), so they must not count.
+        # i >= 1 keeps the split nontrivial — peeling a lone source op would
+        # shadow the nonsequence (machine-splitting) option for graphs whose
+        # parallel towers the reference runs concurrently on half machines.
+        prefix_max = max_reach[0]  # furthest reach of edges from ops[0..i-1]
         bottleneck = -1
-        for i in range(len(ops) - 1):
-            run_max = max(run_max, max_reach[i])
-            if run_max <= i:
+        for i in range(1, len(ops) - 1):
+            if prefix_max <= i:
                 bottleneck = i
                 break  # first bottleneck — reference splits at the earliest
+            prefix_max = max(prefix_max, max_reach[i])
         if bottleneck >= 0:
             bn = ops[bottleneck]
             pre, post = ops[: bottleneck + 1], ops[bottleneck + 1 :]
-            best = GraphCostResult.infinity()
-            views = [fixed[bn.guid]] if bn.guid in fixed else self.valid_views(bn, res)
-            for v in views:
-                pre_fixed = dict(fixed)
-                pre_fixed[bn.guid] = v
-                r1 = self._cost_of(pre, bounds, pre_fixed, res, graph)
-                if r1.cost == float("inf"):
-                    continue
-                post_bounds = dict(bounds)
-                for t in bn.outputs:
-                    post_bounds[t.guid] = v
-                r2 = self._cost_of(post, post_bounds, fixed, res, graph)
-                total = r1.cost + r2.cost
-                if total < best.cost:
-                    views_map = dict(r1.views)
-                    views_map.update(r2.views)
-                    best = GraphCostResult(total, views_map)
-            return best
+            # reference: recursive_logger TAG_ENTER around sequence_optimize
+            with _rlog.enter("sequence split at %s: %d + %d ops",
+                             bn.name, len(pre), len(post)):
+                best = GraphCostResult.infinity()
+                views = (
+                    [fixed[bn.guid]] if bn.guid in fixed
+                    else self.valid_views(bn, res)
+                )
+                for v in views:
+                    pre_fixed = dict(fixed)
+                    pre_fixed[bn.guid] = v
+                    r1 = self._cost_of(pre, bounds, pre_fixed, res, graph)
+                    if r1.cost == float("inf"):
+                        continue
+                    post_bounds = dict(bounds)
+                    for t in bn.outputs:
+                        post_bounds[t.guid] = v
+                    r2 = self._cost_of(post, post_bounds, fixed, res, graph)
+                    total = r1.cost + r2.cost
+                    if total < best.cost:
+                        views_map = dict(r1.views)
+                        views_map.update(r2.views)
+                        best = GraphCostResult(total, views_map)
+                _rlog.info("best sequence cost %.4f", best.cost)
+                return best
 
-        # 2. horizontal split of weakly-connected components
-        comps = self._components(ops, graph)
-        if len(comps) > 1:
-            a, b = comps[0], [o for c in comps[1:] for o in c]
-            return self._nonsequence(tuple(a), tuple(b), bounds, fixed, res, graph)
-
-        # 3. fallback: greedy chain (connected, no bottleneck — rare diamond
+        # 2. fallback: greedy chain (connected, no bottleneck — rare diamond
         #    patterns): pick views greedily in topo order.
         views_map: Dict[int, MachineView] = {}
         total = 0.0

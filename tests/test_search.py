@@ -327,3 +327,67 @@ def test_topology_model_costs():
     b = m.xfer_cost(1 << 20, 0, 1)  # same link now congested
     assert b > a
     assert m.allreduce_cost(1 << 20, range(8)) > 0
+
+
+def test_recursive_logger_indents_search(caplog):
+    """reference: src/runtime/recursive_logger.cc — depth-indented debug
+    records around the DP search's recursive splits."""
+    import logging
+
+    from flexflow_tpu.utils.recursive_logger import RecursiveLogger, logger
+
+    rl = RecursiveLogger()
+    with caplog.at_level(logging.DEBUG, logger="flexflow_tpu.search"):
+        with rl.enter("outer %d", 1):
+            rl.info("inside")
+            with rl.enter("inner"):
+                rl.info("deep")
+    msgs = [r.getMessage() for r in caplog.records]
+    assert msgs == ["outer 1", "  inside", "  inner", "    deep"]
+    assert rl.depth == 0  # balanced on exit
+
+    # and the DP search emits nested records on a searchable graph
+    caplog.clear()
+    machine = MachineModel(num_nodes=1, workers_per_node=4)
+    sh = SearchHelper(CostModel(machine))
+    g = transformer_graph()  # 3-op chain: splits at index 1
+    res = MachineResource(num_nodes=1, all_procs_per_node=4,
+                          available_procs_per_node=4)
+    with caplog.at_level(logging.DEBUG, logger="flexflow_tpu.search"):
+        sh.graph_cost(g, res)
+    assert any("sequence split" in r.getMessage() for r in caplog.records)
+
+
+def test_disconnected_towers_take_nonsequence_split(caplog):
+    """Two independent towers must route through the nonsequence
+    (machine-splitting) path — running them concurrently on half machines
+    can beat pricing them sequentially on the full machine (reference:
+    find_optimal_nonsequence_graph_time)."""
+    import logging
+
+    model = FFModel(FFConfig())
+    x1 = model.create_tensor((64, 256), DataType.DT_FLOAT)
+    x2 = model.create_tensor((64, 256), DataType.DT_FLOAT)
+    t1 = model.dense(x1, 256, ActiMode.AC_MODE_RELU)
+    model.dense(t1, 128)
+    t2 = model.dense(x2, 256, ActiMode.AC_MODE_RELU)
+    model.dense(t2, 128)
+    g, _ = layers_to_pcg(model.layers)
+
+    machine = MachineModel(num_nodes=1, workers_per_node=4)
+    sh = SearchHelper(CostModel(machine))
+    res = MachineResource(num_nodes=1, all_procs_per_node=4,
+                          available_procs_per_node=4)
+    with caplog.at_level(logging.DEBUG, logger="flexflow_tpu.search"):
+        r = sh.graph_cost(g, res)
+    assert any("horizontal split" in rec.getMessage()
+               for rec in caplog.records)
+    assert r.cost < float("inf") and len(r.views) == 4
+
+    # concurrent half-machine option is at least as good as pricing the
+    # towers sequentially on the full machine
+    sh2 = SearchHelper(CostModel(machine))
+    ops = g.topo_order()  # DFS order keeps each tower contiguous
+    ra = sh2._cost_of(tuple(ops[:2]), {}, {}, res, g)
+    rb = sh2._cost_of(tuple(ops[2:]), {}, {}, res, g)
+    assert r.cost <= ra.cost + rb.cost + 1e-12
