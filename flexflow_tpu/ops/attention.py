@@ -118,23 +118,68 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
             * ctx.mesh.shape.get("seq", 1)
         )
     score_bytes = 4 * b * h * seq_len * kv_len // max(1, shard)
-    # FF_ATTENTION_IMPL ∈ {auto, dense, flash, chunked} overrides the
+    # FF_ATTENTION_IMPL ∈ {auto, dense, flash, chunked, ring} overrides the
     # size-based dispatch (like picking a cuDNN MHA algo by hand).
     impl = os.environ.get("FF_ATTENTION_IMPL", "auto")
-    if impl not in ("auto", "dense", "flash", "chunked"):
+    if impl not in ("auto", "dense", "flash", "chunked", "ring"):
         raise ValueError(
-            f"FF_ATTENTION_IMPL={impl!r}: expected auto|dense|flash|chunked"
+            f"FF_ATTENTION_IMPL={impl!r}: "
+            "expected auto|dense|flash|chunked|ring"
         )
-    if impl in ("flash", "chunked") and use_dropout:
+    if impl in ("flash", "chunked", "ring") and use_dropout:
         warnings.warn(
             f"FF_ATTENTION_IMPL={impl} ignored: attention dropout needs the "
             "dense path (streaming kernels don't thread the dropout rng)"
         )
     use_streaming = (
-        impl in ("flash", "chunked")
+        impl in ("flash", "chunked", "ring")
         or (impl == "auto" and score_bytes > 256 * 1024 * 1024)
     ) and not use_dropout
-    if use_streaming:
+    # Sequence/context parallelism: with the seq axis sharded, the dense
+    # and flash paths would make XLA all-gather the full K/V on every chip;
+    # ring attention keeps K/V resident and rotates shards over ICI
+    # (kernels/attention.py). Chosen whenever streaming kicks in on a
+    # seq-sharded mesh, or forced via FF_ATTENTION_IMPL=ring. shard_map
+    # needs every sharded dim divisible (GSPMD tolerates uneven shards,
+    # the explicit specs here don't) — otherwise fall back to streaming.
+    seq_degree = 1
+    data_degree = model_degree = 1
+    if ctx.mesh is not None:
+        seq_degree = ctx.mesh.shape.get("seq", 1)
+        data_degree = ctx.mesh.shape.get("data", 1)
+        model_degree = ctx.mesh.shape.get("model", 1)
+    use_ring = (
+        seq_degree > 1
+        and use_streaming
+        and kv_len == seq_len
+        and seq_len % seq_degree == 0
+        and b % data_degree == 0
+        and h % model_degree == 0
+    )
+    if impl == "ring" and not use_ring:
+        warnings.warn(
+            "FF_ATTENTION_IMPL=ring ignored: needs a seq-sharded mesh "
+            "(sequence_parallel_degree > 1), no dropout, self-attention "
+            "with batch/heads/seq divisible by their mesh degrees"
+        )
+    if use_ring:
+        import functools
+
+        from jax.sharding import PartitionSpec as P
+
+        from ..kernels.attention import ring_attention
+        from ..parallel.pipeline import shard_map
+
+        spec = P("data", "seq", "model", None)
+        attn = shard_map(
+            functools.partial(
+                ring_attention, axis_name="seq", causal=params.causal
+            ),
+            mesh=ctx.mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )(q, k, v)
+    elif use_streaming:
         # Long sequences: O(seq) memory kernels instead of the s×s score
         # tensor — Pallas flash attention on TPU, chunked scan elsewhere
         # (kernels/attention.py; replaces cuDNN MHA's internal algorithm).
