@@ -1,0 +1,91 @@
+"""Bootcamp demo, step 2: train the exported AlexNet on CIFAR-10 with
+FlexFlow-TPU (reference: bootcamp_demo/ff_alexnet_cifar10.py — this is
+BASELINE.md's AlexNet/CIFAR-10 throughput config).
+
+Run: python bootcamp_demo/ff_alexnet_cifar10.py -e 1 -b 64
+(exports alexnet.ff first if it is missing)
+"""
+import os
+
+import numpy as np
+from PIL import Image
+
+from flexflow.core import *  # noqa: F401,F403
+from flexflow.keras.datasets import cifar10
+from flexflow.torch.model import PyTorchModel
+
+
+def top_level_task():
+    ffconfig = FFConfig()
+    print(
+        "Python API batchSize(%d) workersPerNodes(%d) numNodes(%d)"
+        % (
+            ffconfig.get_batch_size(),
+            ffconfig.get_workers_per_node(),
+            ffconfig.get_num_nodes(),
+        )
+    )
+    ffmodel = FFModel(ffconfig)
+
+    dims_input = [ffconfig.get_batch_size(), 3, 229, 229]
+    input_tensor = ffmodel.create_tensor(dims_input, DataType.DT_FLOAT)
+
+    if not os.path.exists("alexnet.ff"):
+        from torch_alexnet_cifar10 import AlexNet
+        import flexflow.torch.fx as fx
+
+        fx.torch_to_flexflow(AlexNet(num_classes=10), "alexnet.ff")
+
+    torch_model = PyTorchModel("alexnet.ff")
+    torch_model.apply(ffmodel, [input_tensor])
+
+    ffoptimizer = SGDOptimizer(ffmodel, 0.01)
+    ffmodel.set_sgd_optimizer(ffoptimizer)
+    ffmodel.compile(
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[
+            MetricsType.METRICS_ACCURACY,
+            MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        ],
+    )
+    label_tensor = ffmodel.get_label_tensor()
+
+    num_samples = int(os.environ.get("BOOTCAMP_NUM_SAMPLES", 10000))
+    (x_train, y_train), _ = cifar10.load_data(num_samples)
+    x_train = x_train[:num_samples]
+    y_train = y_train[:num_samples]
+    num_samples = x_train.shape[0]
+    if x_train.shape[1] == 3:  # reference layout: (N, 3, 32, 32)
+        x_train = x_train.transpose(0, 2, 3, 1)
+
+    full_input_np = np.zeros((num_samples, 3, 229, 229), dtype=np.float32)
+    for i in range(num_samples):
+        pil_image = Image.fromarray(x_train[i].astype(np.uint8))
+        pil_image = pil_image.resize((229, 229), Image.NEAREST)
+        full_input_np[i] = np.array(pil_image, np.float32).transpose(2, 0, 1)
+    full_input_np /= 255
+
+    full_label_np = y_train.astype("int32").reshape(num_samples, 1)
+
+    dataloader_input = ffmodel.create_data_loader(input_tensor, full_input_np)
+    dataloader_label = ffmodel.create_data_loader(label_tensor, full_label_np)
+
+    num_samples = dataloader_input.num_samples
+
+    ffmodel.init_layers()
+
+    epochs = ffconfig.get_epochs()
+
+    ts_start = ffconfig.get_current_time()
+    ffmodel.fit(x=dataloader_input, y=dataloader_label, epochs=epochs)
+    ts_end = ffconfig.get_current_time()
+    run_time = 1e-6 * (ts_end - ts_start)
+    print(
+        "epochs %d, ELAPSED TIME = %.4fs, THROUGHPUT = %.2f samples/s\n"
+        % (epochs, run_time, num_samples * epochs / run_time)
+    )
+
+
+if __name__ == "__main__":
+    print("alexnet cifar10")
+    top_level_task()

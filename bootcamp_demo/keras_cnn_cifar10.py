@@ -1,0 +1,68 @@
+"""Bootcamp demo, step 3: the same CIFAR-10 CNN through the Keras-compatible
+frontend (reference: bootcamp_demo/keras_cnn_cifar10.py).
+
+Run: python bootcamp_demo/keras_cnn_cifar10.py
+"""
+from flexflow.keras.models import Sequential
+from flexflow.keras.layers import (
+    Activation,
+    Conv2D,
+    Dense,
+    Dropout,
+    Flatten,
+    MaxPooling2D,
+)
+from flexflow.keras.optimizers import SGD
+from flexflow.keras.datasets import cifar10
+
+
+def top_level_task():
+    import os
+
+    num_classes = 10
+    num_samples = int(os.environ.get("BOOTCAMP_NUM_SAMPLES", 10000))
+
+    (x_train, y_train), _ = cifar10.load_data(num_samples)
+    x_train = x_train[:num_samples]
+    y_train = y_train[:num_samples]
+    if x_train.shape[-1] == 3:  # to the reference's (N, 3, 32, 32) layout
+        x_train = x_train.transpose(0, 3, 1, 2)
+    x_train = x_train.astype("float32") / 255
+    y_train = y_train.astype("int32")
+    print("shape: ", x_train.shape[1:])
+
+    model = Sequential()
+    model.add(
+        Conv2D(filters=32, input_shape=(3, 32, 32), kernel_size=(3, 3),
+               strides=(1, 1), padding="valid", activation="relu")
+    )
+    model.add(Conv2D(filters=32, kernel_size=(3, 3), strides=(1, 1),
+                     padding="valid", activation="relu"))
+    model.add(MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid"))
+    model.add(Conv2D(filters=64, kernel_size=(3, 3), strides=(1, 1),
+                     padding="valid", activation="relu"))
+    model.add(Conv2D(filters=64, kernel_size=(3, 3), strides=(1, 1),
+                     padding="valid"))
+    model.add(Activation("relu"))
+    model.add(MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid"))
+    model.add(Flatten())
+    model.add(Dense(512))
+    model.add(Activation("relu"))
+    model.add(Dropout(0.5))
+    model.add(Dense(num_classes))
+    model.add(Activation("softmax"))
+
+    opt = SGD(learning_rate=0.01)
+    model.compile(
+        optimizer=opt,
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy", "sparse_categorical_crossentropy"],
+    )
+    print(model.summary())
+
+    model.fit(x_train, y_train, batch_size=64, epochs=4)
+
+
+if __name__ == "__main__":
+    print("Sequential API, cifar10 cnn")
+    top_level_task()

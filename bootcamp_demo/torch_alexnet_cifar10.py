@@ -1,0 +1,51 @@
+"""Bootcamp demo, step 1: define AlexNet in plain PyTorch and export it to
+a .ff file for FlexFlow-TPU to replay (reference:
+bootcamp_demo/torch_alexnet_cifar10.py, which exports via
+flexflow.torch.fx.torch_to_flexflow).
+
+Run: python bootcamp_demo/torch_alexnet_cifar10.py  →  writes alexnet.ff
+"""
+import torch.nn as nn
+
+import flexflow.torch.fx as fx
+
+
+class AlexNet(nn.Module):
+    """torchvision-style AlexNet (same stack the reference script builds)."""
+
+    def __init__(self, num_classes: int = 10):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2d(3, 64, kernel_size=11, stride=4, padding=2),
+            nn.ReLU(inplace=True),
+            nn.MaxPool2d(kernel_size=3, stride=2),
+            nn.Conv2d(64, 192, kernel_size=5, padding=2),
+            nn.ReLU(inplace=True),
+            nn.MaxPool2d(kernel_size=3, stride=2),
+            nn.Conv2d(192, 384, kernel_size=3, padding=1),
+            nn.ReLU(inplace=True),
+            nn.Conv2d(384, 256, kernel_size=3, padding=1),
+            nn.ReLU(inplace=True),
+            nn.Conv2d(256, 256, kernel_size=3, padding=1),
+            nn.ReLU(inplace=True),
+            nn.MaxPool2d(kernel_size=3, stride=2),
+        )
+        self.classifier = nn.Sequential(
+            nn.Linear(256 * 6 * 6, 4096),
+            nn.ReLU(inplace=True),
+            nn.Linear(4096, 4096),
+            nn.ReLU(inplace=True),
+            nn.Linear(4096, num_classes),
+            nn.Softmax(dim=-1),
+        )
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.flatten(1)
+        return self.classifier(x)
+
+
+if __name__ == "__main__":
+    model = AlexNet(num_classes=10)
+    fx.torch_to_flexflow(model, "alexnet.ff")
+    print("exported alexnet.ff")

@@ -1,0 +1,9 @@
+"""Shim: `flexflow.torch.fx` — the module name bootcamp_demo and the
+torch.nn shim import (`import flexflow.torch.fx as fx;
+fx.torch_to_flexflow(model, path)`). The reference repo never shipped this
+file (python/flexflow/torch/ has only model.py), leaving those entry points
+broken there; here it simply fronts the working exporter."""
+from flexflow_tpu.frontends.torch.model import (  # noqa: F401
+    PyTorchModel,
+    torch_to_flexflow,
+)

@@ -189,6 +189,21 @@ class FFConfig:
             return self.search_num_nodes * self.search_num_workers
         return self.numNodes * self.workersPerNode
 
+    # getter-method spellings used by older reference scripts
+    # (bootcamp_demo/ff_alexnet_cifar10.py calls ffconfig.get_batch_size()
+    # etc., predating the cffi property API at flexflow_cffi.py:536-549)
+    def get_batch_size(self) -> int:
+        return self.batch_size
+
+    def get_epochs(self) -> int:
+        return self.epochs
+
+    def get_workers_per_node(self) -> int:
+        return self.workers_per_node
+
+    def get_num_nodes(self) -> int:
+        return self.num_nodes
+
     def get_current_time(self) -> float:
         import time
 

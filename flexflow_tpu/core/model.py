@@ -610,6 +610,22 @@ class FFModel:
 
     optimizer_setter = set_optimizer  # cffi property-style parity
 
+    # pre-`set_optimizer` spellings (flexflow_c.cc
+    # flexflow_model_set_sgd_optimizer / _set_adam_optimizer, used by
+    # bootcamp_demo scripts)
+    set_sgd_optimizer = set_optimizer
+    set_adam_optimizer = set_optimizer
+
+    def get_label_tensor(self):
+        """Label tensor getter-method spelling (cffi exposes it as the
+        `label_tensor` property, flexflow_cffi.py:2185). The label tensor is
+        created by compile() — calling this earlier is an error, same as in
+        the reference."""
+        assert self.label_tensor is not None, (
+            "label tensor exists after compile() — call compile() first"
+        )
+        return self.label_tensor
+
     def get_learning_rate(self) -> float:
         """Current learning rate, whatever the optimizer calls it
         (SGDOptimizer.lr, AdamOptimizer.alpha — optimizer.h:36-117)."""

@@ -231,7 +231,11 @@ class PyTorchModel:
 
     def __init__(self, module, is_hf_model: bool = False, input_names=None,
                  batch_size: int = 1, seq_length=None):
-        assert HAS_TORCH, "torch is not available"
+        # A path string means a `torch_to_flexflow` export to replay
+        # (bootcamp_demo/ff_alexnet_cifar10.py: PyTorchModel("alexnet.ff"));
+        # replay needs no live torch module, so torch is optional there.
+        self._file = module if isinstance(module, str) else None
+        assert self._file is not None or HAS_TORCH, "torch is not available"
         self.module = module
         self.is_hf_model = is_hf_model
         self.input_names = input_names
@@ -256,9 +260,20 @@ class PyTorchModel:
                                             input_names=self.input_names)
         return torch.fx.symbolic_trace(self.module)
 
+    def apply(self, ffmodel, input_tensors: List) -> List:
+        """Uniform entry point matching ONNXModel.apply (onnx/model.py:287):
+        replays a .ff file when constructed from a path, traces live
+        otherwise."""
+        if self._file is not None:
+            return PyTorchModel.file_to_ff(self._file, ffmodel, input_tensors)
+        return self.torch_to_ff(ffmodel, input_tensors)
+
     # ------------------------------------------------------------------
     def torch_to_ff(self, ffmodel, input_tensors: List) -> List:
         """Map the traced graph onto ffmodel; returns output tensors."""
+        assert self._file is None, (
+            "constructed from a file — use apply()/file_to_ff()"
+        )
         traced = self._trace()
         modules = dict(traced.named_modules())
         env: Dict[str, object] = {}

@@ -151,16 +151,17 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
     use_ring = (
         seq_degree > 1
         and use_streaming
+        and impl in ("auto", "ring")  # explicit flash/chunked stays manual
         and kv_len == seq_len
         and seq_len % seq_degree == 0
         and b % data_degree == 0
         and h % model_degree == 0
     )
-    if impl == "ring" and not use_ring:
+    if impl == "ring" and not use_ring and not use_dropout:
         warnings.warn(
             "FF_ATTENTION_IMPL=ring ignored: needs a seq-sharded mesh "
-            "(sequence_parallel_degree > 1), no dropout, self-attention "
-            "with batch/heads/seq divisible by their mesh degrees"
+            "(sequence_parallel_degree > 1), self-attention with "
+            "batch/heads/seq divisible by their mesh degrees"
         )
     if use_ring:
         import functools

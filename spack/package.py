@@ -1,0 +1,39 @@
+"""Spack package recipe for flexflow-tpu (reference: spack/package.py,
+which builds the CUDA/Legion stack via CMakePackage).
+
+The TPU build is a pure-Python package plus an optional C++ native runtime
+(dataloader + task-graph simulator, built by setup.py), so the recipe is a
+PythonPackage: no CUDA/cuDNN/NCCL/GASNet variants — JAX's TPU runtime owns
+the device and collectives.
+"""
+from spack.package import *
+
+
+class FlexflowTpu(PythonPackage):
+    """TPU-native deep-learning framework that accelerates distributed DNN
+    training by automatically searching for efficient parallelization
+    strategies, with drop-in Keras / PyTorch-FX / ONNX frontends. Rebuild of
+    FlexFlow (flexflow.ai) for TPU: XLA SPMD + Pallas kernels instead of
+    CUDA/Legion."""
+
+    homepage = "https://flexflow.ai"
+    git = "https://github.com/flexflow/flexflow-tpu.git"
+
+    maintainers = ["flexflow-tpu"]
+    version("main", branch="main")
+
+    depends_on("python@3.10:", type=("build", "run"))
+    depends_on("py-setuptools", type="build")
+    depends_on("py-jax@0.4.30:", type=("build", "run"))
+    depends_on("py-flax", type=("build", "run"))
+    depends_on("py-optax", type=("build", "run"))
+    depends_on("py-numpy", type=("build", "run"))
+
+    variant("native", default=True,
+            description="Build the C++ native runtime (prefetching "
+                        "dataloader, task-graph simulator)")
+    variant("torch", default=False,
+            description="Enable the PyTorch-FX frontend")
+
+    depends_on("cxx", type="build", when="+native")
+    depends_on("py-torch", type=("build", "run"), when="+torch")

@@ -306,3 +306,34 @@ def test_stepwise_backward_matches_fit_with_regularizer():
     k1 = np.asarray(m1.state.params["op_linear_0"]["kernel"])
     k2 = np.asarray(m2.state.params["op_linear_0"]["kernel"])
     np.testing.assert_allclose(k1, k2, rtol=1e-6, atol=1e-7)
+
+
+def test_bootcamp_demo_scripts(tmp_path):
+    """bootcamp_demo/ (BASELINE.md AlexNet/CIFAR-10 config): torch export →
+    .ff replay via PyTorchModel("alexnet.ff").apply, plus the Keras CNN —
+    the reference's getter-method API spellings (ffconfig.get_batch_size(),
+    ffmodel.set_sgd_optimizer, get_label_tensor) included."""
+    import os
+
+    pytest.importorskip("torch")
+    pytest.importorskip("PIL")
+
+    root = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    demo = os.path.join(root, "bootcamp_demo")
+    env = dict(os.environ, PYTHONPATH=root + os.pathsep +
+               os.environ.get("PYTHONPATH", ""),
+               BOOTCAMP_NUM_SAMPLES="96")
+    for script, args in [
+        ("torch_alexnet_cifar10.py", []),
+        ("ff_alexnet_cifar10.py", ["-e", "1", "-b", "32"]),
+        ("keras_cnn_cifar10.py", []),
+    ]:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(demo, script), *args],
+            cwd=tmp_path, env=dict(env, PYTHONPATH=demo + os.pathsep +
+                                   env["PYTHONPATH"]),
+            capture_output=True, text=True, timeout=560,
+        )
+        assert proc.returncode == 0, (
+            f"{script} failed:\n{proc.stdout}\n{proc.stderr}"
+        )
