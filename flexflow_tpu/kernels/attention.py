@@ -325,9 +325,51 @@ def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def local_attention(q, k, v, *, causal: bool = False,
+                    interpret: bool = False):
+    """The single device-local streaming dispatch: fused Pallas kernel on
+    TPU while its VMEM tile fits, chunked scan otherwise. Both the MHA
+    op's streaming branch (ops/attention.py) and ulysses_attention route
+    through here so the selection policy cannot drift between them."""
+    if (HAS_PALLAS and not interpret and jax.default_backend() == "tpu"
+            and flash_supported(q.shape[1], k.shape[1])):
+        return flash_attention(q, k, v, causal)
+    return chunked_attention(q, k, v, causal=causal)
+
+
 # ---------------------------------------------------------------------------
 # Ring attention (sequence/context parallelism over a mesh axis)
 # ---------------------------------------------------------------------------
+
+def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                      interpret: bool = False):
+    """DeepSpeed-Ulysses-style sequence parallelism: q/k/v arrive sharded
+    along the sequence dim over `axis_name` (LOCAL shards, inside
+    shard_map). One all_to_all re-shards sequence->heads so each device
+    holds the FULL sequence for num_heads/n heads, local fused attention
+    runs, and a second all_to_all restores the seq sharding. Two
+    all_to_alls over ICI instead of ring's n-1 ppermutes — wins when
+    heads divide the axis and the full-seq score tile still fits.
+
+    No reference equivalent (SURVEY §5: sequence parallelism absent
+    there); the head-scatter recipe follows the public Ulysses pattern
+    (PAPERS.md)."""
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    assert h % n == 0, f"heads {h} must divide the {axis_name} axis {n}"
+    # (b, s/n, h, d) -> (b, s, h/n, d)
+    def scatter_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def gather_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    out = local_attention(qh, kh, vh, causal=causal, interpret=interpret)
+    return gather_heads(out)
+
 
 def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
                    chunk_size: int = 256):

@@ -88,7 +88,11 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
     )
     if cdt is not None:
         wq, wk, wv, wo = (w.astype(cdt) for w in (wq, wk, wv, wo))
-    # (b, s, e) @ (e, h, d) -> (b, s, h, d)
+    # (b, s, e) @ (e, h, d) -> (b, s, h, d). Three separate gemms: packing
+    # q/k/v into one gemm against a concatenated weight (cuDNN-MHA style)
+    # was tried and wins ~4.5% in isolation but loses ~6% inside the full
+    # jitted train step (the per-step concat + slices cost XLA more in
+    # layout/fusion than the bigger gemm saves).
     q = jnp.einsum("bse,ehd->bshd", q_in, wq, preferred_element_type=jnp.float32)
     k = jnp.einsum("bse,ehd->bshd", k_in, wk, preferred_element_type=jnp.float32)
     v = jnp.einsum("bse,ehd->bshd", v_in, wv, preferred_element_type=jnp.float32)
@@ -120,15 +124,16 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
             * ctx.mesh.shape.get("seq", 1)
         )
     score_bytes = 4 * b * h * seq_len * kv_len // max(1, shard)
-    # FF_ATTENTION_IMPL ∈ {auto, dense, flash, chunked, ring} overrides the
-    # size-based dispatch (like picking a cuDNN MHA algo by hand).
+    # FF_ATTENTION_IMPL ∈ {auto, dense, flash, chunked, ring, ulysses}
+    # overrides the size-based dispatch (like picking a cuDNN MHA algo by
+    # hand).
     impl = os.environ.get("FF_ATTENTION_IMPL", "auto")
-    if impl not in ("auto", "dense", "flash", "chunked", "ring"):
+    if impl not in ("auto", "dense", "flash", "chunked", "ring", "ulysses"):
         raise ValueError(
             f"FF_ATTENTION_IMPL={impl!r}: "
-            "expected auto|dense|flash|chunked|ring"
+            "expected auto|dense|flash|chunked|ring|ulysses"
         )
-    if impl in ("flash", "chunked", "ring") and use_dropout:
+    if impl in ("flash", "chunked", "ring", "ulysses") and use_dropout:
         warnings.warn(
             f"FF_ATTENTION_IMPL={impl} ignored: attention dropout needs the "
             "dense path (streaming kernels don't thread the dropout rng)"
@@ -141,7 +146,7 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
         and flash_supported(seq_len, kv_len)
     )
     use_streaming = (
-        impl in ("flash", "chunked", "ring")
+        impl in ("flash", "chunked", "ring", "ulysses")
         or (impl == "auto"
             and (prefer_flash or score_bytes > 256 * 1024 * 1024))
     ) and not use_dropout
@@ -158,34 +163,52 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
         seq_degree = ctx.mesh.shape.get("seq", 1)
         data_degree = ctx.mesh.shape.get("data", 1)
         model_degree = ctx.mesh.shape.get("model", 1)
-    use_ring = (
+    sp_shardable = (
         seq_degree > 1
         and use_streaming
-        and impl in ("auto", "ring")  # explicit flash/chunked stays manual
         and kv_len == seq_len
         and seq_len % seq_degree == 0
         and b % data_degree == 0
         and h % model_degree == 0
     )
-    if impl == "ring" and not use_ring and not use_dropout:
+    # Ulysses (all_to_all head scatter) additionally needs the local head
+    # count to divide the seq axis; ring has no such constraint, so auto
+    # keeps ring as the SP default and ulysses is opt-in.
+    use_ulysses = (
+        sp_shardable
+        and impl == "ulysses"
+        and (h // max(1, model_degree)) % seq_degree == 0
+    )
+    use_ring = sp_shardable and impl in ("auto", "ring")
+    if impl in ("ring", "ulysses") and not (use_ring or use_ulysses) \
+            and not use_dropout:
         warnings.warn(
-            "FF_ATTENTION_IMPL=ring ignored: needs a seq-sharded mesh "
+            f"FF_ATTENTION_IMPL={impl} ignored: needs a seq-sharded mesh "
             "(sequence_parallel_degree > 1), self-attention with "
             "batch/heads/seq divisible by their mesh degrees"
+            + (" and heads divisible by the seq axis" if impl == "ulysses"
+               else "")
         )
-    if use_ring:
+    if use_ring or use_ulysses:
         import functools
 
         from jax.sharding import PartitionSpec as P
 
-        from ..kernels.attention import ring_attention
+        from ..kernels.attention import ring_attention, ulysses_attention
         from ..parallel.pipeline import shard_map
 
+        if use_ulysses:
+            fn = functools.partial(
+                ulysses_attention, axis_name="seq", causal=params.causal,
+                interpret=jax.default_backend() != "tpu",
+            )
+        else:
+            fn = functools.partial(
+                ring_attention, axis_name="seq", causal=params.causal
+            )
         spec = P("data", "seq", "model", None)
         attn = shard_map(
-            functools.partial(
-                ring_attention, axis_name="seq", causal=params.causal
-            ),
+            fn,
             mesh=ctx.mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
@@ -194,23 +217,18 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
         # Long sequences: O(seq) memory kernels instead of the s×s score
         # tensor — Pallas flash attention on TPU, chunked scan elsewhere
         # (kernels/attention.py; replaces cuDNN MHA's internal algorithm).
-        from ..kernels.attention import (
-            chunked_attention,
-            flash_attention,
-            flash_supported,
-        )
+        from ..kernels.attention import chunked_attention, local_attention
 
-        if (impl != "chunked" and jax.default_backend() == "tpu"
-                and flash_supported(seq_len, kv_len)):
-            attn = flash_attention(q, k, v, params.causal)
-        else:
-            if impl == "flash" and not flash_supported(seq_len, kv_len):
-                warnings.warn(
-                    "FF_ATTENTION_IMPL=flash ignored: "
-                    f"{seq_len}x{kv_len} scores exceed the fused kernel's "
-                    "VMEM tile — using chunked attention"
-                )
+        if impl == "flash" and not flash_supported(seq_len, kv_len):
+            warnings.warn(
+                "FF_ATTENTION_IMPL=flash ignored: "
+                f"{seq_len}x{kv_len} scores exceed the fused kernel's "
+                "VMEM tile — using chunked attention"
+            )
+        if impl == "chunked":
             attn = chunked_attention(q, k, v, causal=params.causal)
+        else:
+            attn = local_attention(q, k, v, causal=params.causal)
     else:
         scale = 1.0 / jnp.sqrt(jnp.asarray(params.head_dim, jnp.float32))
         scores = jnp.einsum(
