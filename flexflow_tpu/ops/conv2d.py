@@ -81,6 +81,10 @@ def _forward(params: Conv2DParams, weights, inputs, ctx):
     if cdt is not None:
         x = x.astype(cdt)
         kernel = kernel.astype(cdt)
+    # No preferred_element_type under bf16: jax's conv transpose rule feeds
+    # the f32 cotangent back into a conv against the bf16 operands and
+    # crashes on the dtype mix; a bf16-in/bf16-out conv still accumulates
+    # f32 inside the MXU, which is the precision that matters.
     y = lax.conv_general_dilated(
         x,
         kernel,
@@ -88,7 +92,7 @@ def _forward(params: Conv2DParams, weights, inputs, ctx):
         padding=[(params.padding_h, params.padding_h), (params.padding_w, params.padding_w)],
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=params.groups,
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None,
     ).astype(x.dtype)
     if params.use_bias:
         y = y + weights["bias"].astype(y.dtype)[None, :, None, None]

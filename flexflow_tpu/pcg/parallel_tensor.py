@@ -151,8 +151,13 @@ class ParallelTensor:
     def get_shape(self) -> ParallelTensorShape:
         return ParallelTensorShape([d.copy() for d in self.dims], self.data_type)
 
+    def shape_key(self):
+        """get_shape().key() without the defensive dim copies — the search
+        builds cost-cache keys from this millions of times."""
+        return (tuple(d.key() for d in self.dims), self.data_type)
+
     def material_shape(self) -> Tuple[int, ...]:
-        return self.get_shape().material_shape()
+        return tuple(d.size for d in self.dims if not d.is_replica_dim)
 
     def get_volume(self) -> int:
         v = 1

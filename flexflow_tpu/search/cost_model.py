@@ -112,14 +112,19 @@ class CostModel:
         self.machine = machine
         self.bf16 = bf16
         self._cache: Dict[Tuple, CostMetrics] = {}
+        self._xfer_cache: Dict[Tuple, float] = {}
         # measured-mode overrides: key -> (fwd, bwd) seconds
         self.measured: Dict[Tuple, Tuple[float, float]] = {}
 
     def _key(self, op: PCGOp, view: MachineView):
+        # weights are part of the key: their sharding degrees decide the
+        # gradient-sync term (a channel-split table syncs nothing; a
+        # replicated one allreduces the full table)
         return (
             op.op_type,
             op.params,
-            tuple(t.get_shape().key() for t in op.inputs),
+            tuple(t.shape_key() for t in op.inputs),
+            tuple(w.shape_key() for w in op.weights),
             view.hash(),
         )
 
@@ -138,14 +143,26 @@ class CostModel:
             # for the rest (reference measures both; ratio matches its
             # observed GEMM fwd:bwd split)
             bwd = 2.0 * fwd if op.weights else fwd
-        # weight gradient sync over the view's devices (reference: NCCL
-        # allreduce per weight per view, optimizer.cc nccl_update_task)
+        # weight gradient sync (reference: NCCL allreduce per weight per
+        # view, optimizer.cc nccl_update_task). Per weight: a sharded
+        # weight only syncs across its REPLICAS — each device owns
+        # bytes/degree, and with `degree` shards over `parts` devices the
+        # replica group for one shard is every degree-th device (strided,
+        # so a group can span nodes and pay DCN). Fully sharded weights
+        # (parameter parallelism, e.g. DLRM embedding tables) sync nothing;
+        # replicated weights coexisting with sharded ones (a row-parallel
+        # Linear's bias) still pay their own full allreduce.
+        sync = 0.0
         wbytes = op_weight_bytes(op)
-        sync = (
-            self.machine.allreduce_cost(wbytes, view.device_ids())
-            if wbytes and parts > 1
-            else 0.0
-        )
+        if wbytes and parts > 1:
+            ids = view.device_ids()
+            for w in op.weights:
+                w_bytes = _vol(w.material_shape()) * w.data_type.size
+                w_deg = max(1, w.get_total_degree())
+                replicas = max(1, parts // w_deg)
+                if replicas > 1:
+                    group = ids[::w_deg][:replicas]
+                    sync += self.machine.allreduce_cost(w_bytes / w_deg, group)
         cm = CostMetrics(
             forward_time=fwd,
             backward_time=bwd,
@@ -177,6 +194,10 @@ class CostModel:
         if src_view.hash() == dst_view.hash():
             return 0.0
         total = _vol(tensor.material_shape()) * tensor.data_type.size
+        key = (total, src_view.hash(), dst_view.hash())
+        cached = self._xfer_cache.get(key)
+        if cached is not None:
+            return cached
         src_ids, dst_ids = src_view.device_ids(), dst_view.device_ids()
         # per-destination bytes: each dst shard gathers its slice
         per_dst = total / max(1, len(dst_ids))
@@ -184,6 +205,7 @@ class CostModel:
         for i, d in enumerate(dst_ids):
             s = src_ids[i % len(src_ids)]
             worst = max(worst, self.machine.xfer_cost(per_dst, s, d))
+        self._xfer_cache[key] = worst
         return worst
 
     def parallel_op_cost(self, op: PCGOp) -> float:

@@ -56,6 +56,7 @@ class SearchHelper:
         self.max_views_per_op = max_views_per_op
         self._memo: Dict[Tuple, GraphCostResult] = {}
         self._view_cache: Dict[Tuple, List[MachineView]] = {}
+        self._node_cost_cache: Dict[Tuple, float] = {}
 
     # -- machine view enumeration (reference: register_all_machine_views +
     #    Op::get_valid_machine_views) -----------------------------------
@@ -83,6 +84,20 @@ class SearchHelper:
     def node_cost(
         self, op: PCGOp, view: MachineView, bounds: Dict[int, MachineView]
     ) -> float:
+        # memoized on (op, view, producer views): the DP revisits the same
+        # combination across thousands of split states
+        key = (
+            op.guid,
+            view.hash(),
+            tuple(
+                (t.guid, b.hash()) if (b := bounds.get(t.guid)) is not None
+                else t.guid
+                for t in op.inputs
+            ),
+        )
+        cached = self._node_cost_cache.get(key)
+        if cached is not None:
+            return cached
         cm = self.cost_model.measure_operator_cost(op, view)
         total = cm.total_time
         if op.is_parallel_op:
@@ -90,6 +105,7 @@ class SearchHelper:
         for t in op.inputs:
             src = bounds.get(t.guid)
             total += self.cost_model.estimate_xfer_cost(t, src, view)
+        self._node_cost_cache[key] = total
         return total
 
     # -- DP ---------------------------------------------------------------
@@ -113,6 +129,19 @@ class SearchHelper:
         res: MachineResource,
         graph: Graph,
     ) -> GraphCostResult:
+        # Canonicalize to what THIS sub-problem can observe: bounds entries
+        # for tensors none of `ops` consume (and fixed entries for ops not
+        # in `ops`) accumulate as sequence splits recurse, and a stale
+        # upstream view in the key makes every upstream view combination a
+        # distinct memo state — exponential in chain depth instead of
+        # O(n · views²) (reference memoizes by subgraph hash alone,
+        # graph.cc dp_state_hash, for the same reason).
+        consumed = {t.guid for o in ops for t in o.inputs}
+        if any(g not in consumed for g in bounds):
+            bounds = {g: v for g, v in bounds.items() if g in consumed}
+        own = {o.guid for o in ops}
+        if any(g not in own for g in fixed):
+            fixed = {g: v for g, v in fixed.items() if g in own}
         key = self._memo_key(ops, bounds, fixed, res)
         if key in self._memo:
             return self._memo[key]

@@ -137,41 +137,64 @@ def _find_ops(graph: Graph, op_type: OperatorType) -> List[PCGOp]:
     return [o for o in graph.ops if o.op_type == op_type]
 
 
-def partition_linear_combine(degree: int) -> Substitution:
-    """Column-parallel Linear (reference:
-    substitution.cc create_partition_linear_combine). Shard kernel
-    out-channel by `degree`; output channel dim partitioned; Combine
-    restores a full tensor for consumers."""
+def _partition_channel_combine(name: str, op_type, degree: int,
+                               channel_axis: int) -> Substitution:
+    """Shared shard-out-channel-plus-Combine pattern: shard the
+    "out_channel"-tagged weight dims by `degree`, partition the output's
+    channel dim, and insert a Combine so consumers see a full tensor.
+    Instantiated for Linear / Conv2D / Embedding (their only differences
+    are the op type and which output dim is the channel)."""
 
     def apply(graph: Graph) -> Iterator[Graph]:
-        for idx, op in enumerate(_find_ops(graph, OperatorType.OP_LINEAR)):
-            if not op.outputs or op.outputs[0].dims[-1].degree > 1:
+        for op in _find_ops(graph, op_type):
+            if not op.outputs:
                 continue
-            if op.params.out_channels % degree != 0:
+            out_dim = op.outputs[0].dims[channel_axis]
+            if out_dim.degree > 1 or out_dim.size % degree != 0:
                 continue
-            g2, tmap = copy_graph(graph)
+            g2, _ = copy_graph(graph)
             op2 = next(o for o in g2.ops if o.layer_guid == op.layer_guid
                        and o.name == op.name)
             out = op2.outputs[0]
-            # shard weight out dim + output channel dim
+            axis = channel_axis % len(out.dims)
             for w, tags in zip(op2.weights, op2.weight_tags):
                 for i, tag in enumerate(tags):
                     if tag == "out_channel" and w.dims[i].size % degree == 0:
                         w.dims[i].degree = degree
-            out.dims[-1].degree = degree
-            # Combine back to replicated-full for downstream consumers
+            out.dims[axis].degree = degree
             comb_dims = [dataclasses.replace(d) for d in out.dims]
-            comb_dims[-1].degree = 1
+            comb_dims[axis].degree = 1
             comb = _make_parallel_op(
                 OperatorType.OP_COMBINE,
-                CombineParams(combine_dim=len(out.dims) - 1, combine_degree=degree),
+                CombineParams(combine_dim=axis, combine_degree=degree),
                 out,
                 comb_dims,
             )
             _insert_after(g2, out, comb)
             yield g2
 
-    return Substitution(f"partition_linear_combine_{degree}", apply)
+    return Substitution(f"{name}_{degree}", apply)
+
+
+def partition_linear_combine(degree: int) -> Substitution:
+    """Column-parallel Linear (reference:
+    substitution.cc create_partition_linear_combine)."""
+    return _partition_channel_combine(
+        "partition_linear_combine", OperatorType.OP_LINEAR, degree, -1
+    )
+
+
+def partition_embedding_combine(degree: int) -> Substitution:
+    """Parameter parallelism for Embedding (reference: embedding.cc:132-200
+    — the table shards over vocab or channel; DLRM's strategy files place
+    each table's shards on distinct GPUs). Channel split: every device
+    holds all rows × channels/degree, the lookup emits a
+    channel-partitioned activation, Combine restores it — the table's
+    gradient then syncs over `degree`-fold fewer bytes per device than
+    pure DP's full-table allreduce."""
+    return _partition_channel_combine(
+        "partition_embedding_combine", OperatorType.OP_EMBEDDING, degree, -1
+    )
 
 
 def reduce_linear_partition(degree: int) -> Substitution:
@@ -255,33 +278,9 @@ def partition_attention_combine(degree: int) -> Substitution:
 
 def partition_conv2d_combine(degree: int) -> Substitution:
     """Conv out-channel partition (reference: conv mapping xfers)."""
-
-    def apply(graph: Graph) -> Iterator[Graph]:
-        for op in _find_ops(graph, OperatorType.OP_CONV2D):
-            out = op.outputs[0]
-            if out.dims[1].degree > 1 or out.dims[1].size % degree != 0:
-                continue
-            g2, _ = copy_graph(graph)
-            op2 = next(o for o in g2.ops if o.layer_guid == op.layer_guid
-                       and o.name == op.name)
-            out2 = op2.outputs[0]
-            for w, tags in zip(op2.weights, op2.weight_tags):
-                for i, tag in enumerate(tags):
-                    if tag == "out_channel" and w.dims[i].size % degree == 0:
-                        w.dims[i].degree = degree
-            out2.dims[1].degree = degree
-            comb_dims = [dataclasses.replace(d) for d in out2.dims]
-            comb_dims[1].degree = 1
-            comb = _make_parallel_op(
-                OperatorType.OP_COMBINE,
-                CombineParams(combine_dim=1, combine_degree=degree),
-                out2,
-                comb_dims,
-            )
-            _insert_after(g2, out2, comb)
-            yield g2
-
-    return Substitution(f"partition_conv2d_combine_{degree}", apply)
+    return _partition_channel_combine(
+        "partition_conv2d_combine", OperatorType.OP_CONV2D, degree, 1
+    )
 
 
 def partition_batch(degree: int) -> Substitution:
@@ -360,6 +359,7 @@ def generate_all_pcg_xfers(degrees: List[int], config=None) -> List[Substitution
         xfers.append(reduce_linear_partition(d))
         xfers.append(partition_attention_combine(d))
         xfers.append(partition_conv2d_combine(d))
+        xfers.append(partition_embedding_combine(d))
         if config is None or getattr(config, "enable_sequence_parallel", False):
             xfers.append(partition_seq_allgather(d))
     return xfers

@@ -168,3 +168,31 @@ def test_fusion_pass_trains():
     out1 = model.predict(xs[:16], batch_size=16)
     out2 = m2.predict(xs[:16], batch_size=16)
     np.testing.assert_allclose(out1, out2, atol=1e-5)
+
+
+def test_conv_trains_under_mixed_precision():
+    """Regression: conv_general_dilated with bf16 operands and a f32
+    preferred_element_type breaks jax's conv transpose (the f32 cotangent
+    meets the bf16 operands: 'requires arguments to have the same
+    dtypes'). Conv models must train with allow_mixed_precision on."""
+    from flexflow_tpu import (
+        DataType, FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
+    )
+
+    cfg = FFConfig()
+    cfg.batch_size = 4
+    cfg.allow_mixed_precision = True
+    m = FFModel(cfg)
+    x = m.create_tensor((4, 3, 16, 16), DataType.DT_FLOAT)
+    t = m.conv2d(x, 8, 3, 3, 1, 1, 1, 1)
+    t = m.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = m.flat(t)
+    t = m.dense(t, 10)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    rng = np.random.RandomState(0)
+    xs = rng.rand(8, 3, 16, 16).astype(np.float32)
+    ys = rng.randint(0, 10, (8, 1)).astype(np.int32)
+    pm = m.fit(xs, ys, batch_size=4, epochs=1, verbose=False)
+    assert pm.train_all == 8

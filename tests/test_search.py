@@ -9,7 +9,7 @@ import itertools
 import numpy as np
 import pytest
 
-from flexflow_tpu import ActiMode, DataType, FFConfig, FFModel
+from flexflow_tpu import ActiMode, AggrMode, DataType, FFConfig, FFModel
 from flexflow_tpu.ff_types import OperatorType
 from flexflow_tpu.pcg.lowering import layers_to_pcg
 from flexflow_tpu.pcg.machine_view import (
@@ -391,3 +391,104 @@ def test_disconnected_towers_take_nonsequence_split(caplog):
     ra = sh2._cost_of(tuple(ops[:2]), {}, {}, res, g)
     rb = sh2._cost_of(tuple(ops[2:]), {}, {}, res, g)
     assert r.cost <= ra.cost + rb.cost + 1e-12
+
+
+def test_partition_embedding_generates_parameter_parallel_candidate():
+    """partition_embedding_combine shards the table's channel dim and
+    inserts a Combine (reference: embedding.cc:132-200 replica dims —
+    DLRM parameter parallelism)."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.pcg.lowering import layers_to_pcg
+    from flexflow_tpu.search.substitution import partition_embedding_combine
+
+    cfg = FFConfig()
+    m = FFModel(cfg)
+    ids = m.create_tensor((8, 1), DataType.DT_INT32)
+    t = m.embedding(ids, 1000, 64, AggrMode.AGGR_MODE_SUM)
+    m.dense(t, 16)
+    g, _ = layers_to_pcg(m.layers)
+    cands = list(partition_embedding_combine(4).apply(g))
+    assert len(cands) == 1
+    emb = next(o for o in cands[0].ops
+               if o.op_type == OperatorType.OP_EMBEDDING)
+    assert any(d.degree == 4 for w in emb.weights for d in w.dims)
+    assert any(o.op_type == OperatorType.OP_COMBINE for o in cands[0].ops)
+
+
+def test_sharded_weight_sync_cheaper_than_replicated(machine):
+    """Cost-model: a weight sharded across the view's devices must not pay
+    the full-table allreduce that replicated (DP) weights pay — this is
+    what makes parameter parallelism winnable for DLRM."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.pcg.lowering import layers_to_pcg
+
+    cfg = FFConfig()
+    m = FFModel(cfg)
+    ids = m.create_tensor((256, 1), DataType.DT_INT32)
+    t = m.embedding(ids, 100000, 64, AggrMode.AGGR_MODE_SUM)
+    m.dense(t, 16)
+    g, _ = layers_to_pcg(m.layers)
+    emb = next(o for o in g.ops if o.op_type == OperatorType.OP_EMBEDDING)
+    cm = CostModel(machine)
+    view = MachineView(start_device_id=0, dim=(4,), stride=(1,))
+    dp = cm.measure_operator_cost(emb, view)
+    # shard the table over the channel dim (degree 4 == view parts)
+    for w in emb.weights:
+        w.dims[-1].degree = 4
+    sharded = cm.measure_operator_cost(emb, view)
+    assert dp.sync_time > 0
+    assert sharded.sync_time == 0
+    assert sharded.total_time < dp.total_time
+
+
+def test_unity_beats_dp_on_dlrm(machine):
+    """The searched strategy must beat pure DP on DLRM (the north-star
+     'Unity-search speedup vs DP'): parameter-parallel embedding tables
+    avoid the full-table gradient allreduce."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.dlrm import build_dlrm
+    from flexflow_tpu.pcg.lowering import layers_to_pcg
+    from flexflow_tpu.search.substitution import partition_batch
+
+    cfg = FFConfig()
+    m = FFModel(cfg)
+    build_dlrm(m, 2048)
+    g, _ = layers_to_pcg(m.layers)
+    cm = CostModel(machine)
+    sh = SearchHelper(cm)
+    res = MachineResource(num_nodes=1, all_procs_per_node=4,
+                          available_procs_per_node=4)
+    dp_best = GraphSearchHelper(
+        sh, [partition_batch(d) for d in (2, 4)], budget=3
+    ).graph_optimize(g, res)[1].cost
+    g2, _ = layers_to_pcg(m.layers)
+    unity_best = GraphSearchHelper(
+        SearchHelper(CostModel(machine)), generate_all_pcg_xfers([2, 4]),
+        budget=20,
+    ).graph_optimize(g2, res)[1].cost
+    assert unity_best < dp_best
+
+
+def test_searched_dlrm_trains_on_mesh():
+    """compile(search) on DLRM must EXECUTE the searched strategy (sharded
+    embedding tables) on the virtual mesh, not just cost it."""
+    from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                              SGDOptimizer)
+    from flexflow_tpu.models.dlrm import build_dlrm
+
+    cfg = FFConfig()
+    cfg.batch_size = 64
+    cfg.search_budget = 10
+    m = FFModel(cfg)
+    build_dlrm(m, 64, embedding_sizes=(1000,) * 2, mlp_bot=(16, 32),
+               mlp_top=(32, 2))
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    rng = np.random.RandomState(0)
+    n = 128
+    xs = [rng.randint(0, 1000, (n, 1)).astype(np.int32) for _ in range(2)]
+    xs.append(rng.rand(n, 16).astype(np.float32))
+    ys = rng.randint(0, 2, (n, 1)).astype(np.int32)
+    pm = m.fit(xs, ys, batch_size=64, epochs=1, verbose=False)
+    assert pm.train_all == n
