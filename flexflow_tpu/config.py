@@ -72,6 +72,10 @@ class FFConfig:
     python_data_loader_type: int = 2
     perform_fusion: bool = False
     profiling: bool = False
+    # Unity search costs ops by on-device microbenchmarks instead of the
+    # analytic roofline (reference: the Simulator always measures,
+    # simulator.cc:489; here it's opt-in because it pays real compiles)
+    measure_operator_costs: bool = False
     export_strategy_file: str = ""
     import_strategy_file: str = ""
     export_strategy_computation_graph_file: str = ""
@@ -138,6 +142,8 @@ class FFConfig:
                     self.perform_fusion = True
                 elif a == "--profiling":
                     self.profiling = True
+                elif a == "--measured-search":
+                    self.measure_operator_costs = True
                 elif a == "--search-num-nodes":
                     self.search_num_nodes = int(take()); i += 1
                 elif a == "--search-num-workers":

@@ -790,6 +790,16 @@ class FFModel:
             )
             machine = MachineModel(num_nodes=nodes, workers_per_node=workers)
         cost_model = CostModel(machine, bf16=cfg.allow_mixed_precision)
+        if cfg.measure_operator_costs:
+            # --measured-search: per-op on-device timing feeds the search
+            from ..search.measure import attach_measured_mode
+
+            attach_measured_mode(
+                cost_model,
+                compute_dtype=(
+                    jnp.bfloat16 if cfg.allow_mixed_precision else None
+                ),
+            )
         sh = SearchHelper(cost_model)
         degrees = []
         d = 2

@@ -100,10 +100,18 @@ def pspec_for_parallel_tensor(pt, mesh: Mesh) -> PartitionSpec:
     (replication is PartitionSpec's default for unmentioned axes)."""
     names = mesh.axis_names
     spec = []
+    used = set()
     for d in pt.dims:
         if d.is_replica_dim:
             continue
-        if d.degree > 1 and 0 <= d.parallel_idx < len(names):
+        if d.degree > 1 and 0 <= d.parallel_idx < len(names) \
+                and names[d.parallel_idx] not in used:
+            # a mesh axis may appear at most once per spec: when the search
+            # composes two shards that both land on the same axis (e.g.
+            # row- AND column-parallel on one Linear), the first dim keeps
+            # the axis and later dims stay replicated — a valid (weaker)
+            # lowering of the strategy
+            used.add(names[d.parallel_idx])
             spec.append(names[d.parallel_idx])
         else:
             spec.append(None)

@@ -115,6 +115,9 @@ class CostModel:
         self._xfer_cache: Dict[Tuple, float] = {}
         # measured-mode overrides: key -> (fwd, bwd) seconds
         self.measured: Dict[Tuple, Tuple[float, float]] = {}
+        # optional on-device microbenchmark oracle (search/measure.py,
+        # reference: Simulator::measure_operator_cost's real timing path)
+        self.measure_fn = None
 
     def _key(self, op: PCGOp, view: MachineView):
         # weights are part of the key: their sharding degrees decide the
@@ -135,6 +138,10 @@ class CostModel:
         parts = max(1, view.num_parts())
         flops = op_flops(op) / parts
         membytes = op_bytes(op) / parts
+        if key not in self.measured and self.measure_fn is not None:
+            m_fwd, m_bwd = self.measure_fn(op, view)
+            if m_fwd == m_fwd:  # not NaN -> measurable on device
+                self.measured[key] = (m_fwd, m_bwd)
         if key in self.measured:
             fwd, bwd = self.measured[key]
         else:

@@ -1,0 +1,214 @@
+"""On-device operator microbenchmarks for the measured-mode cost model.
+
+The reference's Simulator measures every operator's fwd/bwd on the GPU and
+caches by (op-params, machine-view) hash (simulator.cc:489-537,
+Op::measure_operator_cost per op, inner_measure_operator_cost
+operator.h:127 — cudaEvent timing with warmup + repeats). This module is
+the TPU equivalent: jit the op's forward (and its VJP) at the view's
+per-shard shapes, run R repetitions inside ONE lax.scan dispatch (the
+remote-TPU tunnel makes per-call host timing meaningless), and feed the
+(fwd, bwd) seconds into CostModel.measured so the Unity search steers by
+real silicon instead of the analytic roofline.
+
+Enable with FFConfig.measure_operator_costs (argv: --measured-search).
+"""
+from __future__ import annotations
+
+import time
+import warnings
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ..ff_types import DataType
+from ..ops.registry import FwdCtx, get_op_def
+
+
+def _local_shape(pt) -> Tuple[int, ...]:
+    """The per-shard material shape under the tensor's sharding degrees."""
+    return tuple(
+        d.size // max(1, d.degree)
+        for d in pt.dims
+        if not d.is_replica_dim
+    )
+
+
+def _dummy(shape, data_type: DataType, rng: np.random.RandomState):
+    import jax.numpy as jnp
+
+    dt = data_type.jnp_dtype
+    if data_type in (DataType.DT_INT32, DataType.DT_INT64):
+        return jnp.asarray(rng.randint(0, 2, shape), dt)
+    return jnp.asarray(rng.rand(*shape).astype(np.float32), dt)
+
+
+def _perturb_first_float(ws: Dict, ins: list, c):
+    """Make one float operand depend on the scan carry so XLA's
+    loop-invariant code motion cannot hoist the measured op out of the
+    repetition loop (the perturbation is ~1e-30, numerically inert)."""
+    import jax.numpy as jnp
+
+    for i, a in enumerate(ins):
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            ins = list(ins)
+            ins[i] = a + (c * 1e-30).astype(a.dtype)
+            return ws, ins
+    for k in ws:
+        if jnp.issubdtype(ws[k].dtype, jnp.floating):
+            ws = dict(ws)
+            ws[k] = ws[k] + (c * 1e-30).astype(ws[k].dtype)
+            return ws, ins
+    return ws, ins
+
+
+class OperatorMeasurer:
+    """Times op fwd/bwd on the current default jax device.
+
+    Cached by (op_type, params, local input/weight shapes) — the view
+    enters only through the shard shapes, like the reference's strict
+    hash (simulator.cc strict_hash_to_operator_cost)."""
+
+    def __init__(self, *, repeats: int = 50, warmup: int = 1,
+                 compute_dtype=None):
+        self.repeats = repeats
+        self.warmup = warmup
+        self.compute_dtype = compute_dtype
+        self._cache: Dict[Tuple, Tuple[float, float]] = {}
+        self._warned: set = set()
+
+    def __call__(self, op, view) -> Tuple[float, float]:
+        parts = max(1, view.num_parts())
+        shard_shapes = tuple(_local_shape(t) for t in op.inputs)
+        w_shapes = tuple(_local_shape(w) for w in op.weights)
+        key = (op.op_type, op.params, shard_shapes, w_shapes, parts)
+        if key in self._cache:
+            return self._cache[key]
+        try:
+            fb = self._measure(op, shard_shapes, w_shapes)
+        except Exception as e:
+            # un-runnable standalone (e.g. params that disagree with local
+            # weight shards): analytic fallback — but say so ONCE per op
+            # type, or measured mode silently degrades to the roofline
+            if op.op_type not in self._warned:
+                self._warned.add(op.op_type)
+                warnings.warn(
+                    f"measured-search: {op.op_type.name} fell back to the "
+                    f"analytic cost model ({type(e).__name__}: {e})"
+                )
+            fb = None
+        if fb is None:
+            fb = (float("nan"), float("nan"))
+        self._cache[key] = fb
+        return fb
+
+    def _measure(self, op, shard_shapes, w_shapes):
+        import jax
+        import jax.numpy as jnp
+
+        if op.is_parallel_op or not op.inputs:
+            return None
+        opdef = get_op_def(op.op_type)
+        rng = np.random.RandomState(0)
+        inputs = [
+            _dummy(s, t.data_type, rng)
+            for s, t in zip(shard_shapes, op.inputs)
+        ]
+        # weight names from the WeightSpecs (so dict lookups in the
+        # forward resolve), shapes from the op's ParallelTensors at their
+        # PER-SHARD sizes — a channel-split kernel must be timed at
+        # out_channels/degree, not full size
+        specs = opdef.weights(
+            op.params,
+            [tuple(s) for s in shard_shapes],
+            [t.data_type for t in op.inputs],
+        ) if opdef.weights else []
+        weights = {
+            spec.name: _dummy(ws, w.data_type, rng)
+            for spec, ws, w in zip(specs, w_shapes, op.weights)
+        }
+        ctx = FwdCtx(training=False, rng=None, seq_length=-1,
+                     compute_dtype=self.compute_dtype, aux_losses=None,
+                     n_devices=1, mesh=None)
+        R = self.repeats
+
+        def fwd_once(ws, ins):
+            outs = opdef.forward(op.params, ws, ins, ctx)
+            return sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+
+        diffable = [i for i, a in enumerate(inputs)
+                    if jnp.issubdtype(a.dtype, jnp.floating)]
+
+        def fwd_body(c, _):
+            ws2, ins2 = _perturb_first_float(weights, inputs, c)
+            return c + fwd_once(ws2, ins2) * 1e-9, ()
+
+        def bwd_body(c, _):
+            def loss(ws_, dins):
+                full = list(inputs)
+                for i, v in zip(diffable, dins):
+                    full[i] = v
+                return fwd_once(ws_, full)
+
+            ws2, ins2 = _perturb_first_float(weights, inputs, c)
+            g = jax.grad(loss, argnums=(0, 1))(
+                ws2, [ins2[i] for i in diffable]
+            )
+            leaves = jax.tree_util.tree_leaves(g)
+            return c + sum(
+                jnp.sum(l.astype(jnp.float32)) for l in leaves
+            ) * 1e-9, ()
+
+        def per_rep_seconds(body):
+            """Time scans of R and 4R reps and difference them: the fixed
+            dispatch + device->host fetch (milliseconds through the
+            remote-TPU tunnel) cancels, leaving pure per-repetition op
+            time (the reference's cudaEvent bracket equivalent). R grows
+            until the differenced signal clears the tunnel's jitter, and
+            each point is a min-of-3."""
+            def run(length):
+                fn = jax.jit(lambda: jax.lax.scan(
+                    body, jnp.float32(0.0), None, length=length)[0])
+                for _ in range(self.warmup):
+                    float(fn())
+                best = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    float(fn())
+                    best = min(best, time.perf_counter() - t0)
+                return best
+
+            reps = R
+            while True:
+                t1 = run(reps)
+                t4 = run(4 * reps)
+                signal = t4 - t1
+                if signal > 20e-3 or reps >= 4096:
+                    return max(signal / (3 * reps), 1e-9)
+                reps *= 4
+
+        fwd_t = per_rep_seconds(fwd_body)
+        try:
+            total_t = per_rep_seconds(bwd_body)  # grad includes a forward
+            bwd_t = max(total_t - fwd_t, 0.1 * fwd_t)
+        except Exception:
+            bwd_t = 2.0 * fwd_t
+        return fwd_t, bwd_t
+
+
+def attach_measured_mode(cost_model, *, repeats: int = 50,
+                         compute_dtype=None) -> None:
+    """Wire an OperatorMeasurer into a CostModel: every cost-cache miss
+    first tries real silicon; NaN (unmeasurable) falls back to the
+    analytic roofline."""
+    import jax
+
+    backend = jax.default_backend()
+    if backend != "tpu":
+        warnings.warn(
+            f"measured-search is timing ops on the '{backend}' backend; "
+            "mixing those times with the machine model's TPU link costs "
+            "skews the search — use for testing only"
+        )
+    cost_model.measure_fn = OperatorMeasurer(
+        repeats=repeats, compute_dtype=compute_dtype
+    )

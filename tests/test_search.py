@@ -492,3 +492,61 @@ def test_searched_dlrm_trains_on_mesh():
     ys = rng.randint(0, 2, (n, 1)).astype(np.int32)
     pm = m.fit(xs, ys, batch_size=64, epochs=1, verbose=False)
     assert pm.train_all == n
+
+
+def test_measured_mode_feeds_search():
+    """--measured-search: the cost model microbenchmarks ops on the
+    device (search/measure.py, reference Simulator::measure_operator_cost)
+    and the measured times flow into strategy costs. (No fwd-time ordering
+    assert: at unit-test sizes on CPU, dispatch overhead swamps the
+    compute delta — the discriminating power is for real-chip shapes.)"""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.pcg.lowering import layers_to_pcg
+    from flexflow_tpu.pcg.machine_view import MachineView
+    from flexflow_tpu.search.cost_model import CostModel as CM
+    from flexflow_tpu.search.measure import OperatorMeasurer, attach_measured_mode
+
+    cfg = FFConfig()
+    m = FFModel(cfg)
+    x = m.create_tensor((32, 64), DataType.DT_FLOAT)
+    t = m.dense(x, 64)
+    m.dense(t, 2048)
+    g, _ = layers_to_pcg(m.layers)
+    small, big = [o for o in g.topo_order()
+                  if o.op_type == OperatorType.OP_LINEAR]
+    meas = OperatorMeasurer(repeats=5)
+    view = MachineView(start_device_id=0, dim=(1,), stride=(1,))
+    fs, bs = meas(small, view)
+    fb, bb = meas(big, view)
+    assert fs > 0 and bs > 0 and fb > 0 and bb > 0
+    # cache hit returns identical values
+    assert meas(small, view) == (fs, bs)
+    # wired into a CostModel, the measured time IS the strategy cost input
+    cm = CM(MachineModel(num_nodes=1, workers_per_node=4))
+    attach_measured_mode(cm, repeats=5)
+    got = cm.measure_operator_cost(small, view)
+    assert got.forward_time == pytest.approx(
+        cm.measure_fn(small, view)[0]
+    )
+
+
+def test_measured_search_compile_trains():
+    from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                              SGDOptimizer)
+
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    cfg.search_budget = 4
+    cfg.measure_operator_costs = True
+    m = FFModel(cfg)
+    x = m.create_tensor((32, 64), DataType.DT_FLOAT)
+    t = m.dense(x, 128, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, 10)
+    m.compile(SGDOptimizer(lr=0.05),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    assert m.searched_cost > 0
+    rng = np.random.RandomState(0)
+    m.fit(rng.rand(64, 64).astype(np.float32),
+          rng.randint(0, 10, (64, 1)).astype(np.int32),
+          batch_size=32, epochs=1, verbose=False)
