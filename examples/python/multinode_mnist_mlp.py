@@ -1,0 +1,54 @@
+"""Multi-host data-parallel MNIST MLP (reference: MULTI-NODE.md +
+tests/multinode_helpers — per-rank MPI wrappers around the same script).
+
+Each process calls init_distributed, then builds the SAME model; the mesh
+spans every host's devices and XLA handles the cross-host gradient
+collectives (the reference's NCCL allreduce path). Launch via
+scripts/multinode_run.sh or by hand:
+
+    FF_COORDINATOR_ADDRESS=localhost:39211 FF_NUM_PROCESSES=2 \
+        FF_PROCESS_ID=0 python examples/python/multinode_mnist_mlp.py &
+    FF_COORDINATOR_ADDRESS=localhost:39211 FF_NUM_PROCESSES=2 \
+        FF_PROCESS_ID=1 python examples/python/multinode_mnist_mlp.py
+"""
+import numpy as np
+
+from flexflow_tpu import (
+    ActiMode,
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.runtime.distributed import init_distributed
+
+
+def main():
+    pid, nprocs, devices = init_distributed()
+    print(f"[proc {pid}/{nprocs}] global devices: {len(devices)}", flush=True)
+
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    model = FFModel(cfg)
+    x = model.create_tensor((32, 784), DataType.DT_FLOAT)
+    t = model.dense(x, 256, ActiMode.AC_MODE_RELU)
+    t = model.dense(t, 10)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY,
+                 MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    rng = np.random.RandomState(0)  # same data on every host (DP demo)
+    xs = rng.rand(256, 784).astype(np.float32)
+    ys = rng.randint(0, 10, (256, 1)).astype(np.int32)
+    pm = model.fit(xs, ys, batch_size=32, epochs=2, verbose=pid == 0)
+    if pid == 0:
+        print(f"[proc 0] trained {pm.train_all} samples across "
+              f"{nprocs} processes ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -99,6 +99,13 @@ class FFConfig:
                 self.workersPerNode = max(1, jax.local_device_count())
             except Exception:  # pragma: no cover - no backend at all
                 self.workersPerNode = 1
+        if self.numNodes == 1:
+            try:
+                # multi-host (runtime/distributed.py): one "node" per
+                # process, like the reference's one-Legion-rank-per-host
+                self.numNodes = max(1, jax.process_count())
+            except Exception:  # pragma: no cover
+                pass
         argv = sys.argv[1:]
         if argv:
             self.parse_args(argv)

@@ -67,6 +67,19 @@ from .optimizers import AdamOptimizer, Optimizer, SGDOptimizer
 from .tensor import Layer, Tensor
 
 
+def _fetch_global(v) -> np.ndarray:
+    """Device value -> host numpy, multi-host safe: an array whose shards
+    live on other processes can't be fetched directly (jax refuses), so
+    allgather it first (runtime/distributed.py multi-host path — every
+    process gets the full value, like the reference's CPU
+    UPDATE_METRICS_TASK folding a future chain)."""
+    if isinstance(v, jax.Array) and not v.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        v = multihost_utils.process_allgather(v, tiled=True)
+    return np.asarray(v)
+
+
 class FFModel:
     """reference: model.h:326 FFModel / flexflow_cffi.py:883."""
 
@@ -921,7 +934,9 @@ class FFModel:
                     )
                     for i, pt in enumerate(in_pts)
                 ]
-                bys = jnp.asarray(np.stack([b[-1] for b in chunk]), label_dt)
+                bys = self.executor.put_replicated(
+                    np.stack([b[-1] for b in chunk]).astype(label_dt)
+                )
                 # one key per step, split exactly like the stepwise path so
                 # dropout masks are identical whatever the dispatch grouping
                 subs = []
@@ -929,7 +944,8 @@ class FFModel:
                     self._rng, sub = jax.random.split(self._rng)
                     subs.append(sub)
                 self.state, partials = scan_fn(
-                    self.state, bxs, bys, jnp.stack(subs)
+                    self.state, bxs, bys,
+                    self.executor.put_replicated(jnp.stack(subs)),
                 )
                 device_partials.append(partials)
 
@@ -944,18 +960,24 @@ class FFModel:
                         self.executor.shard_batch(pt, np.asarray(a, pt.data_type.np_dtype))
                         for pt, a in zip(in_pts, batch[:-1])
                     ]
-                    by = jnp.asarray(batch[-1], label_dt)
+                    by = self.executor.put_replicated(
+                        np.asarray(batch[-1]).astype(label_dt)
+                    )
                     self._rng, sub = jax.random.split(self._rng)
-                    self.state, partials = step_fn(self.state, bx, by, sub)
+                    self.state, partials = step_fn(
+                        self.state, bx, by, self.executor.put_replicated(sub)
+                    )
                     device_partials.append(partials)
                 num_samples += bs
             if chunk:  # tail chunk shorter than spd (own compiled shape)
                 flush(chunk)
             folded = jax.tree_util.tree_map(
-                lambda *vs: sum(float(np.sum(np.asarray(v))) for v in vs),
+                lambda *vs: sum(float(np.sum(_fetch_global(v))) for v in vs),
                 *device_partials,
             )
-            last_loss = float(np.asarray(device_partials[-1]["loss"]).ravel()[-1])
+            last_loss = float(
+                _fetch_global(device_partials[-1]["loss"]).ravel()[-1]
+            )
             folded.pop("loss", None)
             self.perf_metrics.update(folded)
             if verbose:

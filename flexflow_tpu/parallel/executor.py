@@ -110,7 +110,10 @@ class PCGExecutor:
     def init_params(self) -> Dict[str, Dict[str, jax.Array]]:
         key = jax.random.PRNGKey(self.seed)
         params: Dict[str, Dict[str, jax.Array]] = {}
-        with jax.default_device(jax.devices()[0]):
+        # local_devices: under multi-host, devices()[0] belongs to process
+        # 0 — every other rank would compute init on a non-addressable
+        # device. Same seed everywhere => identical draws on each host.
+        with jax.default_device(jax.local_devices()[0]):
             for op in self.topo:
                 if not op.weights:
                     continue
@@ -120,6 +123,12 @@ class PCGExecutor:
                     init = get_initializer(op.initializers.get(name, "glorot_uniform"))
                     arr = init(sub, wpt.material_shape(), wpt.data_type.jnp_dtype)
                     sharding = sharding_for_parallel_tensor(wpt, self.mesh)
+                    # via host numpy: under multi-host every process draws
+                    # the SAME init (same seed) and contributes its local
+                    # shards — a device-committed array cannot be reshard
+                    # onto a mesh spanning other processes
+                    if jax.process_count() > 1:
+                        arr = np.asarray(arr)
                     wd[name] = jax.device_put(arr, sharding)
                 params[op.name] = wd
         return params
@@ -271,6 +280,15 @@ class PCGExecutor:
             )
             partials = self.metrics.compute(logits, labels)
             partials["loss"] = loss
+            if self.mesh is not None:
+                # pin metric partials replicated over the FULL mesh: under
+                # multi-host, XLA may otherwise place these tiny outputs on
+                # one process's devices, making them unfetchable elsewhere
+                rep = NamedSharding(self.mesh, PartitionSpec())
+                partials = {
+                    k: jax.lax.with_sharding_constraint(v, rep)
+                    for k, v in partials.items()
+                }
             return (
                 TrainState(params=new_params, opt_state=new_opt, step=state.step + 1),
                 partials,
@@ -379,3 +397,13 @@ class PCGExecutor:
         return jax.device_put(
             array, NamedSharding(self.mesh, PartitionSpec(None, *spec))
         )
+
+    def put_replicated(self, array) -> jax.Array:
+        """Place host data replicated over the FULL mesh. Required under
+        multi-host (runtime/distributed.py): a plain jnp.asarray commits to
+        one local device, and jit cannot reshard a single-device-committed
+        array onto a mesh spanning other processes — labels and rng keys
+        must enter as global arrays."""
+        if self.mesh is None:
+            return jnp.asarray(array)
+        return jax.device_put(array, NamedSharding(self.mesh, PartitionSpec()))

@@ -1,0 +1,89 @@
+"""Multi-host bootstrap — the reference's multi-node story rebuilt for jax.
+
+The reference scales across nodes with Legion/GASNet + per-MachineView NCCL
+communicators, launched under MPI with per-rank env wrappers
+(MULTI-NODE.md, tests/multinode_helpers/mpi_wrapper1.sh; model.cc:3129
+NCCL communicator bootstrap). The TPU-native equivalent is
+`jax.distributed.initialize`: one process per host joins a coordinator,
+after which `jax.devices()` spans every host and XLA compiles collectives
+over ICI within a slice and DCN across hosts — the same programs this
+framework already emits just see a bigger mesh.
+
+Env contract (mirrors the reference's rank-env wrappers; also what
+scripts/multinode_run.sh exports):
+    FF_COORDINATOR_ADDRESS  host:port of process 0 (default from TPU/SLURM
+                            auto-detect when unset)
+    FF_NUM_PROCESSES        total processes (hosts)
+    FF_PROCESS_ID           this process's rank
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> tuple:
+    """Join (or start) the multi-host runtime. Call before creating any
+    FFModel/mesh. Returns (process_id, num_processes, global_devices).
+
+    On TPU pods all three args auto-detect (jax reads the TPU metadata);
+    on CPU/GPU clusters pass them or export FF_* (SLURM/OpenMPI envs also
+    auto-detect inside jax). Idempotent."""
+    import jax
+
+    global _initialized
+    if _initialized:
+        return (jax.process_index(), jax.process_count(), jax.devices())
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "FF_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and "FF_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["FF_NUM_PROCESSES"])
+    if process_id is None and "FF_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["FF_PROCESS_ID"])
+
+    kw = {}
+    if coordinator_address is not None:
+        kw["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kw["num_processes"] = num_processes
+    if process_id is not None:
+        kw["process_id"] = process_id
+    if local_device_ids is not None:
+        kw["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(**kw)
+    _initialized = True
+    return (jax.process_index(), jax.process_count(), jax.devices())
+
+
+def shutdown() -> None:
+    import jax
+
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()

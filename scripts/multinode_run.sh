@@ -1,0 +1,43 @@
+#!/bin/bash
+# reference: tests/multinode_helpers/mpi_wrapper1.sh/2.sh — per-rank env
+# wrappers that re-invoke the test suite under MPI. Here: launch N
+# processes of a flexflow_tpu script joined through jax.distributed (the
+# coordinator replaces mpirun's rank bootstrap). On a real pod each HOST
+# runs one process and FF_COORDINATOR_ADDRESS points at host 0; this
+# script demonstrates the same contract with local processes.
+#
+# usage: scripts/multinode_run.sh [-n NPROCS] [-p PORT] script.py [args...]
+set -euo pipefail
+cd "$(dirname "${BASH_SOURCE[0]}")/.."
+
+NPROCS=2
+PORT=39211
+while getopts "n:p:" opt; do
+  case $opt in
+    n) NPROCS=$OPTARG ;;
+    p) PORT=$OPTARG ;;
+    *) exit 2 ;;
+  esac
+done
+shift $((OPTIND - 1))
+SCRIPT=${1:?usage: multinode_run.sh [-n N] [-p PORT] script.py [args...]}
+shift
+
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+export FF_COORDINATOR_ADDRESS="localhost:$PORT"
+export FF_NUM_PROCESSES=$NPROCS
+
+pids=""
+cleanup() {
+  # a failed rank must not orphan the others (they would block forever in
+  # a collective, pinning the coordinator port)
+  for p in $pids; do kill "$p" 2>/dev/null || true; done
+}
+trap cleanup EXIT
+for ((rank = NPROCS - 1; rank >= 1; rank--)); do
+  FF_PROCESS_ID=$rank python "$SCRIPT" "$@" &
+  pids="$pids $!"
+done
+FF_PROCESS_ID=0 python "$SCRIPT" "$@"
+for p in $pids; do wait "$p"; done
+pids=""
