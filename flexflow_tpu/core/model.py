@@ -316,6 +316,7 @@ class FFModel:
         add_bias_kv: bool = False,
         add_zero_attn: bool = False,
         kernel_initializer=None,
+        causal: bool = False,
         name: str = "",
     ) -> Tensor:
         p = MultiHeadAttentionParams(
@@ -327,6 +328,7 @@ class FFModel:
             bias=bias,
             add_bias_kv=add_bias_kv,
             add_zero_attn=add_zero_attn,
+            causal=causal,
         )
         inits = (
             {k: kernel_initializer for k in ("wq", "wk", "wv", "wo")}

@@ -255,6 +255,71 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
     return [out]
 
 
+def _forward_decode(params, weights, inputs, ctx, cache, t):
+    """Incremental decode step with a KV cache (serving path,
+    executor.build_decode). Inputs are the NEW position's slices
+    (b, 1, e); cache holds (k, v) of shape (b, max_len, h, d) with
+    positions < t valid. Appends this position's K/V and attends the new
+    query against the prefix — O(1) work per token instead of the full
+    O(L²) forward the reference's serving prototype would re-run (it has
+    no KV cache; triton/README.md calls it an incomplete prototype).
+
+    Requires self-attention (q_in is k_in is v_in upstream) — the decode
+    builder rejects cross-attention graphs."""
+    q_in, k_in, v_in = inputs
+    cdt = ctx.compute_dtype
+    if cdt is not None:
+        q_in, k_in, v_in = (x.astype(cdt) for x in (q_in, k_in, v_in))
+    wq, wk, wv, wo = (
+        weights["wq"], weights["wk"], weights["wv"], weights["wo"],
+    )
+    if cdt is not None:
+        wq, wk, wv, wo = (w.astype(cdt) for w in (wq, wk, wv, wo))
+    q = jnp.einsum("bse,ehd->bshd", q_in, wq,
+                   preferred_element_type=jnp.float32).astype(q_in.dtype)
+    k_new = jnp.einsum("bse,ehd->bshd", k_in, wk,
+                       preferred_element_type=jnp.float32).astype(q_in.dtype)
+    v_new = jnp.einsum("bse,ehd->bshd", v_in, wv,
+                       preferred_element_type=jnp.float32).astype(q_in.dtype)
+    k_cache, v_cache = cache
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k_new.astype(k_cache.dtype), (0, t, 0, 0)
+    )
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v_new.astype(v_cache.dtype), (0, t, 0, 0)
+    )
+    scale = 1.0 / jnp.sqrt(jnp.asarray(params.qk_head_dim, jnp.float32))
+    scores = jnp.einsum(
+        "bshd,bthd->bhst", q, k_cache.astype(q.dtype),
+        preferred_element_type=jnp.float32,
+    ) * scale                          # (b, h, 1, max_len)
+    pos = jnp.arange(k_cache.shape[1])
+    scores = jnp.where(
+        (pos <= t)[None, None, None, :], scores, jnp.finfo(jnp.float32).min
+    )
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    attn = jnp.einsum(
+        "bhst,bthd->bshd", probs, v_cache.astype(q.dtype),
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+    out = jnp.einsum("bshd,hde->bse", attn, wo,
+                     preferred_element_type=jnp.float32)
+    out = out.astype(q_in.dtype)  # post-cast dtype, same as _forward
+    if params.bias:
+        out = out + weights["bias_o"].astype(out.dtype)
+    return [out], (k_cache, v_cache)
+
+
+def init_decode_cache(params: MultiHeadAttentionParams, batch: int,
+                      max_len: int, dtype):
+    """Fresh (k, v) cache for one MHA op."""
+    h, dqk, dv = params.num_heads, params.qk_head_dim, params.v_head_dim
+    return (
+        jnp.zeros((batch, max_len, h, dqk), dtype),
+        jnp.zeros((batch, max_len, h, dv), dtype),
+    )
+
+
 register_op(
     OperatorType.OP_MULTIHEAD_ATTENTION,
     "MultiHeadAttention",
@@ -262,4 +327,5 @@ register_op(
     weights=_weights,
     forward=_forward,
     num_inputs=3,
+    forward_decode=_forward_decode,
 )

@@ -39,4 +39,5 @@ def _forward(params: DropoutParams, weights, inputs, ctx):
     return [jnp.where(mask, x / keep, 0).astype(x.dtype)]
 
 
-register_op(OperatorType.OP_DROPOUT, "Dropout", infer=_infer, forward=_forward)
+register_op(OperatorType.OP_DROPOUT, "Dropout", infer=_infer, forward=_forward,
+            seq_pointwise=True)

@@ -81,7 +81,8 @@ for _t in list(_UNARY_FNS) + [
     OperatorType.OP_SCALAR_TRUE_DIV,
     OperatorType.OP_SCALAR_FLOOR_DIV,
 ]:
-    register_op(_t, f"ElementUnary_{_t.name}", infer=_unary_infer, forward=_unary_forward)
+    register_op(_t, f"ElementUnary_{_t.name}", infer=_unary_infer,
+                forward=_unary_forward, seq_pointwise=True)
 
 # ---------------------------------------------------------------------------
 # Binary (reference: element_binary.cc with broadcast support)
@@ -131,7 +132,7 @@ def _binary_forward(params: ElementBinaryParams, weights, inputs, ctx):
 for _t in _BINARY_FNS:
     register_op(
         _t, f"ElementBinary_{_t.name}", infer=_binary_infer, forward=_binary_forward,
-        num_inputs=2,
+        num_inputs=2, seq_pointwise=True,
     )
 
 

@@ -68,4 +68,7 @@ register_op(
     infer=_infer,
     weights=_weights,
     forward=_forward,
+    # bag aggregation (SUM/AVG) reduces over the ids axis — feeding one
+    # position at a time would change its semantics; plain lookup is safe
+    seq_pointwise=lambda p, op: p.aggr == AggrMode.AGGR_MODE_NONE,
 )

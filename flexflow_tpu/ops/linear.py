@@ -88,4 +88,5 @@ register_op(
     weights=_weights,
     forward=_forward,
     num_inputs=1,
+    seq_pointwise=True,
 )

@@ -105,10 +105,18 @@ def _ln_forward(params: LayerNormParams, weights, inputs, ctx):
     return [y.astype(x.dtype)]
 
 
+def _ln_seq_pointwise(params, op):
+    """Safe on a single decoded token only while the normalized axes
+    exclude the sequence axis (axis 1 of a rank>=3 tensor)."""
+    nd = len(op.inputs[0].material_shape())
+    return nd < 3 or all(a % nd != 1 for a in params.axes)
+
+
 register_op(
     OperatorType.OP_LAYERNORM,
     "LayerNorm",
     infer=_ln_infer,
     weights=_ln_weights,
     forward=_ln_forward,
+    seq_pointwise=_ln_seq_pointwise,
 )

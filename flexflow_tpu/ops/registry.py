@@ -48,6 +48,21 @@ class OpDef:
     forward: Callable
     # Number of inputs the op consumes (-1 = variadic)
     num_inputs: int = 1
+    # Incremental-decode support (executor.build_decode / serving KV cache):
+    # seq_pointwise declares the forward treats the sequence dim as a
+    # batch dim (dense/elementwise/...), so running it on the newest
+    # token's slice is exact. Either a bool, or a callable
+    # (params, op) -> bool for ops whose params decide it (softmax over
+    # the seq axis is NOT pointwise; over features it is). Ops that MIX
+    # positions instead provide forward_decode(params, weights, inputs,
+    # ctx, cache, t) -> (outs, cache') — attention appends K/V there.
+    seq_pointwise: object = False
+    forward_decode: Optional[Callable] = None
+
+    def is_seq_pointwise(self, params, op) -> bool:
+        if callable(self.seq_pointwise):
+            return bool(self.seq_pointwise(params, op))
+        return bool(self.seq_pointwise)
 
 
 _REGISTRY: Dict[OperatorType, OpDef] = {}
@@ -61,6 +76,8 @@ def register_op(
     forward: Callable,
     weights: Optional[Callable] = None,
     num_inputs: int = 1,
+    seq_pointwise: object = False,
+    forward_decode: Optional[Callable] = None,
 ) -> OpDef:
     d = OpDef(
         op_type=op_type,
@@ -69,6 +86,8 @@ def register_op(
         weights=weights or (lambda p, s, dt: []),
         forward=forward,
         num_inputs=num_inputs,
+        seq_pointwise=seq_pointwise,
+        forward_decode=forward_decode,
     )
     _REGISTRY[op_type] = d
     return d

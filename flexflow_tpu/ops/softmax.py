@@ -30,4 +30,12 @@ def _forward(params: SoftmaxParams, weights, inputs, ctx):
     return [jax.nn.softmax(x, axis=params.dim)]
 
 
-register_op(OperatorType.OP_SOFTMAX, "Softmax", infer=_infer, forward=_forward)
+def _softmax_seq_pointwise(params, op):
+    """Per-position only when the softmax axis is NOT the sequence axis
+    (axis 1 of a rank>=3 (batch, seq, ...) tensor)."""
+    nd = len(op.inputs[0].material_shape())
+    return nd < 3 or params.dim % nd != 1
+
+
+register_op(OperatorType.OP_SOFTMAX, "Softmax", infer=_infer, forward=_forward,
+            seq_pointwise=_softmax_seq_pointwise)

@@ -239,6 +239,7 @@ register_op(
     "NoOp",
     infer=lambda p, s, dt: ([s[0]], [dt[0]]),
     forward=lambda p, w, x, ctx: [x[0]],
+    seq_pointwise=True,
 )
 
 

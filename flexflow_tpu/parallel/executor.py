@@ -105,6 +105,7 @@ class PCGExecutor:
         self._grad_step = None
         self._eval_step = None
         self._fwd = None
+        self._decode_builds = {}
 
     # -- parameter init (reference: initializer Legion tasks per weight) ----
     def init_params(self) -> Dict[str, Dict[str, jax.Array]]:
@@ -384,6 +385,102 @@ class PCGExecutor:
 
         self._fwd = jax.jit(fwd)
         return self._fwd
+
+    # -- incremental decode (serving KV cache) ------------------------------
+    def build_decode(self, batch: int, max_len: int, cache_dtype=None):
+        """(init_caches, step) for KV-cache autoregressive decoding.
+
+        step(params, caches, t, token_inputs) runs ONE position through the
+        graph: seq-pointwise ops (OpDef.seq_pointwise) execute on the
+        (batch, 1, ...) slice unchanged; attention appends this position's
+        K/V to its cache and attends against the prefix
+        (ops/attention.py _forward_decode) — O(1) per token where the
+        reference's serving prototype would replay the full forward.
+
+        Build-time validation rejects graphs the scheme can't decode
+        exactly: ops that mix sequence positions without a decode rule,
+        non-causal or cross-attention MHA."""
+        from ..ops.attention import init_decode_cache
+
+        key = (batch, max_len, cache_dtype)
+        cached = self._decode_builds.get(key)
+        if cached is not None:
+            return cached
+
+        for guid, (pt, value) in self.constants.items():
+            if len(pt.material_shape()) >= 2:
+                # a rank>=2 constant (baked positional table / mask) would
+                # broadcast against one-position slices at full length
+                raise NotImplementedError(
+                    f"constant tensor {guid} has shape "
+                    f"{pt.material_shape()}: decode can't prove it doesn't "
+                    "span the sequence axis"
+                )
+        cache_ops = []
+        for op in self.topo:
+            if op.is_parallel_op:
+                continue
+            d = get_op_def(op.op_type)
+            if d.forward_decode is not None:
+                g0 = op.inputs[0].guid
+                if any(t.guid != g0 for t in op.inputs):
+                    raise NotImplementedError(
+                        f"{op.name}: incremental decode needs "
+                        "self-attention (q/k/v from one tensor)"
+                    )
+                if not op.params.causal:
+                    raise NotImplementedError(
+                        f"{op.name}: incremental decode needs causal=True "
+                        "(otherwise each position sees the future and the "
+                        "cached prefix is stale)"
+                    )
+                cache_ops.append(op)
+            elif not d.is_seq_pointwise(op.params, op):
+                raise NotImplementedError(
+                    f"{op.name} ({op.op_type.name}) mixes sequence "
+                    "positions and has no decode rule"
+                )
+
+        cdt = cache_dtype or self.compute_dtype or jnp.float32
+
+        def init_caches():
+            return {
+                op.name: init_decode_cache(op.params, batch, max_len, cdt)
+                for op in cache_ops
+            }
+
+        def step(params, caches, t, batch_inputs):
+            vals = dict(self._input_vals(batch_inputs))
+            for guid, (pt, value) in self.constants.items():
+                vals[guid] = jnp.asarray(value, pt.data_type.jnp_dtype)
+            ctx = FwdCtx(
+                training=False, rng=None, seq_length=-1,
+                compute_dtype=self.compute_dtype, aux_losses=None,
+                n_devices=1, mesh=None,  # decode is device-local
+            )
+            new_caches = dict(caches)
+            for op in self.topo:
+                if op.is_parallel_op:
+                    # decode runs single-device; parallel ops are identity
+                    # over an unsharded value (degree bookkeeping only)
+                    vals[op.outputs[0].guid] = vals[op.inputs[0].guid]
+                    continue
+                d = get_op_def(op.op_type)
+                ins = [vals[t_.guid] for t_ in op.inputs]
+                w = params.get(op.name, {})
+                if d.forward_decode is not None:
+                    outs, new_caches[op.name] = d.forward_decode(
+                        op.params, w, ins, ctx, caches[op.name], t
+                    )
+                else:
+                    outs = d.forward(op.params, w, ins, ctx)
+                for t_, v in zip(op.outputs, outs):
+                    vals[t_.guid] = v
+            return vals[self.logits_pt.guid], new_caches
+
+        built = (init_caches, jax.jit(step))
+        self._decode_builds[key] = built
+        return built
 
     # -- data placement -----------------------------------------------------
     def shard_batch(self, pt, array) -> jax.Array:

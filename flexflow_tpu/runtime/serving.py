@@ -16,6 +16,7 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
+import jax.numpy as jnp
 import numpy as np
 
 
@@ -69,6 +70,55 @@ def greedy_generate(
         if eos_token_id is not None and finished.all():
             break
     return dec[:, : t + 2]
+
+
+def incremental_generate(
+    model,
+    prompt_ids: np.ndarray,
+    *,
+    max_new_tokens: int,
+    max_len: Optional[int] = None,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
+) -> np.ndarray:
+    """KV-cache autoregressive decoding for a causal decoder-only FFModel
+    (token ids in, per-position vocab logits out): each step feeds ONE
+    position through executor.build_decode, appending that position's K/V
+    to per-layer caches — O(1) attention work per token instead of
+    greedy_generate's full-forward-per-token. Capability the reference
+    lacks entirely (its Triton prototype serves single forwards).
+
+    prompt_ids: (batch, prompt_len) int array. Returns (batch, total_len)
+    including the prompt."""
+    assert model.executor is not None, "compile() the model first"
+    prompt_ids = np.asarray(prompt_ids)
+    bs, plen = prompt_ids.shape
+    total = plen + max_new_tokens
+    cap = max_len or total
+    assert cap >= total, f"max_len {cap} < prompt+new {total}"
+    init_caches, step = model.executor.build_decode(bs, cap)
+    caches = init_caches()
+    in_t = model._fit_input_tensors[0]
+    id_dt = in_t.data_type.np_dtype
+
+    out = np.full((bs, total), pad_token_id, id_dt)
+    out[:, :plen] = prompt_ids
+    finished = np.zeros(bs, bool)
+    logits = None
+    for t in range(total - 1):
+        tok = out[:, t : t + 1].astype(id_dt)
+        logits, caches = step(
+            model.state.params, caches, jnp.int32(t), [jnp.asarray(tok)]
+        )
+        if t >= plen - 1:  # prompt positions only prefill the cache
+            nxt = np.asarray(logits)[:, 0].argmax(-1)
+            if eos_token_id is not None:
+                nxt = np.where(finished, pad_token_id, nxt)
+                finished |= nxt == eos_token_id
+            out[:, t + 1] = nxt
+            if eos_token_id is not None and finished.all():
+                return out[:, : t + 2]
+    return out
 
 
 def _log_softmax(x: np.ndarray) -> np.ndarray:

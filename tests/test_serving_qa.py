@@ -285,3 +285,111 @@ def test_beam_generate_properties():
     # mid-decode), but deterministic for these fixed seeds/weights — a
     # regression canary, not a theorem.
     assert (score(b4) >= score(g) - 1e-5).all(), (score(b4), score(g))
+
+
+def test_incremental_decode_matches_full_forward():
+    """KV-cache decoding (executor.build_decode + _forward_decode) must
+    produce the SAME logits as the full causal forward on every prefix —
+    the cache is an optimization, not an approximation."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu import (ActiMode, AggrMode, DataType, FFConfig,
+                              FFModel, LossType, MetricsType, SGDOptimizer)
+    from flexflow_tpu.runtime.serving import incremental_generate
+
+    vocab, seq, hidden, heads = 50, 12, 32, 4
+    bs = 2
+    cfg = FFConfig()
+    cfg.batch_size = bs
+    m = FFModel(cfg)
+    ids = m.create_tensor((bs, seq), DataType.DT_INT32)
+    t = m.embedding(ids, vocab, hidden, AggrMode.AGGR_MODE_NONE)
+    for _ in range(2):
+        t = m.multihead_attention(t, t, t, hidden, heads, causal=True)
+        t = m.layer_norm(t)
+        t = m.dense(t, hidden, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, vocab)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, vocab, (bs, seq)).astype(np.int32)
+
+    # full forward over the whole sequence
+    full = np.asarray(
+        m.executor.build_forward()(m.state.params, [jnp.asarray(toks)])
+    )
+
+    # incremental: feed one position at a time through the cache
+    init_caches, step = m.executor.build_decode(bs, seq)
+    caches = init_caches()
+    for t_ in range(seq):
+        logits, caches = step(
+            m.state.params, caches, jnp.int32(t_),
+            [jnp.asarray(toks[:, t_:t_ + 1])],
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits)[:, 0], full[:, t_], rtol=2e-4, atol=2e-4,
+        )
+
+    # generate API end to end
+    out = incremental_generate(m, toks[:, :4], max_new_tokens=5)
+    assert out.shape == (bs, 9)
+    assert (out[:, :4] == toks[:, :4]).all()
+
+
+def test_build_decode_rejects_noncausal():
+    from flexflow_tpu import (DataType, FFConfig, FFModel, LossType,
+                              MetricsType, SGDOptimizer)
+
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    m = FFModel(cfg)
+    x = m.create_tensor((2, 8, 16), DataType.DT_FLOAT)
+    t = m.multihead_attention(x, x, x, 16, 2)  # causal=False
+    m.dense(t, 4)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+              [MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    with pytest.raises(NotImplementedError):
+        m.executor.build_decode(2, 8)
+
+
+def test_build_decode_rejects_seq_mixing_params():
+    """Param-dependent seq mixing must be rejected: softmax over the
+    sequence axis is not per-position even though softmax usually is."""
+    from flexflow_tpu import (DataType, FFConfig, FFModel, LossType,
+                              MetricsType, SGDOptimizer)
+
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    m = FFModel(cfg)
+    x = m.create_tensor((2, 8, 16), DataType.DT_FLOAT)
+    t = m.multihead_attention(x, x, x, 16, 2, causal=True)
+    t = m.softmax(t, axis=1)  # over SEQ positions
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+              [MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    with pytest.raises(NotImplementedError):
+        m.executor.build_decode(2, 8)
+
+
+def test_build_decode_cached_per_shape():
+    from flexflow_tpu import (AggrMode, DataType, FFConfig, FFModel,
+                              LossType, MetricsType, SGDOptimizer)
+
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    m = FFModel(cfg)
+    ids = m.create_tensor((2, 8), DataType.DT_INT32)
+    t = m.embedding(ids, 16, 8, AggrMode.AGGR_MODE_NONE)
+    t = m.multihead_attention(t, t, t, 8, 2, causal=True)
+    m.dense(t, 16)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    b1 = m.executor.build_decode(2, 8)
+    b2 = m.executor.build_decode(2, 8)
+    assert b1 is b2  # same (batch, max_len) -> no re-jit per request
+    assert m.executor.build_decode(2, 16) is not b1
