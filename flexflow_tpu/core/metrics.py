@@ -48,6 +48,14 @@ class Metrics:
         out: Dict[str, jnp.ndarray] = {}
         b = preds.shape[0]
         out["num_samples"] = jnp.asarray(b, jnp.float32)
+        # metric denominators count prediction ROWS: a per-position output
+        # (b, s, vocab) scores b*s classifications and accuracy divides by
+        # that (reference metrics_functions.cu iterates every logit row of
+        # the region, not one per sample); throughput stays per-sample
+        rows = 1
+        for d in preds.shape[:-1]:
+            rows *= d
+        out["num_rows"] = jnp.asarray(rows, jnp.float32)
         pf = preds.astype(jnp.float32)
         lf = labels.astype(jnp.float32) if labels.dtype != jnp.int32 else labels
         for m in self.measures:
@@ -66,9 +74,12 @@ class Metrics:
                     (pred_cls == true_cls).astype(jnp.float32)
                 )
             elif m == MetricsType.METRICS_CATEGORICAL_CROSSENTROPY:
-                out["cce_loss"] = b * losses.categorical_crossentropy(preds, labels)
+                # rows * mean = exact sum over prediction rows
+                out["cce_loss"] = rows * losses.categorical_crossentropy(
+                    preds, labels
+                )
             elif m == MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY:
-                out["sparse_cce_loss"] = b * losses.sparse_categorical_crossentropy(
+                out["sparse_cce_loss"] = rows * losses.sparse_categorical_crossentropy(
                     preds, labels
                 )
             elif m == MetricsType.METRICS_MEAN_SQUARED_ERROR:
@@ -87,7 +98,9 @@ class PerfMetrics:
     """Accumulator (reference: metrics_functions.h:44-80 PerfMetrics)."""
 
     train_all: int = 0
+    train_rows: int = 0  # prediction rows (== train_all for 2D logits)
     train_correct: int = 0
+    tracks_accuracy: bool = False
     cce_loss: float = 0.0
     sparse_cce_loss: float = 0.0
     mse_loss: float = 0.0
@@ -96,26 +109,35 @@ class PerfMetrics:
     start_time: float = dataclasses.field(default_factory=time.time)
 
     def update(self, partials: Dict[str, float]):
-        self.train_all += int(partials.get("num_samples", 0))
-        self.train_correct += int(partials.get("train_correct", 0))
+        n = int(partials.get("num_samples", 0))
+        self.train_all += n
+        self.train_rows += int(partials.get("num_rows", n))
+        if "train_correct" in partials:
+            self.tracks_accuracy = True
+            self.train_correct += int(partials["train_correct"])
         for k in ("cce_loss", "sparse_cce_loss", "mse_loss", "rmse_loss", "mae_loss"):
             if k in partials:
                 setattr(self, k, getattr(self, k) + float(partials[k]))
 
     def get_accuracy(self) -> float:
-        return 100.0 * self.train_correct / max(1, self.train_all)
+        return 100.0 * self.train_correct / max(1, self.train_rows)
 
     def report(self) -> str:
         """reference: PerfMetrics::print"""
         elapsed = time.time() - self.start_time
         tp = self.train_all / elapsed if elapsed > 0 else 0.0
         parts = [f"throughput: {tp:.2f} samples/s"]
+        rows = max(1, self.train_rows)
         if self.train_all:
-            parts.append(f"accuracy: {self.get_accuracy():.2f}% ({self.train_correct}/{self.train_all})")
+            if self.tracks_accuracy:
+                parts.append(
+                    f"accuracy: {self.get_accuracy():.2f}% "
+                    f"({self.train_correct}/{self.train_rows})"
+                )
             if self.sparse_cce_loss:
-                parts.append(f"sparse_cce: {self.sparse_cce_loss / self.train_all:.4f}")
+                parts.append(f"sparse_cce: {self.sparse_cce_loss / rows:.4f}")
             if self.cce_loss:
-                parts.append(f"cce: {self.cce_loss / self.train_all:.4f}")
+                parts.append(f"cce: {self.cce_loss / rows:.4f}")
             if self.mse_loss:
-                parts.append(f"mse: {self.mse_loss / self.train_all:.4f}")
+                parts.append(f"mse: {self.mse_loss / rows:.4f}")
         return "[Metrics] " + " ".join(parts)

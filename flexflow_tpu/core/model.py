@@ -737,6 +737,23 @@ class FFModel:
 
         # 3. Label tensor matched to final op's sharding (model.cc:3054)
         logits_pt = self.graph.output_tensors()[-1]
+        if self.loss_type in (
+            LossType.LOSS_CATEGORICAL_CROSSENTROPY,
+            LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        ):
+            final_ops = [o for o in self.graph.ops
+                         if any(t.guid == logits_pt.guid for t in o.outputs)]
+            if final_ops and final_ops[0].op_type != OperatorType.OP_SOFTMAX:
+                import warnings
+
+                warnings.warn(
+                    "cross-entropy losses expect SOFTMAX outputs (the "
+                    "reference's loss kernels take probabilities; "
+                    "loss_functions.cc) but the model's final op is "
+                    f"{final_ops[0].op_type.name} — raw logits get clipped "
+                    "to [1e-12, 1] and gradients die. End the model with "
+                    "model.softmax(...)."
+                )
         if self.label_tensor is None:
             label_dt = (
                 DataType.DT_INT32

@@ -260,8 +260,9 @@ def _forward_decode(params, weights, inputs, ctx, cache, t):
     executor.build_decode). Inputs are the NEW position's slices
     (b, 1, e); cache holds (k, v) of shape (b, max_len, h, d) with
     positions < t valid. Appends this position's K/V and attends the new
-    query against the prefix — O(1) work per token instead of the full
-    O(L²) forward the reference's serving prototype would re-run (it has
+    query against the prefix — one cache-width attention row per token
+    instead of the full O(L²) forward the reference's serving prototype
+    would re-run (it has
     no KV cache; triton/README.md calls it an incomplete prototype).
 
     Requires self-attention (q_in is k_in is v_in upstream) — the decode

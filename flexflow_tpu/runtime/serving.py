@@ -84,8 +84,8 @@ def incremental_generate(
     """KV-cache autoregressive decoding for a causal decoder-only FFModel
     (token ids in, per-position vocab logits out): each step feeds ONE
     position through executor.build_decode, appending that position's K/V
-    to per-layer caches — O(1) attention work per token instead of
-    greedy_generate's full-forward-per-token. Capability the reference
+    to per-layer caches — one O(max_len)-wide attention row per token
+    instead of greedy_generate's full O(L²) forward per token. Capability the reference
     lacks entirely (its Triton prototype serves single forwards).
 
     prompt_ids: (batch, prompt_len) int array. Returns (batch, total_len)

@@ -196,3 +196,39 @@ def test_conv_trains_under_mixed_precision():
     ys = rng.randint(0, 10, (8, 1)).astype(np.int32)
     pm = m.fit(xs, ys, batch_size=4, epochs=1, verbose=False)
     assert pm.train_all == 8
+
+
+def test_per_position_metrics_and_report():
+    """Regression: with (b, s, vocab) logits, accuracy must divide correct
+    counts by prediction ROWS (b*s), not batch entries (it reported >100%),
+    and report() must not print an accuracy line unless the accuracy
+    metric was requested."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu import LossType, MetricsType
+    from flexflow_tpu.core.metrics import Metrics, PerfMetrics
+
+    b, s, v = 4, 8, 10
+    rng = np.random.RandomState(0)
+    probs = jnp.asarray(rng.rand(b, s, v).astype(np.float32))
+    labels = jnp.asarray(np.asarray(probs).argmax(-1)[..., None])  # all correct
+
+    m = Metrics(LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                [MetricsType.METRICS_ACCURACY,
+                 MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY])
+    part = {k: float(np.asarray(val)) for k, val in
+            m.compute(probs, labels).items()}
+    assert part["num_rows"] == b * s
+    assert part["train_correct"] == b * s
+    pm = PerfMetrics()
+    pm.update(part)
+    assert pm.get_accuracy() == 100.0
+    assert "accuracy: 100.00%" in pm.report()
+
+    # no accuracy metric requested -> no accuracy line
+    m2 = Metrics(LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                 [MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY])
+    pm2 = PerfMetrics()
+    pm2.update({k: float(np.asarray(val)) for k, val in
+                m2.compute(probs, labels).items()})
+    assert "accuracy" not in pm2.report()
