@@ -1066,7 +1066,7 @@ class FFModel:
 
     def forward(self, seq_length: int = -1):
         assert self.executor is not None and self._current_batch is not None
-        fwd = self.executor.build_forward()
+        fwd = self.executor.build_forward(seq_length)
         bx = [jnp.asarray(a) for a in self._bound_inputs()]
         self._last_logits = fwd(self.state.params, bx)
         # The stepwise loop is synchronous like the reference's per-phase
@@ -1091,7 +1091,7 @@ class FFModel:
         # one jitted program (not eager per-op sharded execution, which
         # loses fusion and can wedge the CPU-mesh in-process collectives);
         # cached + invalidated on the executor like the other step traces
-        grad_fn = self.executor.build_grad_step()
+        grad_fn = self.executor.build_grad_step(seq_length)
         self._pending_grads = grad_fn(self.state.params, bx, by)
         jax.block_until_ready(self._pending_grads)  # see forward()
 
@@ -1117,7 +1117,10 @@ class FFModel:
         (reference: flexflow_cffi.py:2004 compute_metrics)."""
         assert self._last_logits is not None and self._current_batch is not None
         _, label = self._current_batch
+        from ..parallel.executor import truncate_labels
+
         by = jnp.asarray(label, self.label_tensor.data_type.jnp_dtype)
+        by = truncate_labels(by, self._last_logits)
         partials = self.metrics_obj.compute(self._last_logits, by)
         self.perf_metrics.update(
             {k: float(v) for k, v in partials.items() if k != "loss"}

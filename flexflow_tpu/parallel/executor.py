@@ -59,6 +59,20 @@ class TrainState:
     step: int = 0
 
 
+def truncate_labels(labels, logits, seq_length: int = 0):
+    """Per-iteration seq truncation must hit the LABELS too: with
+    forward(seq_length=N) the logits lose positions, and a loss/metric
+    against full-length labels shape-errors. Slices every label axis that
+    is LONGER than the logits' (seq axes shrink; a sparse label's trailing
+    1 stays — it's never longer than the vocab axis)."""
+    if labels.ndim != logits.ndim:
+        return labels
+    for ax in range(1, labels.ndim):
+        if labels.shape[ax] > logits.shape[ax]:
+            labels = jax.lax.slice_in_dim(labels, 0, logits.shape[ax], axis=ax)
+    return labels
+
+
 class PCGExecutor:
     """Builds and caches the jitted step functions for a PCG."""
 
@@ -106,6 +120,7 @@ class PCGExecutor:
         self._eval_step = None
         self._fwd = None
         self._decode_builds = {}
+        self._seq_len_cache = {}  # ("fwd"|"grad", seq_length) -> jitted fn
 
     # -- parameter init (reference: initializer Legion tasks per weight) ----
     def init_params(self) -> Dict[str, Dict[str, jax.Array]]:
@@ -253,6 +268,9 @@ class PCGExecutor:
         self._train_step = None
         self._train_scan = None
         self._grad_step = None
+        for k in list(self._seq_len_cache):
+            if k[0] == "grad" or not train_only:
+                del self._seq_len_cache[k]
         if not train_only:
             self._eval_step = None
             self._fwd = None
@@ -330,22 +348,25 @@ class PCGExecutor:
         self._train_scan = jax.jit(multi, donate_argnums=(0,))
         return self._train_scan
 
-    def build_grad_step(self) -> Callable:
+    def build_grad_step(self, seq_length: int = -1) -> Callable:
         """Gradient-only step for the cffi-parity stepwise loop
         (FFModel.backward). Uses the SAME loss as the fused train step —
         including MoE aux losses and regularizer penalties — so stepwise
         training matches fit() exactly."""
-        if self._grad_step is not None:
+        if seq_length < 0 and self._grad_step is not None:
             return self._grad_step
+        if seq_length >= 0 and ("grad", seq_length) in self._seq_len_cache:
+            return self._seq_len_cache[("grad", seq_length)]
 
         def grad_of(params, batch_inputs, labels):
             def loss_of(p):
                 aux: list = []
                 vals = self.apply(
                     p, self._input_vals(batch_inputs), training=True,
-                    rng=None, aux_out=aux,
+                    rng=None, aux_out=aux, seq_length=seq_length,
                 )
-                loss = self.loss_fn(vals[self.logits_pt.guid], labels)
+                logits = vals[self.logits_pt.guid]
+                loss = self.loss_fn(logits, truncate_labels(labels, logits))
                 for a in aux:
                     loss = loss + a
                 for r in self._reg_penalty(p):
@@ -354,8 +375,12 @@ class PCGExecutor:
 
             return jax.grad(loss_of)(params)
 
-        self._grad_step = jax.jit(grad_of)
-        return self._grad_step
+        fn = jax.jit(grad_of)
+        if seq_length < 0:
+            self._grad_step = fn
+        else:
+            self._seq_len_cache[("grad", seq_length)] = fn
+        return fn
 
     def build_eval_step(self) -> Callable:
         if self._eval_step is not None:
@@ -373,18 +398,31 @@ class PCGExecutor:
         self._eval_step = jax.jit(step)
         return self._eval_step
 
-    def build_forward(self) -> Callable:
-        if self._fwd is not None:
-            return self._fwd
+    def build_forward(self, seq_length: int = -1) -> Callable:
+        """seq_length >= 0 truncates seq-aware ops per iteration (reference:
+        FFIterationConfig.seq_length, forward(seq_length) model.h:771 —
+        BatchMatmul a/b_seq_length_dim slicing). Each distinct value is its
+        own compiled executable, like the reference re-runs its tasks with
+        the iteration config."""
+        if seq_length < 0:
+            if self._fwd is not None:
+                return self._fwd
+        elif ("fwd", seq_length) in self._seq_len_cache:
+            return self._seq_len_cache[("fwd", seq_length)]
 
         def fwd(params, batch_inputs):
             vals = self.apply(
-                params, self._input_vals(batch_inputs), training=False, rng=None
+                params, self._input_vals(batch_inputs), training=False,
+                rng=None, seq_length=seq_length,
             )
             return vals[self.logits_pt.guid]
 
-        self._fwd = jax.jit(fwd)
-        return self._fwd
+        fn = jax.jit(fwd)
+        if seq_length < 0:
+            self._fwd = fn
+        else:
+            self._seq_len_cache[("fwd", seq_length)] = fn
+        return fn
 
     # -- incremental decode (serving KV cache) ------------------------------
     def build_decode(self, batch: int, max_len: int, cache_dtype=None):

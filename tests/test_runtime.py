@@ -174,3 +174,61 @@ def test_scan_driver_matches_stepwise_with_dropout():
                     jax.tree_util.tree_leaves(m2.state.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6, atol=1e-7)
+
+
+def test_forward_seq_length_truncates_batch_matmul():
+    """FFIterationConfig.seq_length parity (reference: config.h:162,
+    forward(seq_length) model.h:771 truncates BatchMatmul's seq dims):
+    forward(seq_length=N) must equal running on inputs truncated to N."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu import (DataType, FFConfig, FFModel, LossType,
+                              MetricsType, SGDOptimizer)
+    from flexflow_tpu.ops.batch_matmul import BatchMatmulParams
+    from flexflow_tpu.ff_types import OperatorType
+
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    m = FFModel(cfg)
+    a = m.create_tensor((2, 8, 4), DataType.DT_FLOAT)
+    b = m.create_tensor((2, 4, 8), DataType.DT_FLOAT)
+    out = m.batch_matmul(a, b, a_seq_length_dim=1, b_seq_length_dim=2)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+              [MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    rng = np.random.RandomState(0)
+    av = rng.randn(2, 8, 4).astype(np.float32)
+    bv = rng.randn(2, 4, 8).astype(np.float32)
+    a.set_tensor(m, av)
+    b.set_tensor(m, bv)
+    full = np.asarray(m.forward())
+    trunc = np.asarray(m.forward(seq_length=4))
+    want = np.einsum("bik,bkj->bij", av[:, :4], bv[:, :, :4])
+    np.testing.assert_allclose(trunc, want, rtol=1e-5, atol=1e-5)
+    assert trunc.shape != full.shape
+
+
+def test_backward_seq_length_truncates_labels():
+    """backward(seq_length=N)/compute_metrics must truncate labels to the
+    logits' sequence length instead of shape-erroring."""
+    from flexflow_tpu import (DataType, FFConfig, FFModel, LossType,
+                              MetricsType, SGDOptimizer)
+
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    m = FFModel(cfg)
+    a = m.create_tensor((2, 8, 4), DataType.DT_FLOAT)
+    b = m.create_tensor((2, 4, 8), DataType.DT_FLOAT)
+    m.batch_matmul(a, b, a_seq_length_dim=1, b_seq_length_dim=2)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+              [MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    rng = np.random.RandomState(0)
+    a.set_tensor(m, rng.randn(2, 8, 4).astype(np.float32))
+    b.set_tensor(m, rng.randn(2, 4, 8).astype(np.float32))
+    m.label_tensor.set_tensor(m, rng.randn(2, 8, 8).astype(np.float32))
+    m.forward(seq_length=4)
+    m.compute_metrics()        # truncated logits vs full labels
+    m.zero_gradients()
+    m.backward(seq_length=4)   # grad step truncates labels too
+    m.update()
