@@ -594,6 +594,16 @@ class FFModel:
             name,
         )
 
+    def cache(self, input: Tensor, num_batches: int = 1, name=""):
+        """reference: FFModel::cache (src/ops/cache.cc) — cross-batch
+        activation cache (MoE gating cache); see ops/moe.py CacheParams."""
+        return self._add_layer(
+            OperatorType.OP_CACHE,
+            CacheParams(num_batches=num_batches),
+            [input],
+            name,
+        )
+
     def moe(
         self,
         input: Tensor,
@@ -1025,7 +1035,8 @@ class FFModel:
                 for pt, a in zip(in_pts, batch[:-1])
             ]
             by = jnp.asarray(batch[-1], self.label_tensor.data_type.jnp_dtype)
-            _, partials = step_fn(self.state.params, bx, by)
+            _, partials = step_fn(self.state.params, bx, by,
+                                  self.state.net_state)
             pm.update({k: float(v) for k, v in partials.items()})
         print(pm.report())
         return pm
@@ -1046,7 +1057,8 @@ class FFModel:
                     for c in chunk
                 ]
             bx = [jnp.asarray(c) for c in chunk]
-            out = np.asarray(fwd(self.state.params, bx))
+            out = np.asarray(fwd(self.state.params, bx,
+                                 self.state.net_state))
             outs.append(out[: bs - pad] if pad > 0 else out)
         return np.concatenate(outs, axis=0) if outs else np.empty((0,))
 
@@ -1068,7 +1080,7 @@ class FFModel:
         assert self.executor is not None and self._current_batch is not None
         fwd = self.executor.build_forward(seq_length)
         bx = [jnp.asarray(a) for a in self._bound_inputs()]
-        self._last_logits = fwd(self.state.params, bx)
+        self._last_logits = fwd(self.state.params, bx, self.state.net_state)
         # The stepwise loop is synchronous like the reference's per-phase
         # Legion tasks. Blocking also keeps two sharded programs with
         # collectives from running concurrently, which can wedge the
@@ -1092,7 +1104,9 @@ class FFModel:
         # loses fusion and can wedge the CPU-mesh in-process collectives);
         # cached + invalidated on the executor like the other step traces
         grad_fn = self.executor.build_grad_step(seq_length)
-        self._pending_grads = grad_fn(self.state.params, bx, by)
+        self._pending_grads, self._pending_net_state = grad_fn(
+            self.state.params, bx, by, self.state.net_state
+        )
         jax.block_until_ready(self._pending_grads)  # see forward()
 
     def update(self):
@@ -1100,10 +1114,14 @@ class FFModel:
         new_params, new_opt = self.optimizer.update(
             self.state.params, self._pending_grads, self.state.opt_state
         )
+        net_state = dict(self.state.net_state)
+        net_state.update(getattr(self, "_pending_net_state", None) or {})
         self.state = TrainState(
-            params=new_params, opt_state=new_opt, step=self.state.step + 1
+            params=new_params, opt_state=new_opt, step=self.state.step + 1,
+            net_state=net_state,
         )
         self._pending_grads = None
+        self._pending_net_state = None
 
     def get_perf_metrics(self) -> PerfMetrics:
         return self.perf_metrics

@@ -257,12 +257,13 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
 
 def _forward_decode(params, weights, inputs, ctx, cache, t):
     """Incremental decode step with a KV cache (serving path,
-    executor.build_decode). Inputs are the NEW position's slices
-    (b, 1, e); cache holds (k, v) of shape (b, max_len, h, d) with
-    positions < t valid. Appends this position's K/V and attends the new
-    query against the prefix — one cache-width attention row per token
-    instead of the full O(L²) forward the reference's serving prototype
-    would re-run (it has
+    executor.build_decode). Inputs are the NEW positions' slices
+    (b, s0, e) starting at position t (s0 = 1 for token-by-token decode,
+    s0 = prompt_len for one-shot prefill); cache holds (k, v) of shape
+    (b, max_len, h, d) with positions < t valid. Appends the block's K/V
+    and attends its queries against the prefix with intra-block causal
+    masking — cache-width attention rows per token instead of the full
+    O(L²) forward the reference's serving prototype would re-run (it has
     no KV cache; triton/README.md calls it an incomplete prototype).
 
     Requires self-attention (q_in is k_in is v_in upstream) — the decode
@@ -293,10 +294,12 @@ def _forward_decode(params, weights, inputs, ctx, cache, t):
     scores = jnp.einsum(
         "bshd,bthd->bhst", q, k_cache.astype(q.dtype),
         preferred_element_type=jnp.float32,
-    ) * scale                          # (b, h, 1, max_len)
-    pos = jnp.arange(k_cache.shape[1])
+    ) * scale                          # (b, h, s0, max_len)
+    pos = jnp.arange(k_cache.shape[1])          # cache positions
+    q_pos = t + jnp.arange(q.shape[1])          # this block's positions
     scores = jnp.where(
-        (pos <= t)[None, None, None, :], scores, jnp.finfo(jnp.float32).min
+        pos[None, None, None, :] <= q_pos[None, None, :, None],
+        scores, jnp.finfo(jnp.float32).min,
     )
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     attn = jnp.einsum(

@@ -167,11 +167,46 @@ register_op(
 @dataclasses.dataclass(frozen=True)
 class CacheParams:
     """reference: include/flexflow/ops/cache_params.h — caches an input
-    tensor across batches (MoE gating cache, CACHE_UPDATE_TASK). Our
-    functional equivalent: identity in training (cache write handled by the
-    runtime state), cached value returned in inference via ctx."""
+    tensor across batches (MoE gating cache: cache.cc keeps num_batches
+    snapshots, CACHE_UPDATE_TASK writes the current batch, and a score
+    function decides whether the cache is fresh enough to serve).
+
+    Here the cache is a net_state buffer threaded through the train step:
+    training passes the live input through AND writes it to the buffer
+    (exponential blend over ~num_batches like the reference's rolling
+    window); inference serves the CACHED value — the gating-cache
+    behavior that lets MoE routing reuse recent statistics."""
 
     num_batches: int = 1
+
+
+def _cache_state(params: CacheParams, in_shapes, in_dtypes):
+    from .registry import WeightSpec
+
+    return [WeightSpec("cached", tuple(in_shapes[0]), in_dtypes[0], "zero"),
+            WeightSpec("filled", (1,), in_dtypes[0], "zero")]
+
+
+def _cache_forward_stateful(params: CacheParams, weights, state, inputs, ctx):
+    (x,) = inputs
+    if not state:
+        return [x], {}
+    if ctx.training:
+        # rolling blend over ~num_batches (reference keeps a window of
+        # num_batches snapshots; the exponential average has the same
+        # effective horizon without num_batches x memory)
+        alpha = 1.0 / max(1, params.num_batches)
+        filled = jnp.minimum(state["filled"] + 1.0, 1.0)
+        cached = jnp.where(
+            state["filled"] > 0,
+            (1.0 - alpha) * state["cached"] + alpha * x.astype(
+                state["cached"].dtype),
+            x.astype(state["cached"].dtype),
+        )
+        return [x], {"cached": cached, "filled": filled}
+    # inference: serve the cache when it has ever been written
+    out = jnp.where(state["filled"] > 0, state["cached"].astype(x.dtype), x)
+    return [out], state
 
 
 register_op(
@@ -179,4 +214,6 @@ register_op(
     "Cache",
     infer=lambda p, s, dt: ([s[0]], [dt[0]]),
     forward=lambda p, w, x, ctx: [x[0]],
+    state_spec=_cache_state,
+    forward_stateful=_cache_forward_stateful,
 )

@@ -41,21 +41,59 @@ def _bn_weights(params, in_shapes, in_dtypes):
     ]
 
 
-def _bn_forward(params: BatchNormParams, weights, inputs, ctx):
-    (x,) = inputs
-    # Normalize over (N, H, W) per channel — NCHW axes (0, 2, 3)
-    axes = (0, 2, 3) if x.ndim == 4 else tuple(i for i in range(x.ndim) if i != 1)
-    xf = x.astype(jnp.float32)
-    mean = jnp.mean(xf, axis=axes)
-    var = jnp.var(xf, axis=axes)
+def _bn_normalize(params, weights, x, mean, var):
     bshape = [1, -1] + [1] * (x.ndim - 2)
+    xf = x.astype(jnp.float32)
     y = (xf - mean.reshape(bshape)) / jnp.sqrt(var.reshape(bshape) + params.eps)
     y = y * weights["scale"].astype(jnp.float32).reshape(bshape) + \
         weights["bias"].astype(jnp.float32).reshape(bshape)
     y = y.astype(x.dtype)
     if params.relu:
         y = jnp.maximum(y, 0)
-    return [y]
+    return y
+
+
+def _bn_batch_stats(x):
+    # Normalize over (N, H, W) per channel — NCHW axes (0, 2, 3)
+    axes = (0, 2, 3) if x.ndim == 4 else tuple(i for i in range(x.ndim) if i != 1)
+    xf = x.astype(jnp.float32)
+    return jnp.mean(xf, axis=axes), jnp.var(xf, axis=axes)
+
+
+def _bn_forward(params: BatchNormParams, weights, inputs, ctx):
+    (x,) = inputs
+    mean, var = _bn_batch_stats(x)
+    return [_bn_normalize(params, weights, x, mean, var)]
+
+
+def _bn_state(params, in_shapes, in_dtypes):
+    c = in_shapes[0][1]  # NCHW
+    return [
+        WeightSpec("running_mean", (c,), DataType.DT_FLOAT, "zero"),
+        WeightSpec("running_var", (c,), DataType.DT_FLOAT, "one"),
+    ]
+
+
+def _bn_forward_stateful(params: BatchNormParams, weights, state, inputs, ctx):
+    """Training: batch stats normalize, running stats update with
+    `momentum` (reference: cuDNN BN's exponentialAverageFactor,
+    batch_norm.cu). Inference: the RUNNING stats normalize — the piece the
+    stateless forward can't do."""
+    (x,) = inputs
+    if not state:  # stateless caller (cost measurement, decode) — batch stats
+        return _bn_forward(params, weights, inputs, ctx), {}
+    if ctx.training:
+        mean, var = _bn_batch_stats(x)
+        m = params.momentum
+        new_state = {
+            "running_mean": m * state["running_mean"] + (1 - m) * mean,
+            "running_var": m * state["running_var"] + (1 - m) * var,
+        }
+        return [_bn_normalize(params, weights, x, mean, var)], new_state
+    return [
+        _bn_normalize(params, weights, x, state["running_mean"],
+                      state["running_var"])
+    ], state
 
 
 register_op(
@@ -64,6 +102,8 @@ register_op(
     infer=_bn_infer,
     weights=_bn_weights,
     forward=_bn_forward,
+    state_spec=_bn_state,
+    forward_stateful=_bn_forward_stateful,
 )
 
 

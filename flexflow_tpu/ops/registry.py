@@ -58,6 +58,14 @@ class OpDef:
     # ctx, cache, t) -> (outs, cache') — attention appends K/V there.
     seq_pointwise: object = False
     forward_decode: Optional[Callable] = None
+    # Cross-batch mutable buffers (reference: cuDNN BN running stats,
+    # Cache op's CACHE_UPDATE_TASK). state_spec declares them like
+    # weights; forward_stateful(params, weights, state, inputs, ctx) ->
+    # (outs, new_state) consumes/produces them. The executor threads the
+    # collection through the train step (functional update) and passes it
+    # read-only to eval/forward.
+    state_spec: Optional[Callable] = None
+    forward_stateful: Optional[Callable] = None
 
     def is_seq_pointwise(self, params, op) -> bool:
         if callable(self.seq_pointwise):
@@ -78,6 +86,8 @@ def register_op(
     num_inputs: int = 1,
     seq_pointwise: object = False,
     forward_decode: Optional[Callable] = None,
+    state_spec: Optional[Callable] = None,
+    forward_stateful: Optional[Callable] = None,
 ) -> OpDef:
     d = OpDef(
         op_type=op_type,
@@ -88,6 +98,8 @@ def register_op(
         num_inputs=num_inputs,
         seq_pointwise=seq_pointwise,
         forward_decode=forward_decode,
+        state_spec=state_spec,
+        forward_stateful=forward_stateful,
     )
     _REGISTRY[op_type] = d
     return d

@@ -57,6 +57,11 @@ class TrainState:
     params: Dict[str, Dict[str, jax.Array]]
     opt_state: Any
     step: int = 0
+    # non-trainable cross-batch buffers (BN running stats, Cache op);
+    # keyed op.name -> buffer name -> array
+    net_state: Dict[str, Dict[str, jax.Array]] = dataclasses.field(
+        default_factory=dict
+    )
 
 
 def truncate_labels(labels, logits, seq_length: int = 0):
@@ -149,10 +154,39 @@ class PCGExecutor:
                 params[op.name] = wd
         return params
 
+    def init_net_state(self) -> Dict[str, Dict[str, jax.Array]]:
+        """Zero/one-filled cross-batch buffers for stateful ops (reference:
+        cuDNN BN running stats init, Cache's first-batch fill)."""
+        net: Dict[str, Dict[str, jax.Array]] = {}
+        for op in self.topo:
+            if op.is_parallel_op:
+                continue
+            d = get_op_def(op.op_type)
+            if d.state_spec is None:
+                continue
+            specs = d.state_spec(
+                op.params,
+                [t.material_shape() for t in op.inputs],
+                [t.data_type for t in op.inputs],
+            )
+            bufs = {}
+            for spec in specs:
+                fill = 1.0 if spec.initializer == "one" else 0.0
+                arr = np.full(spec.shape, fill, spec.dtype.np_dtype)
+                if self.mesh is not None:
+                    bufs[spec.name] = jax.device_put(
+                        arr, NamedSharding(self.mesh, PartitionSpec())
+                    )
+                else:
+                    bufs[spec.name] = jnp.asarray(arr)
+            net[op.name] = bufs
+        return net
+
     def init_state(self) -> TrainState:
         params = self.init_params()
         opt_state = self.optimizer.init_state(params)
-        return TrainState(params=params, opt_state=opt_state)
+        return TrainState(params=params, opt_state=opt_state,
+                          net_state=self.init_net_state())
 
     # -- forward ------------------------------------------------------------
     def _constrain(self, val, pt):
@@ -172,9 +206,13 @@ class PCGExecutor:
         rng: Optional[jax.Array],
         seq_length: int = -1,
         aux_out: Optional[list] = None,
+        net_state: Optional[Dict] = None,
+        net_out: Optional[Dict] = None,
     ) -> Dict[int, jax.Array]:
         """Walk the PCG and compute every tensor. Returns guid -> value.
-        Differentiable aux losses (MoE balance) are appended to aux_out."""
+        Differentiable aux losses (MoE balance) are appended to aux_out;
+        stateful ops read net_state and write updates into net_out (the
+        train step threads both; eval passes net_state read-only)."""
         vals: Dict[int, jax.Array] = dict(inputs)
         for guid, (pt, value) in self.constants.items():
             if isinstance(value, np.ndarray):  # baked array constant
@@ -223,6 +261,16 @@ class PCGExecutor:
                             _od.forward(_p, w_, ins_, _c)
                         )
                     )(w, ins)
+                elif opdef.forward_stateful is not None:
+                    st = (net_state or {}).get(op.name, {})
+                    outs, new_st = opdef.forward_stateful(
+                        op.params, w, st, ins, ctx
+                    )
+                    if net_out is not None:
+                        # buffers are statistics, not a gradient path
+                        net_out[op.name] = jax.tree_util.tree_map(
+                            jax.lax.stop_gradient, new_st
+                        )
                 else:
                     outs = opdef.forward(op.params, w, ins, ctx)
             for t, o in zip(op.outputs, outs):
@@ -279,9 +327,10 @@ class PCGExecutor:
         def step(state: TrainState, batch_inputs, labels, rng):
             def loss_of(params):
                 aux: list = []
+                net_out: dict = {}
                 vals = self.apply(
                     params, self._input_vals(batch_inputs), training=True, rng=rng,
-                    aux_out=aux,
+                    aux_out=aux, net_state=state.net_state, net_out=net_out,
                 )
                 logits = vals[self.logits_pt.guid]
                 loss = self.loss_fn(logits, labels)
@@ -289,11 +338,13 @@ class PCGExecutor:
                     loss = loss + a
                 for r in self._reg_penalty(params):
                     loss = loss + r
-                return loss, logits
+                return loss, (logits, net_out)
 
-            (loss, logits), grads = jax.value_and_grad(loss_of, has_aux=True)(
-                state.params
-            )
+            (loss, (logits, net_out)), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(state.params)
+            new_net = dict(state.net_state)
+            new_net.update(net_out)
             new_params, new_opt = self.optimizer.update(
                 state.params, grads, state.opt_state
             )
@@ -309,7 +360,8 @@ class PCGExecutor:
                     for k, v in partials.items()
                 }
             return (
-                TrainState(params=new_params, opt_state=new_opt, step=state.step + 1),
+                TrainState(params=new_params, opt_state=new_opt,
+                           step=state.step + 1, net_state=new_net),
                 partials,
             )
 
@@ -358,12 +410,14 @@ class PCGExecutor:
         if seq_length >= 0 and ("grad", seq_length) in self._seq_len_cache:
             return self._seq_len_cache[("grad", seq_length)]
 
-        def grad_of(params, batch_inputs, labels):
+        def grad_of(params, batch_inputs, labels, net_state=None):
             def loss_of(p):
                 aux: list = []
+                net_out: dict = {}
                 vals = self.apply(
                     p, self._input_vals(batch_inputs), training=True,
                     rng=None, aux_out=aux, seq_length=seq_length,
+                    net_state=net_state, net_out=net_out,
                 )
                 logits = vals[self.logits_pt.guid]
                 loss = self.loss_fn(logits, truncate_labels(labels, logits))
@@ -371,9 +425,10 @@ class PCGExecutor:
                     loss = loss + a
                 for r in self._reg_penalty(p):
                     loss = loss + r
-                return loss
+                return loss, net_out
 
-            return jax.grad(loss_of)(params)
+            grads, net_out = jax.grad(loss_of, has_aux=True)(params)
+            return grads, net_out
 
         fn = jax.jit(grad_of)
         if seq_length < 0:
@@ -386,9 +441,10 @@ class PCGExecutor:
         if self._eval_step is not None:
             return self._eval_step
 
-        def step(params, batch_inputs, labels):
+        def step(params, batch_inputs, labels, net_state=None):
             vals = self.apply(
-                params, self._input_vals(batch_inputs), training=False, rng=None
+                params, self._input_vals(batch_inputs), training=False,
+                rng=None, net_state=net_state,
             )
             logits = vals[self.logits_pt.guid]
             partials = self.metrics.compute(logits, labels)
@@ -410,10 +466,10 @@ class PCGExecutor:
         elif ("fwd", seq_length) in self._seq_len_cache:
             return self._seq_len_cache[("fwd", seq_length)]
 
-        def fwd(params, batch_inputs):
+        def fwd(params, batch_inputs, net_state=None):
             vals = self.apply(
                 params, self._input_vals(batch_inputs), training=False,
-                rng=None, seq_length=seq_length,
+                rng=None, seq_length=seq_length, net_state=net_state,
             )
             return vals[self.logits_pt.guid]
 

@@ -31,6 +31,10 @@ def save_checkpoint(model, path: str, *, step: Optional[int] = None) -> str:
         "opt_state": _strip_none(model.state.opt_state),
         "step": np.asarray(step if step is not None else model.state.step),
     }
+    if model.state.net_state:
+        # cross-batch buffers (BN running stats, Cache) are part of the
+        # trained state — dropping them silently reverts eval behavior
+        state["net_state"] = model.state.net_state
     _checkpointer().save(path, state, force=True)
     # sidecar metadata for topology validation on restore
     meta = {
@@ -77,7 +81,22 @@ def restore_checkpoint(model, path: str) -> int:
             )
     opt_state = _merge_restore(model.state.opt_state, restored.get("opt_state"))
     step = int(np.asarray(restored.get("step", 0)))
-    model.state = TrainState(params=new_params, opt_state=opt_state, step=step)
+    saved_net = restored.get("net_state")
+    net_state = model.state.net_state
+    if saved_net:
+        net_state = {}
+        for op_name, bufs in model.state.net_state.items():
+            net_state[op_name] = {
+                name: jax.device_put(
+                    np.asarray(saved_net[op_name][name]).astype(old.dtype),
+                    old.sharding,
+                )
+                if op_name in saved_net and name in saved_net[op_name]
+                else old
+                for name, old in bufs.items()
+            }
+    model.state = TrainState(params=new_params, opt_state=opt_state,
+                             step=step, net_state=net_state)
     return step
 
 

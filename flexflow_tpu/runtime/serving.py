@@ -61,7 +61,8 @@ def greedy_generate(
     enc = np.asarray(encoder_ids, enc_t.data_type.np_dtype)
     finished = np.zeros(bs, bool)
     for t in range(steps):
-        logits = np.asarray(fwd(model.state.params, [enc, dec]))
+        logits = np.asarray(fwd(model.state.params, [enc, dec],
+                                model.state.net_state))
         nxt = logits[:, t].argmax(-1)
         if eos_token_id is not None:
             nxt = np.where(finished, pad_token_id, nxt)
@@ -93,6 +94,8 @@ def incremental_generate(
     assert model.executor is not None, "compile() the model first"
     prompt_ids = np.asarray(prompt_ids)
     bs, plen = prompt_ids.shape
+    if max_new_tokens <= 0:
+        return prompt_ids.copy()
     total = plen + max_new_tokens
     cap = max_len or total
     assert cap >= total, f"max_len {cap} < prompt+new {total}"
@@ -104,20 +107,29 @@ def incremental_generate(
     out = np.full((bs, total), pad_token_id, id_dt)
     out[:, :plen] = prompt_ids
     finished = np.zeros(bs, bool)
-    logits = None
-    for t in range(total - 1):
+    # one-shot prefill: the whole prompt goes through a single step (the
+    # decode kernels handle any block width with intra-block causal
+    # masking), populating every prompt position's K/V at once
+    logits, caches = step(
+        model.state.params, caches, jnp.int32(0),
+        [jnp.asarray(prompt_ids.astype(id_dt))],
+    )
+    nxt = np.asarray(logits)[:, -1].argmax(-1)
+    if eos_token_id is not None:
+        finished |= nxt == eos_token_id
+    out[:, plen] = nxt
+    for t in range(plen, total - 1):
+        if eos_token_id is not None and finished.all():
+            return out[:, : t + 1]
         tok = out[:, t : t + 1].astype(id_dt)
         logits, caches = step(
             model.state.params, caches, jnp.int32(t), [jnp.asarray(tok)]
         )
-        if t >= plen - 1:  # prompt positions only prefill the cache
-            nxt = np.asarray(logits)[:, 0].argmax(-1)
-            if eos_token_id is not None:
-                nxt = np.where(finished, pad_token_id, nxt)
-                finished |= nxt == eos_token_id
-            out[:, t + 1] = nxt
-            if eos_token_id is not None and finished.all():
-                return out[:, : t + 2]
+        nxt = np.asarray(logits)[:, 0].argmax(-1)
+        if eos_token_id is not None:
+            nxt = np.where(finished, pad_token_id, nxt)
+            finished |= nxt == eos_token_id
+        out[:, t + 1] = nxt
     return out
 
 
@@ -174,7 +186,8 @@ def beam_generate(
             dec = np.full((bs, dec_len), pad_token_id, beams.dtype)
             dec[:num_beams] = beams
             logp = _log_softmax(
-                np.asarray(fwd(model.state.params, [enc, dec]))[:num_beams, t]
+                np.asarray(fwd(model.state.params, [enc, dec],
+                               model.state.net_state))[:num_beams, t]
             )
             vocab = logp.shape[-1]
             # finished beams propagate unchanged via a single pad candidate
@@ -275,7 +288,8 @@ class BatchScheduler:
                 rows = [r.inputs[i] for r in batch]
                 stacked = np.stack(rows + [rows[-1]] * pad, axis=0)
                 arrays.append(jnp.asarray(stacked))
-            out = np.asarray(self._fwd(self.model.state.params, arrays))
+            out = np.asarray(self._fwd(self.model.state.params, arrays,
+                                       self.model.state.net_state))
             for j, r in enumerate(batch):
                 r.result = out[j]
                 r.event.set()

@@ -108,3 +108,115 @@ def test_create_constant_and_introspection():
     assert m.get_layer_by_name("plus2").name == "plus2"
     m.reset_metrics()
     m.print_layers(0)
+
+
+def test_batchnorm_running_stats_used_at_eval():
+    """BN parity upgrade (reference: cuDNN BN running stats,
+    batch_norm.cu): training updates running mean/var; predict() uses
+    THEM, so an example's eval output doesn't depend on its batch."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu import (DataType, FFConfig, FFModel, LossType,
+                              MetricsType, SGDOptimizer)
+
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    m = FFModel(cfg)
+    x = m.create_tensor((8, 4, 6, 6), DataType.DT_FLOAT)
+    t = m.batch_norm(x, relu=False)
+    t = m.flat(t)
+    m.dense(t, 3)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    rng = np.random.RandomState(0)
+    xs = (3.0 + 2.0 * rng.randn(64, 4, 6, 6)).astype(np.float32)
+    ys = rng.randint(0, 3, (64, 1)).astype(np.int32)
+    bn_name = next(op for op in m.executor.topo
+                   if op.op_type.name == "OP_BATCHNORM").name
+    before = np.asarray(m.state.net_state[bn_name]["running_mean"]).copy()
+    m.fit(xs, ys, batch_size=8, epochs=2, verbose=False)
+    after = np.asarray(m.state.net_state[bn_name]["running_mean"])
+    assert not np.allclose(before, after)  # stats moved toward data mean ~3
+
+    # the same example must eval identically in two different batches
+    probe = xs[:1]
+    batch_a = np.concatenate([probe, xs[1:8]])
+    batch_b = np.concatenate([probe, 50.0 + xs[8:15]])
+    out_a = m.predict(batch_a, batch_size=8)[0]
+    out_b = m.predict(batch_b, batch_size=8)[0]
+    np.testing.assert_allclose(out_a, out_b, rtol=1e-5, atol=1e-6)
+
+
+def test_cache_op_serves_cached_value_at_inference():
+    """Cache parity (reference: cache.cc — CACHE_UPDATE_TASK writes each
+    batch, inference serves the cache): after training, predict() returns
+    the cached activations, not the live input's."""
+    from flexflow_tpu import (DataType, FFConfig, FFModel, LossType,
+                              MetricsType, SGDOptimizer)
+
+    cfg = FFConfig()
+    cfg.batch_size = 4
+    m = FFModel(cfg)
+    x = m.create_tensor((4, 6), DataType.DT_FLOAT)
+    t = m.cache(x, num_batches=1)
+    m.dense(t, 2, use_bias=False)
+    m.compile(SGDOptimizer(lr=0.0),
+              LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+              [MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    rng = np.random.RandomState(0)
+    xs = rng.randn(4, 6).astype(np.float32)
+    ys = rng.randn(4, 2).astype(np.float32)
+    m.fit(xs, ys, batch_size=4, epochs=1, verbose=False)
+    cache_name = next(op for op in m.executor.topo
+                      if op.op_type.name == "OP_CACHE").name
+    np.testing.assert_allclose(
+        np.asarray(m.state.net_state[cache_name]["cached"]), xs, atol=1e-6)
+    # inference on DIFFERENT inputs returns the cached batch's outputs
+    out_other = m.predict(rng.randn(4, 6).astype(np.float32), batch_size=4)
+    out_cached = m.predict(xs, batch_size=4)
+    np.testing.assert_allclose(out_other, out_cached, atol=1e-6)
+
+
+def test_batchnorm_running_stats_update_in_stepwise_loop_and_checkpoint(tmp_path):
+    """The stepwise forward/backward/update loop must update running stats
+    like fit() does, and checkpoints must carry net_state."""
+    from flexflow_tpu import (DataType, FFConfig, FFModel, LossType,
+                              MetricsType, SGDOptimizer, restore_checkpoint,
+                              save_checkpoint)
+
+    def build():
+        cfg = FFConfig()
+        cfg.batch_size = 8
+        m = FFModel(cfg)
+        x = m.create_tensor((8, 4, 6, 6), DataType.DT_FLOAT)
+        t = m.batch_norm(x, relu=False)
+        t = m.flat(t)
+        m.dense(t, 3)
+        m.compile(SGDOptimizer(lr=0.01),
+                  LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [MetricsType.METRICS_ACCURACY])
+        return m
+
+    m = build()
+    rng = np.random.RandomState(0)
+    xs = (3.0 + rng.randn(8, 4, 6, 6)).astype(np.float32)
+    ys = rng.randint(0, 3, (8, 1)).astype(np.int32)
+    bn = next(op for op in m.executor.topo
+              if op.op_type.name == "OP_BATCHNORM").name
+    m.input_tensors[0].set_tensor(m, xs)
+    m.label_tensor.set_tensor(m, ys)
+    m.forward()
+    m.zero_gradients()
+    m.backward()
+    m.update()
+    after = np.asarray(m.state.net_state[bn]["running_mean"])
+    assert not np.allclose(after, 0.0)  # stepwise loop updated the stats
+
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(m, path)
+    m2 = build()
+    assert np.allclose(np.asarray(m2.state.net_state[bn]["running_mean"]), 0)
+    restore_checkpoint(m2, path)
+    np.testing.assert_allclose(
+        np.asarray(m2.state.net_state[bn]["running_mean"]), after, atol=1e-6)

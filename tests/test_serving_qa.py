@@ -333,6 +333,22 @@ def test_incremental_decode_matches_full_forward():
             np.asarray(logits)[:, 0], full[:, t_], rtol=2e-4, atol=2e-4,
         )
 
+    # block prefill: the first 5 positions in ONE step (intra-block causal
+    # masking), then token-by-token — same logits as the full forward
+    caches2 = init_caches()
+    logits, caches2 = step(
+        m.state.params, caches2, jnp.int32(0), [jnp.asarray(toks[:, :5])]
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), full[:, :5], rtol=2e-4, atol=2e-4,
+    )
+    logits, caches2 = step(
+        m.state.params, caches2, jnp.int32(5), [jnp.asarray(toks[:, 5:6])]
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits)[:, 0], full[:, 5], rtol=2e-4, atol=2e-4,
+    )
+
     # generate API end to end
     out = incremental_generate(m, toks[:, :4], max_new_tokens=5)
     assert out.shape == (bs, 9)
