@@ -753,12 +753,28 @@ class FFModel:
         ):
             final_ops = [o for o in self.graph.ops
                          if any(t.guid == logits_pt.guid for t in o.outputs)]
-            if final_ops and final_ops[0].op_type != OperatorType.OP_SOFTMAX:
+
+            def _probability_like(op) -> bool:
+                op_type, params = op.op_type, op.params
+                if op_type == OperatorType.OP_FUSED and params.chain:
+                    # --fusion packs the tail chain into one node; judge by
+                    # the chain's LAST step
+                    op_type, params = params.chain[-1][0], params.chain[-1][1]
+                if op_type in (OperatorType.OP_SOFTMAX,
+                               OperatorType.OP_SIGMOID):
+                    return True
+                # fused activation inside the op (DLRM's final dense has
+                # AC_MODE_SIGMOID, dlrm.cc create_mlp) keeps outputs in
+                # (0, 1) — the clip is a no-op and gradients flow
+                act = getattr(params, "activation", None)
+                return act == ActiMode.AC_MODE_SIGMOID
+
+            if final_ops and not _probability_like(final_ops[0]):
                 import warnings
 
                 warnings.warn(
-                    "cross-entropy losses expect SOFTMAX outputs (the "
-                    "reference's loss kernels take probabilities; "
+                    "cross-entropy losses expect probability outputs (the "
+                    "reference's loss kernels take them; "
                     "loss_functions.cc) but the model's final op is "
                     f"{final_ops[0].op_type.name} — raw logits get clipped "
                     "to [1e-12, 1] and gradients die. End the model with "
