@@ -1,76 +1,21 @@
 """AlexNet/CIFAR-10 training throughput on the real chip — the second
 headline config (BASELINE.md: bootcamp_demo/ff_alexnet_cifar10.py prints
-THROUGHPUT; reference input layout 3x229x229, batch 64). Synthetic data,
-same measurement discipline as bench.py (scan driver + scalar probe)."""
-import json
+THROUGHPUT; reference input layout 3x229x229, batch 64)."""
 import os
 import sys
-import time
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+from _harness import run_throughput
 
 
-def main():
-    import jax
-
-    from flexflow_tpu import (
-        FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
-    )
+def build(model, batch):
     from flexflow_tpu.models.alexnet import build_alexnet
 
-    batch = 64
-    cfg = FFConfig()
-    cfg.batch_size = batch
-    cfg.allow_mixed_precision = True
-    model = FFModel(cfg)
     build_alexnet(model, batch_size=batch, num_classes=10,
                   height=229, width=229)
-    model.compile(
-        optimizer=SGDOptimizer(lr=0.01),
-        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
-        metrics=[MetricsType.METRICS_ACCURACY],
-    )
-    ex = model.executor
-    in_pt = ex.input_pts[0]
-    rng = np.random.RandomState(0)
-    x = ex.shard_batch(in_pt, rng.rand(*in_pt.material_shape()).astype(np.float32))
-    y = jax.numpy.asarray(rng.randint(0, 10, (batch, 1)).astype(np.int32))
-    state = model.state
-    probe = jax.jit(
-        lambda params: sum(
-            leaf.reshape(-1)[0].astype(jax.numpy.float32)
-            for leaf in jax.tree_util.tree_leaves(params)
-        )
-    )
-
-    def sync(st):
-        return float(np.asarray(probe(st.params)))
-
-    scan = ex.build_train_scan()
-    spd = 25
-    xs = [jax.numpy.broadcast_to(x, (spd,) + x.shape)]
-    ys = jax.numpy.broadcast_to(y, (spd,) + y.shape)
-    keys = jax.random.split(jax.random.PRNGKey(0), spd)
-    for _ in range(2):
-        state, _ = scan(state, xs, ys, keys)
-    sync(state)
-    chunks = 4
-    t0 = time.perf_counter()
-    for _ in range(chunks):
-        state, _ = scan(state, xs, ys, keys)
-    sync(state)
-    dt = time.perf_counter() - t0
-    iters = spd * chunks
-    n_chips = max(1, len(jax.devices()))
-    print(json.dumps({
-        "metric": "alexnet_cifar10_train_throughput",
-        "value": round(batch * iters / dt / n_chips, 2),
-        "unit": "samples/s/chip",
-        "vs_baseline": None,
-    }))
 
 
 if __name__ == "__main__":
-    main()
+    run_throughput(build, metric="alexnet_cifar10_train_throughput",
+                   batch=64, label_classes=10, spd=25)

@@ -1,0 +1,19 @@
+"""DLRM training throughput on the real chip (reference config:
+scripts/osdi22ae/dlrm.sh; 4 embedding tables of 1M x 64 + bottom/top MLPs)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _harness import run_throughput
+
+
+def build(model, batch):
+    from flexflow_tpu.models.dlrm import build_dlrm
+
+    build_dlrm(model, batch)
+
+
+if __name__ == "__main__":
+    run_throughput(build, metric="dlrm_train_throughput",
+                   batch=64, label_classes=2, spd=25)

@@ -1,0 +1,21 @@
+"""ResNet-50 training throughput on the real chip (BASELINE.json config:
+'ResNet-50 / ImageNet-synthetic ... data+parameter parallel' — here the
+single-chip number; multi-chip comes from the mesh)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _harness import run_throughput
+
+
+def build(model, batch):
+    from flexflow_tpu.models.resnet import build_resnet
+
+    build_resnet(model, batch_size=batch, num_classes=1000,
+                 height=224, width=224)
+
+
+if __name__ == "__main__":
+    run_throughput(build, metric="resnet50_imagenet_train_throughput",
+                   batch=64, label_classes=1000, spd=10)

@@ -754,12 +754,23 @@ class FFModel:
             final_ops = [o for o in self.graph.ops
                          if any(t.guid == logits_pt.guid for t in o.outputs)]
 
-            def _probability_like(op) -> bool:
-                op_type, params = op.op_type, op.params
-                if op_type == OperatorType.OP_FUSED and params.chain:
-                    # --fusion packs the tail chain into one node; judge by
-                    # the chain's LAST step
-                    op_type, params = params.chain[-1][0], params.chain[-1][1]
+            _SHAPE_ONLY = (OperatorType.OP_RESHAPE, OperatorType.OP_FLAT,
+                           OperatorType.OP_NOOP, OperatorType.OP_IDENTITY)
+
+            def _resolve_tail(op):
+                """The op that produced the VALUES: unpack --fusion chains
+                and skip shape-only steps."""
+                steps = (
+                    [(s[0], s[1]) for s in op.params.chain]
+                    if op.op_type == OperatorType.OP_FUSED and op.params.chain
+                    else [(op.op_type, op.params)]
+                )
+                for op_type, params in reversed(steps):
+                    if op_type not in _SHAPE_ONLY:
+                        return op_type, params
+                return steps[-1]
+
+            def _probability_like(op_type, params) -> bool:
                 if op_type in (OperatorType.OP_SOFTMAX,
                                OperatorType.OP_SIGMOID):
                     return True
@@ -769,17 +780,19 @@ class FFModel:
                 act = getattr(params, "activation", None)
                 return act == ActiMode.AC_MODE_SIGMOID
 
-            if final_ops and not _probability_like(final_ops[0]):
-                import warnings
+            if final_ops:
+                tail_type, tail_params = _resolve_tail(final_ops[0])
+                if not _probability_like(tail_type, tail_params):
+                    import warnings
 
-                warnings.warn(
-                    "cross-entropy losses expect probability outputs (the "
-                    "reference's loss kernels take them; "
-                    "loss_functions.cc) but the model's final op is "
-                    f"{final_ops[0].op_type.name} — raw logits get clipped "
-                    "to [1e-12, 1] and gradients die. End the model with "
-                    "model.softmax(...)."
-                )
+                    warnings.warn(
+                        "cross-entropy losses expect probability outputs "
+                        "(the reference's loss kernels take them; "
+                        "loss_functions.cc) but the model's final op is "
+                        f"{tail_type.name} — raw logits get clipped to "
+                        "[1e-12, 1] and gradients die. End the model with "
+                        "model.softmax(...)."
+                    )
         if self.label_tensor is None:
             label_dt = (
                 DataType.DT_INT32
