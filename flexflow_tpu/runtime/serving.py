@@ -133,10 +133,107 @@ def incremental_generate(
     return out
 
 
+def incremental_beam_generate(
+    model,
+    prompt_ids: np.ndarray,
+    *,
+    num_beams: int = 4,
+    max_new_tokens: int,
+    max_len: Optional[int] = None,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
+) -> np.ndarray:
+    """Beam search over the KV-cache decoder (decoder-only models): the
+    decode step is built at batch=num_beams (build_decode jits for any
+    batch, so no compiled-batch packing), each step feeds ONE position per
+    beam, and on a beam reorder the per-layer caches are gathered along
+    the batch axis on-device. Scores are sums of log-probs (probability
+    and logit output heads both handled — _as_log_probs), no length
+    penalty; samples decode sequentially.
+
+    prompt_ids: (n, prompt_len). Returns (n, prompt_len + max_new_tokens)
+    top beams."""
+    import jax
+
+    assert model.executor is not None, "compile() the model first"
+    prompt_ids = np.asarray(prompt_ids)
+    plen = prompt_ids.shape[1]
+    if max_new_tokens <= 0:
+        return prompt_ids.copy()
+    in_t = model._fit_input_tensors[0]
+    total = plen + max_new_tokens
+    cap = max_len or total
+    assert cap >= total, f"max_len {cap} < prompt+new {total}"
+    init_caches, step = model.executor.build_decode(num_beams, cap)
+    id_dt = in_t.data_type.np_dtype
+
+    outs = []
+    for row in prompt_ids.astype(id_dt):
+        caches = init_caches()
+        beams = np.full((num_beams, total), pad_token_id, id_dt)
+        beams[:, :plen] = row
+        scores = np.full(num_beams, -np.inf)
+        scores[0] = 0.0  # beams identical until the first branch
+        done = np.zeros(num_beams, bool)
+        # prefill: same prompt in every beam slot, one block step
+        block = np.broadcast_to(row, (num_beams, plen)).copy()
+        logits, caches = step(model.state.params, caches, jnp.int32(0),
+                              [jnp.asarray(block)])
+        logp = _as_log_probs(np.asarray(logits)[:, -1])
+        for t in range(plen, total):
+            src_beams, toks, scores = _beam_topk(
+                scores, logp, done, pad_token_id, num_beams
+            )
+            beams = beams[src_beams]
+            beams[:, t] = np.where(done[src_beams], pad_token_id, toks)
+            if eos_token_id is not None:
+                done = done[src_beams] | (beams[:, t] == eos_token_id)
+            # caches follow their beams (identity gathers are common early
+            # on; jnp.take keeps the shuffle on-device)
+            caches = jax.tree_util.tree_map(
+                lambda c: jnp.take(c, jnp.asarray(src_beams.astype(np.int32)),
+                                   axis=0),
+                caches,
+            )
+            if (eos_token_id is not None and done.all()) or t == total - 1:
+                break
+            logits, caches = step(
+                model.state.params, caches, jnp.int32(t),
+                [jnp.asarray(beams[:, t : t + 1])],
+            )
+            logp = _as_log_probs(np.asarray(logits)[:, 0])
+        outs.append(beams[0])
+    return np.stack(outs)
+
+
 def _log_softmax(x: np.ndarray) -> np.ndarray:
     m = x.max(axis=-1, keepdims=True)
     e = np.exp(x - m)
     return (x - m) - np.log(e.sum(axis=-1, keepdims=True))
+
+
+def _as_log_probs(x: np.ndarray) -> np.ndarray:
+    """Model outputs may be PROBABILITIES (the framework convention: CE
+    models end in softmax/sigmoid) or raw logits (imported heads).
+    log-softmax of probabilities is NOT log(p) — it flattens every gap to
+    <1 nat and corrupts beam accumulation — so detect probability rows
+    (non-negative, summing to ~1) and take their log directly."""
+    if (x >= 0).all() and np.allclose(x.sum(axis=-1), 1.0, atol=1e-3):
+        return np.log(np.clip(x, 1e-30, None))
+    return _log_softmax(x)
+
+
+def _beam_topk(scores, logp, done, pad_token_id, num_beams):
+    """One beam-search selection step, shared by beam_generate and
+    incremental_beam_generate: finished beams propagate unchanged via a
+    single pad candidate; top-k via argpartition (O(n), no full sort)."""
+    vocab = logp.shape[-1]
+    cand = scores[:, None] + np.where(done[:, None], -np.inf, logp)
+    for b in np.nonzero(done)[0]:
+        cand[b, pad_token_id] = scores[b]
+    flat = np.argpartition(cand.ravel(), -num_beams)[-num_beams:]
+    flat = flat[np.argsort(cand.ravel()[flat])[::-1]]
+    return flat // vocab, flat % vocab, cand.ravel()[flat]
 
 
 def beam_generate(
@@ -185,22 +282,14 @@ def beam_generate(
         for t in range(steps):
             dec = np.full((bs, dec_len), pad_token_id, beams.dtype)
             dec[:num_beams] = beams
-            logp = _log_softmax(
+            logp = _as_log_probs(
                 np.asarray(fwd(model.state.params, [enc, dec],
                                model.state.net_state))[:num_beams, t]
             )
-            vocab = logp.shape[-1]
-            # finished beams propagate unchanged via a single pad candidate
-            cand = scores[:, None] + np.where(done[:, None], -np.inf, logp)
-            for b in np.nonzero(done)[0]:
-                cand[b, pad_token_id] = scores[b]
-            # top-k via argpartition (O(n), not a full sort of beams*vocab)
-            flat = np.argpartition(cand.ravel(), -num_beams)[-num_beams:]
-            flat = flat[np.argsort(cand.ravel()[flat])[::-1]]
-            src, tok = flat // vocab, flat % vocab
+            src, tok, scores = _beam_topk(scores, logp, done, pad_token_id,
+                                          num_beams)
             beams = beams[src]
             beams[:, t + 1] = tok
-            scores = cand.ravel()[flat]
             done = done[src]
             if eos_token_id is not None:
                 done = done | (tok == eos_token_id)
