@@ -67,6 +67,34 @@ from .optimizers import AdamOptimizer, Optimizer, SGDOptimizer
 from .tensor import Layer, Tensor
 
 
+_SHAPE_ONLY_OPS = (OperatorType.OP_RESHAPE, OperatorType.OP_FLAT,
+                   OperatorType.OP_NOOP, OperatorType.OP_IDENTITY)
+
+
+def _resolve_value_tail(op):
+    """The op that produced an output's VALUES: unpack --fusion chains and
+    skip shape-only steps."""
+    steps = (
+        [(s[0], s[1]) for s in op.params.chain]
+        if op.op_type == OperatorType.OP_FUSED and op.params.chain
+        else [(op.op_type, op.params)]
+    )
+    for op_type, params in reversed(steps):
+        if op_type not in _SHAPE_ONLY_OPS:
+            return op_type, params
+    return steps[-1]
+
+
+def _probability_like_tail(op_type, params) -> bool:
+    """Does this value-producing tail op emit probabilities (in [0, 1])?"""
+    if op_type in (OperatorType.OP_SOFTMAX, OperatorType.OP_SIGMOID):
+        return True
+    # fused activation inside the op (DLRM's final dense has
+    # AC_MODE_SIGMOID, dlrm.cc create_mlp) keeps outputs in (0, 1)
+    act = getattr(params, "activation", None)
+    return act == ActiMode.AC_MODE_SIGMOID
+
+
 def _fetch_global(v) -> np.ndarray:
     """Device value -> host numpy, multi-host safe: an array whose shards
     live on other processes can't be fetched directly (jax refuses), so
@@ -754,35 +782,9 @@ class FFModel:
             final_ops = [o for o in self.graph.ops
                          if any(t.guid == logits_pt.guid for t in o.outputs)]
 
-            _SHAPE_ONLY = (OperatorType.OP_RESHAPE, OperatorType.OP_FLAT,
-                           OperatorType.OP_NOOP, OperatorType.OP_IDENTITY)
-
-            def _resolve_tail(op):
-                """The op that produced the VALUES: unpack --fusion chains
-                and skip shape-only steps."""
-                steps = (
-                    [(s[0], s[1]) for s in op.params.chain]
-                    if op.op_type == OperatorType.OP_FUSED and op.params.chain
-                    else [(op.op_type, op.params)]
-                )
-                for op_type, params in reversed(steps):
-                    if op_type not in _SHAPE_ONLY:
-                        return op_type, params
-                return steps[-1]
-
-            def _probability_like(op_type, params) -> bool:
-                if op_type in (OperatorType.OP_SOFTMAX,
-                               OperatorType.OP_SIGMOID):
-                    return True
-                # fused activation inside the op (DLRM's final dense has
-                # AC_MODE_SIGMOID, dlrm.cc create_mlp) keeps outputs in
-                # (0, 1) — the clip is a no-op and gradients flow
-                act = getattr(params, "activation", None)
-                return act == ActiMode.AC_MODE_SIGMOID
-
             if final_ops:
-                tail_type, tail_params = _resolve_tail(final_ops[0])
-                if not _probability_like(tail_type, tail_params):
+                tail_type, tail_params = _resolve_value_tail(final_ops[0])
+                if not _probability_like_tail(tail_type, tail_params):
                     import warnings
 
                     warnings.warn(
@@ -1151,6 +1153,23 @@ class FFModel:
         )
         self._pending_grads = None
         self._pending_net_state = None
+
+    def output_probability_like(self, output_index: int = -1) -> Optional[bool]:
+        """Whether the model's output carries PROBABILITIES (tail op is
+        softmax/sigmoid or a fused sigmoid activation) rather than raw
+        logits. None when undetermined (not compiled / output untraced).
+        Serving's beam scorer uses this instead of sniffing values."""
+        if self.graph is None:
+            return None
+        outs = self.graph.output_tensors()
+        if not outs:
+            return None
+        pt = outs[output_index]
+        ops = [o for o in self.graph.ops
+               if any(t.guid == pt.guid for t in o.outputs)]
+        if not ops:
+            return None
+        return _probability_like_tail(*_resolve_value_tail(ops[0]))
 
     def get_perf_metrics(self) -> PerfMetrics:
         return self.perf_metrics

@@ -113,16 +113,17 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
     # over the mesh, so the per-chip footprint divides by n_devices.
     b, _, h, _ = q.shape
     kv_len = k.shape[1]
+    seq_degree = data_degree = model_degree = 1
+    if ctx.mesh is not None:
+        seq_degree = ctx.mesh.shape.get("seq", 1)
+        data_degree = ctx.mesh.shape.get("data", 1)
+        model_degree = ctx.mesh.shape.get("model", 1)
     # Only the mesh axes that actually shard the score tensor's dims count:
     # data (batch), model (heads), seq (query positions). Expert/pipe axes
     # don't divide this op's footprint.
     shard = ctx.n_devices
     if ctx.mesh is not None:
-        shard = (
-            ctx.mesh.shape.get("data", 1)
-            * ctx.mesh.shape.get("model", 1)
-            * ctx.mesh.shape.get("seq", 1)
-        )
+        shard = data_degree * model_degree * seq_degree
     score_bytes = 4 * b * h * seq_len * kv_len // max(1, shard)
     # FF_ATTENTION_IMPL ∈ {auto, dense, flash, chunked, ring, ulysses}
     # overrides the size-based dispatch (like picking a cuDNN MHA algo by
@@ -140,10 +141,24 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
         )
     from ..kernels.attention import flash_supported
 
+    # pallas_call has no GSPMD partitioning rule: on a non-trivial mesh the
+    # fused kernel must run under shard_map over the batch/head axes (each
+    # program is independent per (batch, head)); when the seq axis shards
+    # the queries, the ring/ulysses paths own the problem instead.
+    mesh_nontrivial = data_degree * model_degree * seq_degree > 1
+    flash_shardable = (
+        seq_degree == 1
+        and b % data_degree == 0
+        and h % model_degree == 0
+    )
+    # A seq-sharded mesh still wants streaming: the ring path intercepts
+    # below (keeping K/V sharded), and its indivisible fallback lands on
+    # chunked — never on a GSPMD-sharded pallas_call.
     prefer_flash = (
         impl == "auto"
         and jax.default_backend() == "tpu"
         and flash_supported(seq_len, kv_len)
+        and (not mesh_nontrivial or flash_shardable or seq_degree > 1)
     )
     use_streaming = (
         impl in ("flash", "chunked", "ring", "ulysses")
@@ -157,12 +172,6 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
     # seq-sharded mesh, or forced via FF_ATTENTION_IMPL=ring. shard_map
     # needs every sharded dim divisible (GSPMD tolerates uneven shards,
     # the explicit specs here don't) — otherwise fall back to streaming.
-    seq_degree = 1
-    data_degree = model_degree = 1
-    if ctx.mesh is not None:
-        seq_degree = ctx.mesh.shape.get("seq", 1)
-        data_degree = ctx.mesh.shape.get("data", 1)
-        model_degree = ctx.mesh.shape.get("model", 1)
     sp_shardable = (
         seq_degree > 1
         and use_streaming
@@ -217,6 +226,8 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
         # Long sequences: O(seq) memory kernels instead of the s×s score
         # tensor — Pallas flash attention on TPU, chunked scan elsewhere
         # (kernels/attention.py; replaces cuDNN MHA's internal algorithm).
+        import functools
+
         from ..kernels.attention import chunked_attention, local_attention
 
         if impl == "flash" and not flash_supported(seq_len, kv_len):
@@ -227,6 +238,32 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
             )
         if impl == "chunked":
             attn = chunked_attention(q, k, v, causal=params.causal)
+        elif mesh_nontrivial:
+            # On a sharded mesh the Pallas kernel can only run on per-chip
+            # shards: shard_map over batch (data) and heads (model) — each
+            # (batch, head) program is independent, so no collectives. When
+            # those dims don't divide the mesh, chunked attention (plain
+            # jnp, GSPMD-partitionable) is the safe path.
+            if flash_shardable:
+                from jax.sharding import PartitionSpec as P
+
+                from ..parallel.pipeline import shard_map
+
+                spec = P("data", None, "model", None)
+                attn = shard_map(
+                    functools.partial(local_attention, causal=params.causal),
+                    mesh=ctx.mesh,
+                    in_specs=(spec, spec, spec),
+                    out_specs=spec,
+                )(q, k, v)
+            else:
+                if impl == "flash":
+                    warnings.warn(
+                        "FF_ATTENTION_IMPL=flash ignored: batch/heads don't "
+                        "divide the data/model mesh axes (or the seq axis is "
+                        "sharded) — using chunked attention"
+                    )
+                attn = chunked_attention(q, k, v, causal=params.causal)
         else:
             attn = local_attention(q, k, v, causal=params.causal)
     else:

@@ -183,8 +183,14 @@ class CacheParams:
 def _cache_state(params: CacheParams, in_shapes, in_dtypes):
     from .registry import WeightSpec
 
-    return [WeightSpec("cached", tuple(in_shapes[0]), in_dtypes[0], "zero"),
-            WeightSpec("filled", (1,), in_dtypes[0], "zero")]
+    # State buffers are DT_FLOAT regardless of the input dtype: the training
+    # blend (1-alpha)*cached + alpha*x is float math, and a buffer typed to
+    # an integer input would change dtype across the update, breaking the
+    # lax.scan carry structure in build_train_scan. Values are cast on
+    # write and cast back to the input dtype on serve.
+    return [WeightSpec("cached", tuple(in_shapes[0]), DataType.DT_FLOAT,
+                       "zero"),
+            WeightSpec("filled", (1,), DataType.DT_FLOAT, "zero")]
 
 
 def _cache_forward_stateful(params: CacheParams, weights, state, inputs, ctx):
@@ -197,12 +203,14 @@ def _cache_forward_stateful(params: CacheParams, weights, state, inputs, ctx):
         # effective horizon without num_batches x memory)
         alpha = 1.0 / max(1, params.num_batches)
         filled = jnp.minimum(state["filled"] + 1.0, 1.0)
+        xf = x.astype(state["cached"].dtype)
         cached = jnp.where(
             state["filled"] > 0,
-            (1.0 - alpha) * state["cached"] + alpha * x.astype(
-                state["cached"].dtype),
-            x.astype(state["cached"].dtype),
+            (1.0 - alpha) * state["cached"] + alpha * xf,
+            xf,
         )
+        cached = cached.astype(state["cached"].dtype)
+        filled = filled.astype(state["filled"].dtype)
         return [x], {"cached": cached, "filled": filled}
     # inference: serve the cache when it has ever been written
     out = jnp.where(state["filled"] > 0, state["cached"].astype(x.dtype), x)

@@ -120,7 +120,7 @@ def incremental_generate(
     out[:, plen] = nxt
     for t in range(plen, total - 1):
         if eos_token_id is not None and finished.all():
-            return out[:, : t + 1]
+            break  # out is already pad-filled to the documented full width
         tok = out[:, t : t + 1].astype(id_dt)
         logits, caches = step(
             model.state.params, caches, jnp.int32(t), [jnp.asarray(tok)]
@@ -166,6 +166,7 @@ def incremental_beam_generate(
     assert cap >= total, f"max_len {cap} < prompt+new {total}"
     init_caches, step = model.executor.build_decode(num_beams, cap)
     id_dt = in_t.data_type.np_dtype
+    prob_hint = model.output_probability_like()
 
     outs = []
     for row in prompt_ids.astype(id_dt):
@@ -179,7 +180,7 @@ def incremental_beam_generate(
         block = np.broadcast_to(row, (num_beams, plen)).copy()
         logits, caches = step(model.state.params, caches, jnp.int32(0),
                               [jnp.asarray(block)])
-        logp = _as_log_probs(np.asarray(logits)[:, -1])
+        logp = _as_log_probs(np.asarray(logits)[:, -1], prob_hint)
         for t in range(plen, total):
             src_beams, toks, scores = _beam_topk(
                 scores, logp, done, pad_token_id, num_beams
@@ -201,7 +202,7 @@ def incremental_beam_generate(
                 model.state.params, caches, jnp.int32(t),
                 [jnp.asarray(beams[:, t : t + 1])],
             )
-            logp = _as_log_probs(np.asarray(logits)[:, 0])
+            logp = _as_log_probs(np.asarray(logits)[:, 0], prob_hint)
         outs.append(beams[0])
     return np.stack(outs)
 
@@ -212,13 +213,21 @@ def _log_softmax(x: np.ndarray) -> np.ndarray:
     return (x - m) - np.log(e.sum(axis=-1, keepdims=True))
 
 
-def _as_log_probs(x: np.ndarray) -> np.ndarray:
+def _as_log_probs(x: np.ndarray,
+                  probability: Optional[bool] = None) -> np.ndarray:
     """Model outputs may be PROBABILITIES (the framework convention: CE
     models end in softmax/sigmoid) or raw logits (imported heads).
     log-softmax of probabilities is NOT log(p) — it flattens every gap to
-    <1 nat and corrupts beam accumulation — so detect probability rows
-    (non-negative, summing to ~1) and take their log directly."""
-    if (x >= 0).all() and np.allclose(x.sum(axis=-1), 1.0, atol=1e-3):
+    <1 nat and corrupts beam accumulation. The caller passes the answer
+    from the graph's tail op (model.output_probability_like()); the
+    numeric sniff (non-negative rows summing to ~1) is only the fallback
+    for the undetermined case — bf16 softmax heads over large vocabs can
+    drift past its tolerance, so the structural answer wins."""
+    if probability is None:
+        probability = bool(
+            (x >= 0).all() and np.allclose(x.sum(axis=-1), 1.0, atol=1e-3)
+        )
+    if probability:
         return np.log(np.clip(x, 1e-30, None))
     return _log_softmax(x)
 
@@ -268,6 +277,7 @@ def beam_generate(
     n_rows = encoder_ids.shape[0]
     if steps <= 0:
         return np.full((n_rows, 1), start_token_id, dec_t.data_type.np_dtype)
+    prob_hint = model.output_probability_like()
 
     outs = []
     for row in np.asarray(encoder_ids, enc_t.data_type.np_dtype):
@@ -284,7 +294,8 @@ def beam_generate(
             dec[:num_beams] = beams
             logp = _as_log_probs(
                 np.asarray(fwd(model.state.params, [enc, dec],
-                               model.state.net_state))[:num_beams, t]
+                               model.state.net_state))[:num_beams, t],
+                prob_hint,
             )
             src, tok, scores = _beam_topk(scores, logp, done, pad_token_id,
                                           num_beams)

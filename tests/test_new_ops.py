@@ -178,6 +178,39 @@ def test_cache_op_serves_cached_value_at_inference():
     np.testing.assert_allclose(out_other, out_cached, atol=1e-6)
 
 
+def test_cache_op_integer_input_keeps_state_dtype():
+    """Cache state buffers are float regardless of the input dtype: an
+    int32 input's training blend is float math, and a buffer typed to the
+    input would change dtype across the update and break the scan carry
+    structure (ADVICE r1). Training and inference must both run."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu import (AggrMode, DataType, FFConfig, FFModel,
+                              LossType, MetricsType, SGDOptimizer)
+
+    cfg = FFConfig()
+    cfg.batch_size = 4
+    m = FFModel(cfg)
+    ids = m.create_tensor((4, 6), DataType.DT_INT32)
+    t = m.cache(ids, num_batches=2)
+    t = m.embedding(t, 16, 8, AggrMode.AGGR_MODE_SUM)
+    m.dense(t, 2)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+              [MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    rng = np.random.RandomState(0)
+    xs = rng.randint(0, 16, (4, 6)).astype(np.int32)
+    ys = rng.randn(4, 2).astype(np.float32)
+    m.fit(xs, ys, batch_size=4, epochs=2, verbose=False)
+    cache_name = next(op for op in m.executor.topo
+                      if op.op_type.name == "OP_CACHE").name
+    st = m.state.net_state[cache_name]
+    assert st["cached"].dtype == jnp.float32
+    assert st["filled"].dtype == jnp.float32
+    out = m.predict(xs, batch_size=4)
+    assert np.isfinite(out).all()
+
+
 def test_batchnorm_running_stats_update_in_stepwise_loop_and_checkpoint(tmp_path):
     """The stepwise forward/backward/update loop must update running stats
     like fit() does, and checkpoints must carry net_state."""

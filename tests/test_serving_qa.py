@@ -411,6 +411,83 @@ def test_build_decode_cached_per_shape():
     assert m.executor.build_decode(2, 16) is not b1
 
 
+def test_as_log_probs_uses_structural_hint():
+    """The beam scorer must take the probability-vs-logits answer from the
+    graph's tail op, not value sniffing: a logits row that coincidentally
+    looks like probabilities must still go through log_softmax when the
+    model says logits, and a drifted bf16 softmax row (sums to 1±>1e-3)
+    must still be treated as probabilities when the model says so."""
+    from flexflow_tpu.runtime.serving import _as_log_probs, _log_softmax
+
+    # coincidentally probability-like logits (non-negative, sums to 1)
+    x = np.array([[0.7, 0.2, 0.1]], np.float32)
+    np.testing.assert_allclose(_as_log_probs(x, False), _log_softmax(x))
+    # drifted probabilities: sum = 1.01 — the sniff alone would log_softmax
+    p = np.array([[0.72, 0.19, 0.10]], np.float32)
+    np.testing.assert_allclose(
+        _as_log_probs(p, True), np.log(p), rtol=1e-6
+    )
+    # no hint: falls back to the sniff
+    np.testing.assert_allclose(
+        _as_log_probs(x, None), np.log(x), rtol=1e-6
+    )
+
+
+def test_output_probability_like_reads_tail_op():
+    from flexflow_tpu import (DataType, FFConfig, FFModel, LossType,
+                              MetricsType, SGDOptimizer)
+
+    def build(with_softmax):
+        cfg = FFConfig()
+        cfg.batch_size = 2
+        m = FFModel(cfg)
+        x = m.create_tensor((2, 8), DataType.DT_FLOAT)
+        t = m.dense(x, 4)
+        if with_softmax:
+            t = m.softmax(t)
+        m.compile(SGDOptimizer(lr=0.01),
+                  LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY
+                  if with_softmax else
+                  LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+                  [MetricsType.METRICS_MEAN_SQUARED_ERROR])
+        return m
+
+    assert build(True).output_probability_like() is True
+    assert build(False).output_probability_like() is False
+    assert FFModel(FFConfig()).output_probability_like() is None
+
+
+def test_incremental_generate_fixed_width_on_early_eos():
+    """Early EOS must not narrow the documented (batch, prompt+new) return
+    shape — callers index fixed positions."""
+    from flexflow_tpu import (ActiMode, AggrMode, DataType, FFConfig,
+                              FFModel, LossType, MetricsType, SGDOptimizer)
+    from flexflow_tpu.runtime.serving import incremental_generate
+
+    vocab, seq, hidden = 16, 12, 16
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    m = FFModel(cfg)
+    ids = m.create_tensor((2, seq), DataType.DT_INT32)
+    t = m.embedding(ids, vocab, hidden, AggrMode.AGGR_MODE_NONE)
+    t = m.multihead_attention(t, t, t, hidden, 2, causal=True)
+    t = m.dense(t, vocab)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(0, vocab, (2, 4)).astype(np.int32)
+    # find what the model generates first, then declare it EOS so every
+    # sequence finishes immediately
+    free = incremental_generate(m, prompt, max_new_tokens=6, max_len=seq)
+    eos = int(free[0, 4])
+    out = incremental_generate(m, prompt, max_new_tokens=6, max_len=seq,
+                               eos_token_id=eos, pad_token_id=0)
+    assert out.shape == (2, 4 + 6)
+    assert (out[:, :4] == prompt).all()
+
+
 def test_incremental_beam_matches_greedy_at_beam1():
     """incremental_beam_generate(num_beams=1) must reproduce greedy
     KV-cache decoding exactly (same caches, same argmax path)."""

@@ -174,6 +174,81 @@ def test_ring_attention_dispatch_under_sequence_parallel(monkeypatch):
     m_ring.fit(xv, yv, epochs=1, verbose=False)
 
 
+def test_flash_impl_on_sharded_mesh_routes_through_shard_map(monkeypatch):
+    """FF_ATTENTION_IMPL=flash on a dp×tp mesh must not hand GSPMD-sharded
+    tensors to pallas_call (it has no SPMD partitioning rule): the op wraps
+    the kernel in shard_map over the data/model axes. Numerics must match
+    the dense path and training must step."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu import (DataType, FFConfig, FFModel,
+                              LossType, MetricsType, SGDOptimizer)
+
+    def build(tp, impl):
+        monkeypatch.setenv("FF_ATTENTION_IMPL", impl)
+        cfg = FFConfig()
+        cfg.batch_size = 4
+        cfg.tensor_parallel_degree = tp
+        m = FFModel(cfg)
+        x = m.create_tensor((4, 16, 32), DataType.DT_FLOAT)
+        t = m.multihead_attention(x, x, x, 32, 4)
+        t = m.dense(t, 32)
+        m.compile(SGDOptimizer(lr=0.1),
+                  LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+                  [MetricsType.METRICS_MEAN_SQUARED_ERROR])
+        return m
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4, 16, 32).astype(np.float32)
+
+    m_dense = build(tp=1, impl="dense")
+    want = np.asarray(m_dense.executor.build_forward()(
+        m_dense.state.params, [jnp.asarray(xv)]))
+
+    m_flash = build(tp=2, impl="flash")
+    for op_name, ws in m_dense.state.params.items():
+        for w_name, w in ws.items():
+            m_flash.state.params[op_name][w_name] = jnp.asarray(np.asarray(w))
+    got = np.asarray(m_flash.executor.build_forward()(
+        m_flash.state.params, [jnp.asarray(xv)]))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    yv = rng.randn(4, 16, 32).astype(np.float32)
+    m_flash.fit(xv, yv, epochs=1, verbose=False)
+
+
+def test_flash_impl_indivisible_heads_falls_back_to_chunked(monkeypatch):
+    """heads=6 on a model-degree-4 mesh can't shard the Pallas kernel:
+    forced flash must warn and use chunked attention (GSPMD-partitionable)
+    instead of crashing or replicating."""
+    import warnings as _w
+
+    import jax.numpy as jnp
+
+    from flexflow_tpu import (DataType, FFConfig, FFModel,
+                              LossType, MetricsType, SGDOptimizer)
+
+    monkeypatch.setenv("FF_ATTENTION_IMPL", "flash")
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    cfg.tensor_parallel_degree = 4
+    m = FFModel(cfg)
+    x = m.create_tensor((2, 16, 36), DataType.DT_FLOAT)
+    t = m.multihead_attention(x, x, x, 36, 6)
+    m.dense(t, 36)
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        m.compile(SGDOptimizer(lr=0.1),
+                  LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+                  [MetricsType.METRICS_MEAN_SQUARED_ERROR])
+        rng = np.random.RandomState(0)
+        xv = rng.randn(2, 16, 36).astype(np.float32)
+        out = np.asarray(m.executor.build_forward()(
+            m.state.params, [jnp.asarray(xv)]))
+    assert np.isfinite(out).all()
+    assert any("chunked" in str(w.message) for w in rec)
+
+
 def test_ulysses_attention_dispatch_under_sequence_parallel(monkeypatch):
     """FF_ATTENTION_IMPL=ulysses on a seq-sharded mesh routes through the
     all_to_all head-scatter path; numerics must match dense and training
