@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import time
 import warnings
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -69,12 +69,27 @@ class OperatorMeasurer:
     hash (simulator.cc strict_hash_to_operator_cost)."""
 
     def __init__(self, *, repeats: int = 50, warmup: int = 1,
-                 compute_dtype=None):
+                 compute_dtype=None, differenced: Optional[bool] = None):
         self.repeats = repeats
         self.warmup = warmup
         self.compute_dtype = compute_dtype
+        # R-vs-4R differencing cancels the remote-TPU tunnel's ~100ms
+        # dispatch/fetch constant but costs extra compiles per op. Off the
+        # tunnel (cpu tests) dispatch is microseconds: time one scan
+        # directly — same cache semantics, ~6x fewer XLA compiles.
+        # None = decide from the backend at first measurement (deciding
+        # here would force jax backend init at construction time).
+        self._differenced = differenced
         self._cache: Dict[Tuple, Tuple[float, float]] = {}
         self._warned: set = set()
+
+    @property
+    def differenced(self) -> bool:
+        if self._differenced is None:
+            import jax
+
+            self._differenced = jax.default_backend() == "tpu"
+        return self._differenced
 
     def __call__(self, op, view) -> Tuple[float, float]:
         parts = max(1, view.num_parts())
@@ -158,29 +173,32 @@ class OperatorMeasurer:
                 jnp.sum(l.astype(jnp.float32)) for l in leaves
             ) * 1e-9, ()
 
+        def run(body, length):
+            fn = jax.jit(lambda: jax.lax.scan(
+                body, jnp.float32(0.0), None, length=length)[0])
+            for _ in range(self.warmup):
+                float(fn())
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(fn())
+                best = min(best, time.perf_counter() - t0)
+            return best
+
         def per_rep_seconds(body):
             """Time scans of R and 4R reps and difference them: the fixed
             dispatch + device->host fetch (milliseconds through the
             remote-TPU tunnel) cancels, leaving pure per-repetition op
             time (the reference's cudaEvent bracket equivalent). R grows
             until the differenced signal clears the tunnel's jitter, and
-            each point is a min-of-3."""
-            def run(length):
-                fn = jax.jit(lambda: jax.lax.scan(
-                    body, jnp.float32(0.0), None, length=length)[0])
-                for _ in range(self.warmup):
-                    float(fn())
-                best = float("inf")
-                for _ in range(3):
-                    t0 = time.perf_counter()
-                    float(fn())
-                    best = min(best, time.perf_counter() - t0)
-                return best
-
+            each point is a min-of-3. Non-differenced mode (off-tunnel
+            backends) times one scan directly."""
+            if not self.differenced:
+                return max(run(body, R) / R, 1e-9)
             reps = R
             while True:
-                t1 = run(reps)
-                t4 = run(4 * reps)
+                t1 = run(body, reps)
+                t4 = run(body, 4 * reps)
                 signal = t4 - t1
                 if signal > 20e-3 or reps >= 4096:
                     return max(signal / (3 * reps), 1e-9)

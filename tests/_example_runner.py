@@ -1,0 +1,91 @@
+"""Run many example scripts in ONE interpreter (amortizes the ~10s
+jax import + backend init per script on the 1-core test host).
+
+Invoked as `python _example_runner.py <spec.json> <results.json>`; the spec
+lists cases {name, path, argv, cwd, extra_sys_path, timeout}. Each script
+runs via runpy.run_path under its own argv/cwd, isolated from the others'
+sys state; a failure or per-case timeout in one script doesn't stop the
+rest, and results are flushed after every case so a later hard crash
+keeps the finished ones. Results map name -> {ok, output}.
+"""
+import io
+import json
+import os
+import runpy
+import signal
+import sys
+import traceback
+
+DEFAULT_CASE_TIMEOUT = 600
+
+
+class _CaseTimeout(Exception):
+    pass
+
+
+def _on_alarm(signum, frame):
+    raise _CaseTimeout()
+
+
+def _run_case(case):
+    old_cwd = os.getcwd()
+    old_argv = list(sys.argv)
+    old_path = list(sys.path)
+    old_modules = set(sys.modules)
+    script_dir = os.path.dirname(os.path.abspath(case["path"]))
+    buf = io.StringIO()
+    old_out, old_err = sys.stdout, sys.stderr
+    ok, tail = True, ""
+    signal.alarm(int(case.get("timeout", DEFAULT_CASE_TIMEOUT)))
+    try:
+        sys.stdout = sys.stderr = buf
+        if case.get("cwd"):
+            os.chdir(case["cwd"])
+        for p in reversed(case.get("extra_sys_path", [])):
+            sys.path.insert(0, p)
+        # `python script.py` puts the script's dir first on sys.path;
+        # scripts import sibling helpers (_example_args, _mnist) via it
+        sys.path.insert(0, script_dir)
+        sys.argv = [case["path"]] + list(case.get("argv", []))
+        runpy.run_path(case["path"], run_name="__main__")
+    except SystemExit as e:
+        code = e.code if e.code is not None else 0
+        if code != 0:
+            ok, tail = False, f"SystemExit({code})\n"
+    except _CaseTimeout:
+        ok, tail = False, f"timed out after {case.get('timeout', DEFAULT_CASE_TIMEOUT)}s\n"
+    except BaseException:
+        ok, tail = False, traceback.format_exc()
+    finally:
+        signal.alarm(0)
+        sys.stdout, sys.stderr = old_out, old_err
+        os.chdir(old_cwd)
+        sys.argv = old_argv
+        sys.path[:] = old_path
+        # Different example trees ship same-named sibling helpers
+        # (_example_args, _mnist, accuracy); drop modules loaded from this
+        # script's dir so the next case resolves against its OWN tree
+        # instead of this one's sys.modules entry.
+        for name in set(sys.modules) - old_modules:
+            f = getattr(sys.modules[name], "__file__", None)
+            if f and os.path.dirname(os.path.abspath(f)) == script_dir:
+                del sys.modules[name]
+    return {"ok": ok, "output": buf.getvalue()[-8000:] + tail}
+
+
+def main():
+    spec_path, results_path = sys.argv[1], sys.argv[2]
+    signal.signal(signal.SIGALRM, _on_alarm)
+    with open(spec_path) as f:
+        spec = json.load(f)
+    results = {}
+    for case in spec["cases"]:
+        results[case["name"]] = _run_case(case)
+        status = "ok" if results[case["name"]]["ok"] else "FAIL"
+        print(f"[runner] {case['name']}: {status}", flush=True)
+        with open(results_path, "w") as f:  # flush per case: crash-safe
+            json.dump(results, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -6,7 +6,6 @@ flexflow.torch.model, flexflow.onnx.model. These tests run reference-style
 scripts (examples/python/compat/, near-verbatim ports of
 examples/python/native + keras + pytorch examples) against the shim.
 """
-import runpy
 import subprocess
 import sys
 
@@ -80,38 +79,89 @@ def test_type_module():
     assert ft.str_to_enum(ft.ActiMode, "AC_MODE_RELU") is ft.ActiMode.AC_MODE_RELU
 
 
-def _run_example(script, extra=()):
+def _script_batch_results(tmp_path_factory):
+    """All compat + bootcamp scripts in ONE subprocess
+    (tests/_example_runner.py) — a fresh interpreter per script costs ~10s
+    of jax import each on this 1-core host. Bootcamp cases share a workdir
+    in listed order (torch export writes alexnet.ff, the replay reads it)."""
+    import json
     import os
     import pathlib
 
     repo = pathlib.Path(__file__).resolve().parents[1]
-    env = dict(os.environ)
-    env["PYTHONPATH"] = str(repo)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-    out = subprocess.run(
-        [sys.executable, str(repo / "examples/python/compat" / script), *extra],
-        capture_output=True, text=True, timeout=600,
-        cwd=str(repo / "examples/python/compat"), env=env,
+    compat = repo / "examples/python/compat"
+    demo = repo / "bootcamp_demo"
+    base = tmp_path_factory.mktemp("compat_scripts")
+    bootcamp_dir = base / "bootcamp"
+    bootcamp_dir.mkdir()
+
+    compat_scripts = ["mnist_mlp.py", "seq_mnist_mlp.py"]
+    try:
+        import torch  # noqa: F401
+
+        compat_scripts.append("mnist_mlp_torch.py")
+    except ImportError:
+        pass
+    cases = [
+        {"name": f"compat/{s}", "path": str(compat / s), "argv": [],
+         "cwd": str(compat), "extra_sys_path": [str(repo)]}
+        for s in compat_scripts
+    ]
+    try:
+        import PIL  # noqa: F401
+        import torch  # noqa: F401
+
+        cases += [
+            {"name": f"bootcamp/{s}", "path": str(demo / s), "argv": argv,
+             "cwd": str(bootcamp_dir),
+             "extra_sys_path": [str(demo), str(repo)]}
+            for s, argv in (
+                ("torch_alexnet_cifar10.py", []),
+                ("ff_alexnet_cifar10.py", ["-e", "1", "-b", "32"]),
+                ("keras_cnn_cifar10.py", []),
+            )
+        ]
+    except ImportError:
+        pass
+    spec = base / "spec.json"
+    results = base / "results.json"
+    spec.write_text(json.dumps({"cases": cases}))
+    proc = subprocess.run(
+        [sys.executable, str(repo / "tests" / "_example_runner.py"),
+         str(spec), str(results)],
+        capture_output=True, text=True, timeout=2400,
+        env=dict(os.environ, PYTHONPATH=str(repo),
+                 BOOTCAMP_NUM_SAMPLES="96"),
     )
-    assert out.returncode == 0, out.stderr[-2000:]
-    return out.stdout
+    assert results.exists(), (
+        f"script runner died: rc={proc.returncode}\n{proc.stdout}\n"
+        f"{proc.stderr}"
+    )
+    return json.loads(results.read_text())
 
 
-def test_compat_mnist_mlp_trains():
-    out = _run_example("mnist_mlp.py")
-    assert "THROUGHPUT" in out
+@pytest.fixture(scope="module")
+def compat_script_results(tmp_path_factory):
+    return _script_batch_results(tmp_path_factory)
 
 
-def test_compat_keras_sequential_trains():
-    out = _run_example("seq_mnist_mlp.py")
-    assert "THROUGHPUT" in out
+def test_compat_mnist_mlp_trains(compat_script_results):
+    res = compat_script_results["compat/mnist_mlp.py"]
+    assert res["ok"], res["output"]
+    assert "THROUGHPUT" in res["output"]
 
 
-def test_compat_torch_file_roundtrip():
+def test_compat_keras_sequential_trains(compat_script_results):
+    res = compat_script_results["compat/seq_mnist_mlp.py"]
+    assert res["ok"], res["output"]
+    assert "THROUGHPUT" in res["output"]
+
+
+def test_compat_torch_file_roundtrip(compat_script_results):
     pytest.importorskip("torch")
-    out = _run_example("mnist_mlp_torch.py")
-    assert "THROUGHPUT" in out
+    res = compat_script_results["compat/mnist_mlp_torch.py"]
+    assert res["ok"], res["output"]
+    assert "THROUGHPUT" in res["output"]
 
 
 def test_torch_file_format_roundtrip_inproc():
@@ -308,32 +358,14 @@ def test_stepwise_backward_matches_fit_with_regularizer():
     np.testing.assert_allclose(k1, k2, rtol=1e-6, atol=1e-7)
 
 
-def test_bootcamp_demo_scripts(tmp_path):
+def test_bootcamp_demo_scripts(compat_script_results):
     """bootcamp_demo/ (BASELINE.md AlexNet/CIFAR-10 config): torch export →
     .ff replay via PyTorchModel("alexnet.ff").apply, plus the Keras CNN —
     the reference's getter-method API spellings (ffconfig.get_batch_size(),
     ffmodel.set_sgd_optimizer, get_label_tensor) included."""
-    import os
-
     pytest.importorskip("torch")
     pytest.importorskip("PIL")
-
-    root = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
-    demo = os.path.join(root, "bootcamp_demo")
-    env = dict(os.environ, PYTHONPATH=root + os.pathsep +
-               os.environ.get("PYTHONPATH", ""),
-               BOOTCAMP_NUM_SAMPLES="96")
-    for script, args in [
-        ("torch_alexnet_cifar10.py", []),
-        ("ff_alexnet_cifar10.py", ["-e", "1", "-b", "32"]),
-        ("keras_cnn_cifar10.py", []),
-    ]:
-        proc = subprocess.run(
-            [sys.executable, os.path.join(demo, script), *args],
-            cwd=tmp_path, env=dict(env, PYTHONPATH=demo + os.pathsep +
-                                   env["PYTHONPATH"]),
-            capture_output=True, text=True, timeout=560,
-        )
-        assert proc.returncode == 0, (
-            f"{script} failed:\n{proc.stdout}\n{proc.stderr}"
-        )
+    for s in ("torch_alexnet_cifar10.py", "ff_alexnet_cifar10.py",
+              "keras_cnn_cifar10.py"):
+        res = compat_script_results[f"bootcamp/{s}"]
+        assert res["ok"], f"{s} failed:\n{res['output']}"

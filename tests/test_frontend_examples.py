@@ -2,7 +2,12 @@
 tests/multi_gpu_tests.sh runs examples/python/pytorch and /onnx scripts;
 pass criterion "trains without crashing" — SURVEY §4). The ONNX scripts also
 exercise the self-contained protobuf wire codec end to end: export a real
-.onnx file, re-parse it, train."""
+.onnx file, re-parse it, train.
+
+All scripts run in ONE subprocess (tests/_example_runner.py) — a fresh
+interpreter per script costs ~10s of jax import each on this host; the
+parametrized tests below just report each script's recorded result."""
+import json
 import os
 import subprocess
 import sys
@@ -28,19 +33,42 @@ CASES = [
 ]
 
 
-@pytest.mark.parametrize("tree,script", CASES)
-def test_frontend_example(tree, script, tmp_path):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
-    cwd = os.path.join(ROOT, "examples", "python", tree)
+@pytest.fixture(scope="module")
+def frontend_results(tmp_path_factory):
+    base = tmp_path_factory.mktemp("frontend_examples")
+    cases = []
+    for tree, script in CASES:
+        tree_dir = os.path.join(ROOT, "examples", "python", tree)
+        workdir = base / f"{tree}_{script}".replace(".py", "")
+        workdir.mkdir()
+        cases.append({
+            "name": f"{tree}/{script}",
+            "path": os.path.join(tree_dir, script),
+            "argv": ["--epochs", "1", "--num-samples", "96",
+                     "--batch-size", "32"],
+            "cwd": str(workdir),  # exported .ff/.onnx artifacts land here
+            "extra_sys_path": [tree_dir, ROOT],
+        })
+    spec = base / "spec.json"
+    results = base / "results.json"
+    spec.write_text(json.dumps({"cases": cases}))
     proc = subprocess.run(
-        [sys.executable, os.path.join(cwd, script), "--epochs", "1",
-         "--num-samples", "96", "--batch-size", "32"],
-        cwd=tmp_path,  # exported .ff/.onnx artifacts land in tmp
-        env=dict(env, PYTHONPATH=cwd + os.pathsep + env["PYTHONPATH"]),
-        capture_output=True, text=True, timeout=560,
+        [sys.executable, os.path.join(ROOT, "tests", "_example_runner.py"),
+         str(spec), str(results)],
+        capture_output=True, text=True, timeout=2400,
+        env=dict(os.environ, PYTHONPATH=ROOT),
     )
-    assert proc.returncode == 0, f"{tree}/{script} failed:\n{proc.stdout}\n{proc.stderr}"
+    assert results.exists(), (
+        f"example runner died: rc={proc.returncode}\n{proc.stdout}\n"
+        f"{proc.stderr}"
+    )
+    return json.loads(results.read_text())
+
+
+@pytest.mark.parametrize("tree,script", CASES)
+def test_frontend_example(tree, script, frontend_results):
+    res = frontend_results[f"{tree}/{script}"]
+    assert res["ok"], f"{tree}/{script} failed:\n{res['output']}"
 
 
 def test_onnx_proto_roundtrip(tmp_path):

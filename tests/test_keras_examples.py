@@ -1,14 +1,19 @@
 """Keras example-suite smoke tests (reference: tests/multi_gpu_tests.sh runs
 the examples/python/keras scripts; pass criterion is "trains without
 crashing" — SURVEY §4). A representative subset runs here with tiny sizes;
-the full tree is runnable by hand with reference-scale defaults."""
+the full tree is runnable by hand with reference-scale defaults.
+
+All scripts share ONE subprocess (tests/_example_runner.py) to amortize the
+per-interpreter jax import on this host."""
+import json
 import os
 import subprocess
 import sys
 
 import pytest
 
-EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples", "python", "keras")
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+EXAMPLES = os.path.join(ROOT, "examples", "python", "keras")
 
 SCRIPTS = [
     "func_mnist_mlp.py",          # functional API
@@ -21,17 +26,34 @@ SCRIPTS = [
 ]
 
 
-@pytest.mark.parametrize("script", SCRIPTS)
-def test_keras_example(script):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = (
-        os.path.abspath(os.path.join(EXAMPLES, "..", "..", ".."))
-        + os.pathsep
-        + env.get("PYTHONPATH", "")
-    )
+@pytest.fixture(scope="module")
+def keras_results(tmp_path_factory):
+    base = tmp_path_factory.mktemp("keras_examples")
+    cases = [{
+        "name": script,
+        "path": os.path.join(EXAMPLES, script),
+        "argv": ["--epochs", "1", "--num-samples", "96",
+                 "--batch-size", "32"],
+        "cwd": EXAMPLES,
+        "extra_sys_path": [ROOT],
+    } for script in SCRIPTS]
+    spec = base / "spec.json"
+    results = base / "results.json"
+    spec.write_text(json.dumps({"cases": cases}))
     proc = subprocess.run(
-        [sys.executable, script, "--epochs", "1", "--num-samples", "96",
-         "--batch-size", "32"],
-        cwd=EXAMPLES, env=env, capture_output=True, text=True, timeout=420,
+        [sys.executable, os.path.join(ROOT, "tests", "_example_runner.py"),
+         str(spec), str(results)],
+        capture_output=True, text=True, timeout=1800,
+        env=dict(os.environ, PYTHONPATH=ROOT),
     )
-    assert proc.returncode == 0, f"{script} failed:\n{proc.stdout}\n{proc.stderr}"
+    assert results.exists(), (
+        f"example runner died: rc={proc.returncode}\n{proc.stdout}\n"
+        f"{proc.stderr}"
+    )
+    return json.loads(results.read_text())
+
+
+@pytest.mark.parametrize("script", SCRIPTS)
+def test_keras_example(script, keras_results):
+    res = keras_results[script]
+    assert res["ok"], f"{script} failed:\n{res['output']}"
