@@ -351,6 +351,58 @@ def _forward_decode(params, weights, inputs, ctx, cache, t):
     return [out], (k_cache, v_cache)
 
 
+def cross_decode_kv(params: MultiHeadAttentionParams, weights, k_in, v_in,
+                    ctx):
+    """Precompute the FULL encoder-side K/V for cross-attention decode
+    (executor.build_decode init): k_in/v_in are the static encoder
+    outputs (b, s_enc, e). Computed once per sequence — each decode step
+    then attends its query slice against these without re-projecting
+    (the O(1)/token contract for enc-dec serving)."""
+    cdt = ctx.compute_dtype
+    if cdt is not None:
+        k_in, v_in = k_in.astype(cdt), v_in.astype(cdt)
+    wk, wv = weights["wk"], weights["wv"]
+    if cdt is not None:
+        wk, wv = wk.astype(cdt), wv.astype(cdt)
+    k = jnp.einsum("bse,ehd->bshd", k_in, wk,
+                   preferred_element_type=jnp.float32).astype(k_in.dtype)
+    v = jnp.einsum("bse,ehd->bshd", v_in, wv,
+                   preferred_element_type=jnp.float32).astype(k_in.dtype)
+    return (k, v)
+
+
+def _forward_decode_cross(params, weights, q_in, ctx, kv):
+    """Cross-attention decode step: project this block's queries and
+    attend over the precomputed full encoder K/V (cross_decode_kv). No
+    causal mask — every decoder position sees the whole encoder sequence,
+    exactly like the training forward."""
+    cdt = ctx.compute_dtype
+    if cdt is not None:
+        q_in = q_in.astype(cdt)
+    wq, wo = weights["wq"], weights["wo"]
+    if cdt is not None:
+        wq, wo = wq.astype(cdt), wo.astype(cdt)
+    q = jnp.einsum("bse,ehd->bshd", q_in, wq,
+                   preferred_element_type=jnp.float32).astype(q_in.dtype)
+    k, v = kv
+    scale = 1.0 / jnp.sqrt(jnp.asarray(params.qk_head_dim, jnp.float32))
+    scores = jnp.einsum(
+        "bshd,bthd->bhst", q, k.astype(q.dtype),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    attn = jnp.einsum(
+        "bhst,bthd->bshd", probs, v.astype(q.dtype),
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+    out = jnp.einsum("bshd,hde->bse", attn, wo,
+                     preferred_element_type=jnp.float32)
+    out = out.astype(q_in.dtype)
+    if params.bias:
+        out = out + weights["bias_o"].astype(out.dtype)
+    return [out]
+
+
 def init_decode_cache(params: MultiHeadAttentionParams, batch: int,
                       max_len: int, dtype):
     """Fresh (k, v) cache for one MHA op."""

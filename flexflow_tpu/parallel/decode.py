@@ -1,0 +1,463 @@
+"""Incremental (KV-cache) decoding for arbitrary PCGs.
+
+The reference's serving story is a Triton prototype that replays a full
+forward per request (triton/README.md: "incomplete prototype"); it has no
+incremental decode at all. This module gives the TPU build O(1)-per-token
+decoding for ANY causal decoder or encoder-decoder PCG — including graphs
+imported from HF (mt5), where attention is built from primitive ops
+(batch_matmul / softmax / elementwise masks) rather than the fused MHA op.
+
+How: classify every tensor by how the decode position flows through it.
+
+  * live axis    — the axis indexed by decoder position; per step only the
+    newest s0 positions are computed (s0 = 1, or prompt_len at prefill).
+  * prefix axis  — an axis that ranges over ALL positions so far (the
+    key/value axis of attention scores); reads come from a persistent
+    cache of shape cap (= max_len) that each step appends to.
+  * static       — everything not downstream of the decode input: the
+    encoder subgraph, relative-position-bias chains, baked mask
+    constants. Computed ONCE at init (with the static graph inputs) and
+    sliced per step where a static axis aligns with a live/prefix axis.
+
+Axis info propagates forward from the decode input through a per-op-type
+rule table (pointwise ops pass it through; transpose/reshape remap it;
+batch_matmul creates/consumes prefix axes). Ops the rules can't prove
+exact raise NotImplementedError at build time — the same contract as the
+strict seq-pointwise checker this generalizes.
+
+Exactness: a softmax over a prefix axis gets an injected causality/
+validity mask (cache position <= query position), which both enforces
+causal attention and hides the cache's unwritten tail; for causal models
+this reproduces the full forward bit-for-bit modulo float association
+(asserted against the full forward in tests/test_serving_qa.py).
+Caveat: causality of PRIMITIVE-op attention cannot be proven statically
+(the mask lives in baked constants) — the analysis ASSUMES the decoder's
+self-attention is causal and the injected mask enforces it, so a
+bidirectional/prefix-LM import decodes causally instead of erroring; the
+fused-MHA path does reject non-causal self-attention at build time.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ff_types import AggrMode, OperatorType
+from ..ops.registry import FwdCtx, get_op_def
+
+NEG_INF = -1e30
+
+# pointwise in every axis (rank-preserving): the live/prefix axes pass
+# straight through; execution on a slice is the plain forward
+_POINTWISE = frozenset({
+    OperatorType.OP_EW_ADD, OperatorType.OP_EW_SUB, OperatorType.OP_EW_MUL,
+    OperatorType.OP_EW_DIV, OperatorType.OP_EW_MAX, OperatorType.OP_EW_MIN,
+    OperatorType.OP_WHERE,
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisInfo:
+    """Where the decode position lives in a tensor. None = static/full."""
+
+    live: Optional[int] = None
+    prefix: Optional[int] = None
+
+    @property
+    def is_live(self) -> bool:
+        return self.live is not None or self.prefix is not None
+
+
+@dataclasses.dataclass
+class DecodePlan:
+    """Build-time product: everything the jitted step needs."""
+
+    live_ops: List  # topo-ordered ops downstream of the decode input
+    static_ops: List  # topo-ordered ops computable from static inputs
+    info: Dict[int, AxisInfo]  # guid -> axis info (live tensors only)
+    cached_guids: List[int]  # tensors consumed at full prefix length
+    static_needed: List[int]  # static guids consumed by live ops
+    live_len: int  # compiled decoder length L
+    decode_pt: object  # the decode-driving input ParallelTensor
+    requires_cap_le_live_len: bool  # static slicing present
+
+
+def _is_unary_pointwise(op) -> bool:
+    d = get_op_def(op.op_type)
+    # rank-preserving single-input ops whose forward treats every axis as
+    # a batch axis: elementwise unaries, cast, dropout(inference), linear
+    # (contracts the LAST axis only), embedding lookup, identity
+    return op.op_type in (
+        OperatorType.OP_CAST, OperatorType.OP_DROPOUT, OperatorType.OP_NOOP,
+        OperatorType.OP_IDENTITY,
+    ) or (d.num_inputs == 1 and op.op_type.name.startswith(("OP_SCALAR_",))
+          ) or op.op_type in (
+        OperatorType.OP_EXP, OperatorType.OP_LOG, OperatorType.OP_RELU,
+        OperatorType.OP_SIGMOID, OperatorType.OP_TANH, OperatorType.OP_ELU,
+        OperatorType.OP_GELU, OperatorType.OP_RSQRT, OperatorType.OP_SQRT,
+        OperatorType.OP_SIN, OperatorType.OP_COS, OperatorType.OP_POW,
+        OperatorType.OP_PRELU,
+    )
+
+
+def _bcast_axis(in_rank: int, out_rank: int, axis: int) -> int:
+    """Right-aligned broadcast: input axis -> output axis position."""
+    return axis + (out_rank - in_rank)
+
+
+class _Propagator:
+    """Forward axis-info propagation + build-time validation."""
+
+    def __init__(self, live_len: int):
+        self.live_len = live_len
+        self.info: Dict[int, AxisInfo] = {}
+        self.cached: set = set()
+        self.saw_static_slicing = False
+
+    def get(self, guid) -> AxisInfo:
+        return self.info.get(guid, AxisInfo())
+
+    def visit(self, op):
+        t = op.op_type
+        ins = [self.get(x.guid) for x in op.inputs]
+        in_shapes = [tuple(x.material_shape()) for x in op.inputs]
+        out_shapes = [tuple(x.material_shape()) for x in op.outputs]
+
+        def fail(msg):
+            raise NotImplementedError(
+                f"{op.name} ({t.name}): incremental decode can't prove "
+                f"exactness — {msg}"
+            )
+
+        def set_out(i, info):
+            self.info[op.outputs[i].guid] = info
+
+        if t == OperatorType.OP_MULTIHEAD_ATTENTION:
+            q, k, v = ins
+            if q.live != 1 or q.prefix is not None:
+                fail("attention query must be (batch, seq, embed) with the "
+                     "live axis at 1")
+            if k.is_live or v.is_live:
+                # self-attention via the op's own KV cache
+                if not (k.live == 1 and v.live == 1 and k.prefix is None
+                        and v.prefix is None):
+                    fail("attention k/v must be live at axis 1")
+                if not op.params.causal:
+                    fail("needs causal=True (otherwise each position sees "
+                         "the future and the cached prefix is stale)")
+            elif op.params.causal:
+                # the full forward would tril-mask cross scores; the
+                # decode kernel attends the full encoder unmasked
+                fail("causal cross-attention has no decode rule")
+            # cross-attention: k/v static (encoder side) — full-length
+            # K/V computed once, no causal mask (matches the full forward)
+            set_out(0, AxisInfo(live=1))
+            return
+
+        if _is_unary_pointwise(op) or (
+            t == OperatorType.OP_LINEAR
+        ) or (
+            t == OperatorType.OP_EMBEDDING
+            and op.params.aggr == AggrMode.AGGR_MODE_NONE
+        ):
+            a = ins[0]
+            if t == OperatorType.OP_LINEAR and a.live == len(in_shapes[0]) - 1:
+                fail("linear contracts the live axis")
+            if t == OperatorType.OP_EMBEDDING:
+                # (.., L) ids -> (.., L, E): axes keep their positions
+                set_out(0, AxisInfo(live=a.live, prefix=a.prefix))
+                return
+            set_out(0, a)
+            return
+
+        if t in (OperatorType.OP_LAYERNORM,):
+            a = ins[0]
+            nd = len(in_shapes[0])
+            if any(ax % nd in (a.live, a.prefix) for ax in op.params.axes):
+                fail("layernorm normalizes over the live/prefix axis")
+            set_out(0, a)
+            return
+
+        if t in (OperatorType.OP_REDUCE_SUM, OperatorType.OP_REDUCE_MEAN,
+                 OperatorType.OP_MEAN):
+            a = ins[0]
+            nd = len(in_shapes[0])
+            axes = sorted(ax % nd for ax in op.params.axes)
+            if any(ax in (a.live, a.prefix) for ax in axes):
+                fail("reduce over the live/prefix axis")
+            if getattr(op.params, "keepdims", True):
+                set_out(0, a)
+            else:
+                def drop(axis):
+                    if axis is None:
+                        return None
+                    return axis - sum(1 for ax in axes if ax < axis)
+                set_out(0, AxisInfo(live=drop(a.live), prefix=drop(a.prefix)))
+            return
+
+        if t == OperatorType.OP_SOFTMAX:
+            a = ins[0]
+            nd = len(in_shapes[0])
+            dim = op.params.dim % nd
+            if dim == a.live:
+                fail("softmax over the live axis")
+            # softmax over the prefix axis is the attention row softmax;
+            # the step injects the causality/validity mask there
+            set_out(0, a)
+            return
+
+        if t == OperatorType.OP_TRANSPOSE:
+            a = ins[0]
+            perm = list(op.params.perm)
+
+            def remap(axis):
+                return None if axis is None else perm.index(axis)
+            set_out(0, AxisInfo(live=remap(a.live), prefix=remap(a.prefix)))
+            return
+
+        if t in (OperatorType.OP_SQUEEZE, OperatorType.OP_UNSQUEEZE):
+            a = ins[0]
+            nd_in, nd_out = len(in_shapes[0]), len(out_shapes[0])
+            if t == OperatorType.OP_UNSQUEEZE:
+                added = sorted(ax % nd_out for ax in op.params.axes)
+
+                def remap(axis):
+                    if axis is None:
+                        return None
+                    for ad in added:
+                        if ad <= axis:
+                            axis += 1
+                    return axis
+            else:
+                removed = sorted(ax % nd_in for ax in op.params.axes)
+                if any(ax in (a.live, a.prefix) for ax in removed):
+                    fail("squeeze removes the live/prefix axis")
+
+                def remap(axis):
+                    if axis is None:
+                        return None
+                    return axis - sum(1 for ax in removed if ax < axis)
+            set_out(0, AxisInfo(live=remap(a.live), prefix=remap(a.prefix)))
+            return
+
+        if t in (OperatorType.OP_RESHAPE, OperatorType.OP_FLAT):
+            a = ins[0]
+            if a.prefix is not None:
+                fail("reshape of a tensor with a prefix axis")
+            if a.live is None:
+                set_out(0, AxisInfo())
+                return
+            s_in, s_out = in_shapes[0], out_shapes[0]
+            # the live axis must survive as a standalone axis: volumes
+            # before/at it must match some output prefix
+            pre = int(np.prod(s_in[:a.live], dtype=np.int64))
+            out_live = None
+            acc = 1
+            for i, d in enumerate(s_out):
+                if acc == pre and d == s_in[a.live]:
+                    out_live = i
+                    break
+                acc *= d
+            if out_live is None:
+                fail(f"reshape {s_in}->{s_out} splits/merges the live axis")
+            set_out(0, AxisInfo(live=out_live))
+            return
+
+        if t in _POINTWISE:
+            out_rank = len(out_shapes[0])
+            live = prefix = None
+            for inf, s in zip(ins, in_shapes):
+                if inf.live is not None:
+                    al = _bcast_axis(len(s), out_rank, inf.live)
+                    if live is not None and live != al:
+                        fail("two live inputs broadcast to different axes")
+                    live = al
+                if inf.prefix is not None:
+                    ap = _bcast_axis(len(s), out_rank, inf.prefix)
+                    if prefix is not None and prefix != ap:
+                        fail("two prefix inputs broadcast to different axes")
+                    prefix = ap
+            # static operands with a full-length axis aligned to live or
+            # prefix get sliced per step — note that slicing happens
+            for inf, s in zip(ins, in_shapes):
+                if not inf.is_live:
+                    for ax, d in enumerate(s):
+                        pos = _bcast_axis(len(s), out_rank, ax)
+                        if d > 1 and pos in (live, prefix):
+                            if d != self.live_len:
+                                fail(
+                                    f"static operand axis {ax} (size {d}) "
+                                    f"aligns with the decode axis but isn't "
+                                    f"the compiled decoder length "
+                                    f"{self.live_len}"
+                                )
+                            self.saw_static_slicing = True
+            if live is None and prefix is None:
+                fail("elementwise op classified live but no live input")
+            set_out(0, AxisInfo(live=live, prefix=prefix))
+            return
+
+        if t == OperatorType.OP_CONCAT:
+            axis = op.params.axis % len(out_shapes[0])
+            lives = {inf.live for inf in ins}
+            prefixes = {inf.prefix for inf in ins}
+            if len(lives) != 1 or len(prefixes) != 1:
+                fail("concat mixes live and static inputs")
+            a = ins[0]
+            if axis in (a.live, a.prefix):
+                fail("concat along the live/prefix axis")
+            set_out(0, a)
+            return
+
+        if t == OperatorType.OP_SPLIT:
+            a = ins[0]
+            axis = op.params.axis % len(in_shapes[0])
+            if axis in (a.live, a.prefix):
+                fail("split along the live/prefix axis")
+            for i in range(len(op.outputs)):
+                set_out(i, a)
+            return
+
+        if t == OperatorType.OP_BATCHMATMUL:
+            a, b = ins
+            ra, rb = len(in_shapes[0]), len(in_shapes[1])
+            ro = len(out_shapes[0])
+            M, K_a = ra - 2, ra - 1
+            K_b, N = rb - 2, rb - 1
+
+            # batch-dim liveness: both operands sliced at the same step —
+            # behaves like an elementwise op over the batch dims
+            a_batch_live = a.live is not None and a.live < M
+            b_batch_live = b.live is not None and b.live < K_b
+
+            if a.prefix is not None and a.prefix == K_a:
+                # probs @ V: contract the prefix axis against a cached
+                # full-length operand
+                if b.is_live:
+                    if b.live != K_b or b.prefix is not None:
+                        fail("prefix contraction needs the rhs live on its "
+                             "contraction axis")
+                    self.cached.add(op.inputs[1].guid)
+                elif in_shapes[1][K_b] != self.live_len:
+                    fail("prefix contraction against a static rhs of the "
+                         "wrong length")
+                else:
+                    self.saw_static_slicing = True
+                if a.live is not None and a.live != M and not a_batch_live:
+                    fail("unsupported live-axis position in lhs")
+                set_out(0, AxisInfo(live=a.live if a.live != K_a else None))
+                return
+            if a.prefix is not None:
+                fail("lhs prefix axis not on the contraction dim")
+
+            if a.live == K_a or (b.is_live and b.live == K_b):
+                fail("contraction over a live axis without a prefix lhs")
+
+            out_live = None
+            out_prefix = None
+            if a_batch_live or b_batch_live:
+                la = a.live if a_batch_live else None
+                lb = b.live + (ro - rb) if b_batch_live else None
+                if la is not None and lb is not None and la != lb:
+                    fail("lhs/rhs live on different batch axes")
+                out_live = la if la is not None else lb
+            if a.live == M:
+                if out_live is not None:
+                    fail("live axis on both batch and M dims")
+                out_live = ro - 2
+            if b.is_live and b.live == N:
+                # Q @ K^T: rhs is the transposed key matrix, consumed at
+                # full prefix length -> the output's N axis is a prefix
+                if b.prefix is not None:
+                    fail("rhs has both live and prefix axes")
+                self.cached.add(op.inputs[1].guid)
+                out_prefix = ro - 1
+            set_out(0, AxisInfo(live=out_live, prefix=out_prefix))
+            return
+
+        fail("op mixes sequence positions and has no decode rule")
+
+
+def build_plan(topo, input_pts, constants, decode_input: Optional[int] = None):
+    """Classify ops/tensors and validate decodability.
+
+    decode_input: index into input_pts of the decode-driven input; default
+    is the last input (enc-dec convention: (encoder_ids, decoder_ids)).
+    """
+    inputs = list(input_pts)
+    if decode_input is None:
+        decode_input = len(inputs) - 1
+    decode_pt = inputs[decode_input]
+    live_len = decode_pt.material_shape()[1]
+
+    prop = _Propagator(live_len)
+    prop.info[decode_pt.guid] = AxisInfo(live=1)
+
+    live_ops, static_ops = [], []
+    for op in topo:
+        if op.is_parallel_op:
+            # decode runs single-device; parallel ops are identity over an
+            # unsharded value (degree bookkeeping only)
+            src = op.inputs[0].guid
+            if prop.get(src).is_live:
+                prop.info[op.outputs[0].guid] = prop.get(src)
+                live_ops.append(op)
+            else:
+                static_ops.append(op)
+            continue
+        if any(prop.get(x.guid).is_live for x in op.inputs):
+            prop.visit(op)
+            live_ops.append(op)
+        else:
+            static_ops.append(op)
+
+    # static guids live ops actually read
+    live_set = {id(o) for o in live_ops}
+    static_out = set()
+    for op in static_ops:
+        for x in op.outputs:
+            static_out.add(x.guid)
+    needed = []
+    for op in live_ops:
+        for x in op.inputs:
+            if not prop.get(x.guid).is_live and x.guid in static_out:
+                if x.guid not in needed:
+                    needed.append(x.guid)
+    del live_set
+    return DecodePlan(
+        live_ops=live_ops,
+        static_ops=static_ops,
+        info=prop.info,
+        cached_guids=sorted(prop.cached),
+        static_needed=needed,
+        live_len=live_len,
+        decode_pt=decode_pt,
+        requires_cap_le_live_len=prop.saw_static_slicing,
+    )
+
+
+def _slice_aligned(val, info_axis_map, t, s0, cap):
+    """Slice a static/full value per its alignment: live-aligned axes take
+    [t:t+s0], prefix-aligned axes take [0:cap]."""
+    for axis, kind in info_axis_map:
+        if kind == "live":
+            val = jax.lax.dynamic_slice_in_dim(val, t, s0, axis=axis)
+        else:  # prefix
+            val = jax.lax.slice_in_dim(val, 0, cap, axis=axis)
+    return val
+
+
+def _static_alignment(shape, out_rank, out_info: AxisInfo, live_len):
+    """Which axes of a static operand need slicing against a live stream."""
+    plan = []
+    for ax, d in enumerate(shape):
+        pos = _bcast_axis(len(shape), out_rank, ax)
+        if d > 1 and d == live_len:
+            if pos == out_info.live:
+                plan.append((ax, "live"))
+            elif pos == out_info.prefix:
+                plan.append((ax, "prefix"))
+    return plan

@@ -481,95 +481,301 @@ class PCGExecutor:
         return fn
 
     # -- incremental decode (serving KV cache) ------------------------------
-    def build_decode(self, batch: int, max_len: int, cache_dtype=None):
-        """(init_caches, step) for KV-cache autoregressive decoding.
+    def build_decode(self, batch: int, max_len: int, cache_dtype=None,
+                     decode_input: Optional[int] = None):
+        """(init_caches, step) for KV-cache autoregressive decoding over an
+        arbitrary causal decoder or encoder-decoder PCG (the liveness/
+        prefix analysis in parallel/decode.py — graphs imported from HF
+        build attention from primitive batch_matmul/softmax/mask ops and
+        still decode O(1)/token).
 
-        step(params, caches, t, token_inputs) runs ONE position through the
-        graph: seq-pointwise ops (OpDef.seq_pointwise) execute on the
-        (batch, 1, ...) slice unchanged; attention appends this position's
-        K/V to its cache and attends against the prefix
-        (ops/attention.py _forward_decode) — O(1) per token where the
-        reference's serving prototype would replay the full forward.
+        init_caches(params=None, static_inputs=()) computes the static
+        (encoder-side) subgraph once and zero-fills the prefix/KV caches;
+        decoder-only graphs keep the old zero-arg call. step(params,
+        caches, t, [token_block]) runs the newest positions: seq-pointwise
+        ops execute on the (batch, s0, ...) slice, attention appends this
+        block's K/V and attends against the prefix, cross-attention
+        attends the precomputed encoder K/V, and static/constant operands
+        (positional tables, masks) are sliced per step.
 
-        Build-time validation rejects graphs the scheme can't decode
-        exactly: ops that mix sequence positions without a decode rule,
-        non-causal or cross-attention MHA."""
-        from ..ops.attention import init_decode_cache
+        Build-time validation rejects graphs the scheme can't prove exact:
+        ops mixing sequence positions without a decode rule, non-causal
+        self-attention, softmax over the live axis."""
+        from . import decode as dec
+        from ..ops.attention import cross_decode_kv, init_decode_cache
 
-        key = (batch, max_len, cache_dtype)
+        key = (batch, max_len, cache_dtype, decode_input)
         cached = self._decode_builds.get(key)
         if cached is not None:
             return cached
 
-        for guid, (pt, value) in self.constants.items():
-            if len(pt.material_shape()) >= 2:
-                # a rank>=2 constant (baked positional table / mask) would
-                # broadcast against one-position slices at full length
-                raise NotImplementedError(
-                    f"constant tensor {guid} has shape "
-                    f"{pt.material_shape()}: decode can't prove it doesn't "
-                    "span the sequence axis"
-                )
-        cache_ops = []
-        for op in self.topo:
+        plan = dec.build_plan(self.topo, self.input_pts, self.constants,
+                              decode_input)
+        if plan.requires_cap_le_live_len and max_len > plan.live_len:
+            raise NotImplementedError(
+                f"max_len {max_len} > compiled decoder length "
+                f"{plan.live_len}: the graph bakes full-length constants "
+                "(masks/position tables) that can't be extended"
+            )
+        if not plan.info.get(self.logits_pt.guid, dec.AxisInfo()).is_live:
+            raise NotImplementedError(
+                "the graph output does not depend on the decode input"
+            )
+        cdt = cache_dtype or self.compute_dtype or jnp.float32
+        static_pts = [pt for pt in self.input_pts
+                      if pt.guid != plan.decode_pt.guid]
+        ctx = FwdCtx(
+            training=False, rng=None, seq_length=-1,
+            compute_dtype=self.compute_dtype, aux_losses=None,
+            n_devices=1, mesh=None,  # decode is device-local
+        )
+
+        # MHA classification: self-attention (live k/v -> per-op KV cache)
+        # vs cross-attention (static k/v -> precomputed encoder K/V)
+        mha_self, mha_cross = [], []
+        for op in plan.live_ops:
             if op.is_parallel_op:
                 continue
-            d = get_op_def(op.op_type)
-            if d.forward_decode is not None:
-                g0 = op.inputs[0].guid
-                if any(t.guid != g0 for t in op.inputs):
-                    raise NotImplementedError(
-                        f"{op.name}: incremental decode needs "
-                        "self-attention (q/k/v from one tensor)"
-                    )
-                if not op.params.causal:
-                    raise NotImplementedError(
-                        f"{op.name}: incremental decode needs causal=True "
-                        "(otherwise each position sees the future and the "
-                        "cached prefix is stale)"
-                    )
-                cache_ops.append(op)
-            elif not d.is_seq_pointwise(op.params, op):
-                raise NotImplementedError(
-                    f"{op.name} ({op.op_type.name}) mixes sequence "
-                    "positions and has no decode rule"
-                )
+            if op.op_type == OperatorType.OP_MULTIHEAD_ATTENTION:
+                if plan.info.get(op.inputs[1].guid, dec.AxisInfo()).is_live:
+                    mha_self.append(op)
+                else:
+                    mha_cross.append(op)
 
-        cdt = cache_dtype or self.compute_dtype or jnp.float32
-
-        def init_caches():
-            return {
-                op.name: init_decode_cache(op.params, batch, max_len, cdt)
-                for op in cache_ops
-            }
-
-        def step(params, caches, t, batch_inputs):
-            vals = dict(self._input_vals(batch_inputs))
+        def _materialize_constants():
+            """Baked constants, with batch-uniform leading axes collapsed
+            to 1: decode may run at a different batch than compile (beam
+            search runs at num_beams), and constants like HF's extended
+            attention masks carry the compiled batch size — when every
+            row is identical (no per-sample padding was traced) a
+            broadcastable row-1 constant is exact."""
+            vals = {}
             for guid, (pt, value) in self.constants.items():
-                vals[guid] = jnp.asarray(value, pt.data_type.jnp_dtype)
-            ctx = FwdCtx(
-                training=False, rng=None, seq_length=-1,
-                compute_dtype=self.compute_dtype, aux_losses=None,
-                n_devices=1, mesh=None,  # decode is device-local
-            )
-            new_caches = dict(caches)
-            for op in self.topo:
+                shape = tuple(pt.material_shape())
+                if isinstance(value, np.ndarray):
+                    arr = value
+                    if (arr.ndim >= 1 and arr.shape[0] not in (1, batch)
+                            and np.array_equal(arr, np.broadcast_to(
+                                arr[:1], arr.shape), equal_nan=True)):
+                        arr = arr[:1]
+                    vals[guid] = jnp.asarray(arr, pt.data_type.jnp_dtype)
+                else:
+                    if len(shape) >= 1 and shape[0] not in (1, batch):
+                        shape = (1,) + shape[1:]
+                    vals[guid] = jnp.full(
+                        shape, value, pt.data_type.jnp_dtype
+                    )
+            return vals
+
+        def _compute_statics(params, static_arrays):
+            vals = _materialize_constants()
+            for pt, arr in zip(static_pts, static_arrays):
+                vals[pt.guid] = jnp.asarray(arr, pt.data_type.jnp_dtype)
+            for op in plan.static_ops:
                 if op.is_parallel_op:
-                    # decode runs single-device; parallel ops are identity
-                    # over an unsharded value (degree bookkeeping only)
                     vals[op.outputs[0].guid] = vals[op.inputs[0].guid]
                     continue
                 d = get_op_def(op.op_type)
-                ins = [vals[t_.guid] for t_ in op.inputs]
-                w = params.get(op.name, {})
-                if d.forward_decode is not None:
-                    outs, new_caches[op.name] = d.forward_decode(
-                        op.params, w, ins, ctx, caches[op.name], t
-                    )
+                ins = [vals[x.guid] for x in op.inputs]
+                w = (params or {}).get(op.name, {})
+                if (op.op_type == OperatorType.OP_RESHAPE
+                        and tuple(ins[0].shape)
+                        != tuple(op.inputs[0].material_shape())):
+                    # traced reshape params bake the compiled batch size;
+                    # decode may run at a different batch (beam search) —
+                    # recompute the batch axis
+                    target = list(op.outputs[0].material_shape())
+                    target[0] = -1
+                    outs = [jnp.reshape(ins[0], target)]
                 else:
                     outs = d.forward(op.params, w, ins, ctx)
-                for t_, v in zip(op.outputs, outs):
-                    vals[t_.guid] = v
+                for x, v in zip(op.outputs, outs):
+                    vals[x.guid] = v
+            return vals
+
+        needs_params = bool(mha_cross) or any(
+            op.weights for op in plan.static_ops if not op.is_parallel_op
+        )
+
+        # static values whose ONLY live consumers are cross-attention k/v
+        # slots are folded into the precomputed K/V — keeping the raw
+        # encoder hidden states in the cache would waste HBM per layer
+        cross_kv_guids = {op.inputs[i].guid
+                          for op in mha_cross for i in (1, 2)}
+        other_uses = set()
+        for op in plan.live_ops:
+            if op.is_parallel_op or id(op) in {id(o) for o in mha_cross}:
+                continue
+            for x in op.inputs:
+                other_uses.add(x.guid)
+        for op in mha_cross:
+            other_uses.add(op.inputs[0].guid)
+        static_kept = [g for g in plan.static_needed
+                       if g not in cross_kv_guids or g in other_uses]
+
+        def init_caches(params=None, static_inputs=()):
+            assert len(static_inputs) == len(static_pts), (
+                f"need {len(static_pts)} static (non-decode) input arrays, "
+                f"got {len(static_inputs)}"
+            )
+            assert params is not None or not needs_params, (
+                "this graph has encoder-side ops: call "
+                "init_caches(params, static_inputs)"
+            )
+            svals = _compute_statics(params, static_inputs)
+            caches = {
+                "static": {g: svals[g] for g in static_kept},
+                "prefix": {},
+                "mha": {},
+            }
+            for g in plan.cached_guids:
+                pt = next(x for op in plan.live_ops for x in op.outputs
+                          if x.guid == g)
+                shape = list(pt.material_shape())
+                shape[plan.info[g].live] = max_len
+                if plan.info[g].live != 0:
+                    shape[0] = batch  # decode batch, not compile batch
+                caches["prefix"][g] = jnp.zeros(
+                    shape, pt.data_type.jnp_dtype
+                )
+            for op in mha_self:
+                caches["mha"][op.name] = init_decode_cache(
+                    op.params, batch, max_len, cdt
+                )
+            for op in mha_cross:
+                caches["mha"][op.name] = cross_decode_kv(
+                    op.params, params.get(op.name, {}),
+                    svals[op.inputs[1].guid], svals[op.inputs[2].guid],
+                    ctx,
+                )
+            return caches
+
+        info = plan.info
+        cached_set = set(plan.cached_guids)
+        mha_cross_set = {id(op) for op in mha_cross}
+        mha_self_set = {id(op) for op in mha_self}
+
+        def step(params, caches, t, batch_inputs):
+            (tok,) = batch_inputs
+            tok = jnp.asarray(tok, plan.decode_pt.data_type.jnp_dtype)
+            s0 = tok.shape[1]
+            consts = _materialize_constants()
+            statics = dict(caches["static"])
+            vals = {plan.decode_pt.guid: tok}
+            new_caches = {
+                "static": caches["static"],
+                "prefix": dict(caches["prefix"]),
+                "mha": dict(caches["mha"]),
+            }
+
+            def get_static(g):
+                if g in statics:
+                    return statics[g]
+                return consts[g]
+
+            def aligned_input(x, out_rank, out_info):
+                """A live op's input value: live tensors yield their
+                current slice; static/constant operands are sliced where
+                their full-length axes align with the live/prefix axes."""
+                g = x.guid
+                if g in vals:
+                    return vals[g]
+                full = get_static(g)
+                # runtime shape, not the compiled ParallelTensor's — a
+                # batch-collapsed constant differs on axis 0
+                amap = dec._static_alignment(
+                    tuple(full.shape), out_rank, out_info, plan.live_len,
+                )
+                return dec._slice_aligned(full, amap, t, s0, max_len)
+
+            for op in plan.live_ops:
+                if op.is_parallel_op:
+                    vals[op.outputs[0].guid] = vals[op.inputs[0].guid]
+                    continue
+                d = get_op_def(op.op_type)
+                w = params.get(op.name, {})
+                ot = op.op_type
+                out_info = info.get(op.outputs[0].guid, dec.AxisInfo())
+
+                if id(op) in mha_self_set:
+                    ins = [vals[x.guid] for x in op.inputs]
+                    outs, new_caches["mha"][op.name] = d.forward_decode(
+                        op.params, w, ins, ctx, caches["mha"][op.name], t
+                    )
+                elif id(op) in mha_cross_set:
+                    from ..ops.attention import _forward_decode_cross
+
+                    outs = _forward_decode_cross(
+                        op.params, w, vals[op.inputs[0].guid], ctx,
+                        new_caches["mha"][op.name],
+                    )
+                elif ot == OperatorType.OP_BATCHMATMUL:
+                    a_pt, b_pt = op.inputs
+                    # lhs may itself be static (live operand on the rhs)
+                    a = (vals[a_pt.guid] if a_pt.guid in vals
+                         else get_static(a_pt.guid))
+                    b_info = info.get(b_pt.guid, dec.AxisInfo())
+                    if b_pt.guid in cached_set:
+                        b = new_caches["prefix"][b_pt.guid]
+                    elif b_info.is_live:
+                        b = vals[b_pt.guid]
+                    else:
+                        b_full = get_static(b_pt.guid)
+                        a_info = info.get(a_pt.guid, dec.AxisInfo())
+                        rb = b_full.ndim
+                        if a_info.prefix == len(a_pt.material_shape()) - 1:
+                            # probs @ static V of compiled length: keep
+                            # only the cap positions the cache covers
+                            b_full = jax.lax.slice_in_dim(
+                                b_full, 0, max_len, axis=rb - 2
+                            )
+                        b = b_full
+                    outs = [jnp.matmul(
+                        a, b, preferred_element_type=jnp.float32
+                    ).astype(a.dtype)]
+                elif ot == OperatorType.OP_SOFTMAX:
+                    x = vals[op.inputs[0].guid]
+                    nd = x.ndim
+                    dim = op.params.dim % nd
+                    a_info = info[op.inputs[0].guid]
+                    if a_info.prefix is not None and dim == a_info.prefix:
+                        # attention row softmax over the prefix axis:
+                        # inject the causality/validity mask (hides the
+                        # cache's unwritten tail; for causal models this
+                        # matches the graph's own mask)
+                        assert a_info.live is not None, (
+                            "prefix softmax without a live query axis"
+                        )
+                        kv = jax.lax.broadcasted_iota(jnp.int32, x.shape, dim)
+                        qp = t + jax.lax.broadcasted_iota(
+                            jnp.int32, x.shape, a_info.live
+                        )
+                        x = jnp.where(kv <= qp, x, dec.NEG_INF)
+                    outs = [jax.nn.softmax(x, axis=dim)]
+                elif ot in (OperatorType.OP_RESHAPE, OperatorType.OP_FLAT):
+                    x = vals[op.inputs[0].guid]
+                    target = list(op.outputs[0].material_shape())
+                    if out_info.live is not None:
+                        target[out_info.live] = s0
+                    if out_info.live != 0:
+                        target[0] = -1  # batch may differ from compile
+                    outs = [jnp.reshape(x, target)]
+                else:
+                    out_rank = len(op.outputs[0].material_shape())
+                    ins = [aligned_input(x, out_rank, out_info)
+                           for x in op.inputs]
+                    outs = d.forward(op.params, w, ins, ctx)
+
+                for x, v in zip(op.outputs, outs):
+                    vals[x.guid] = v
+                    if x.guid in cached_set:
+                        ax = info[x.guid].live
+                        cache = caches["prefix"][x.guid]
+                        new_caches["prefix"][x.guid] = (
+                            jax.lax.dynamic_update_slice_in_dim(
+                                cache, v.astype(cache.dtype), t, axis=ax
+                            )
+                        )
             return vals[self.logits_pt.guid], new_caches
 
         built = (init_caches, jax.jit(step))

@@ -73,6 +73,62 @@ def greedy_generate(
     return dec[:, : t + 2]
 
 
+def incremental_seq2seq_generate(
+    model,
+    encoder_ids: np.ndarray,
+    *,
+    max_new_tokens: Optional[int] = None,
+    start_token_id: int = 0,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
+) -> np.ndarray:
+    """KV-cache greedy decode for a compiled encoder-decoder FFModel —
+    same signature and token-exact output as greedy_generate, but
+    O(1)/token: the encoder runs ONCE (executor.build_decode computes the
+    static subgraph and the cross-attention K/V at init), each step feeds
+    one decoder position through the liveness-analyzed decoder subgraph
+    (parallel/decode.py). Works on imported HF graphs (mt5) where
+    attention is primitive batch_matmul/softmax ops."""
+    assert model.executor is not None, "compile() the model first"
+    assert len(model._fit_input_tensors) >= 2, (
+        "incremental_seq2seq_generate needs an encoder-decoder model "
+        "(two graph inputs); use incremental_generate for decoder-only"
+    )
+    ex = model.executor
+    enc_t, dec_t = model._fit_input_tensors[:2]
+    bs, dec_len = dec_t.dims[0], dec_t.dims[1]
+    assert tuple(encoder_ids.shape) == tuple(enc_t.dims), (
+        f"encoder_ids shape {tuple(encoder_ids.shape)} != compiled input "
+        f"shape {tuple(enc_t.dims)}"
+    )
+    want = dec_len - 1 if max_new_tokens is None else max_new_tokens
+    steps = min(want, dec_len - 1)
+    dec_dt = dec_t.data_type.np_dtype
+    out = np.full((bs, dec_len), pad_token_id, dec_dt)
+    out[:, 0] = start_token_id
+    if steps <= 0:
+        return out[:, :1]
+    init_caches, step = ex.build_decode(bs, dec_len)
+    caches = init_caches(
+        model.state.params,
+        [np.asarray(encoder_ids, enc_t.data_type.np_dtype)],
+    )
+    finished = np.zeros(bs, bool)
+    for t in range(steps):
+        logits, caches = step(
+            model.state.params, caches, jnp.int32(t),
+            [jnp.asarray(out[:, t : t + 1])],
+        )
+        nxt = np.asarray(logits)[:, -1].argmax(-1)
+        if eos_token_id is not None:
+            nxt = np.where(finished, pad_token_id, nxt)
+            finished |= nxt == eos_token_id
+        out[:, t + 1] = nxt
+        if eos_token_id is not None and finished.all():
+            break
+    return out[:, : t + 2]
+
+
 def incremental_generate(
     model,
     prompt_ids: np.ndarray,
@@ -100,7 +156,7 @@ def incremental_generate(
     cap = max_len or total
     assert cap >= total, f"max_len {cap} < prompt+new {total}"
     init_caches, step = model.executor.build_decode(bs, cap)
-    caches = init_caches()
+    caches = init_caches(model.state.params, [])
     in_t = model._fit_input_tensors[0]
     id_dt = in_t.data_type.np_dtype
 
@@ -142,17 +198,20 @@ def incremental_beam_generate(
     max_len: Optional[int] = None,
     eos_token_id: Optional[int] = None,
     pad_token_id: int = 0,
+    encoder_ids: Optional[np.ndarray] = None,
 ) -> np.ndarray:
-    """Beam search over the KV-cache decoder (decoder-only models): the
-    decode step is built at batch=num_beams (build_decode jits for any
-    batch, so no compiled-batch packing), each step feeds ONE position per
-    beam, and on a beam reorder the per-layer caches are gathered along
-    the batch axis on-device. Scores are sums of log-probs (probability
-    and logit output heads both handled — _as_log_probs), no length
-    penalty; samples decode sequentially.
+    """Beam search over the KV-cache decoder: the decode step is built at
+    batch=num_beams (build_decode jits for any batch, so no
+    compiled-batch packing), each step feeds ONE position per beam, and on
+    a beam reorder the per-layer caches are gathered along the batch axis
+    on-device. Scores are sums of log-probs (probability and logit output
+    heads both handled — _as_log_probs), no length penalty; samples decode
+    sequentially.
 
     prompt_ids: (n, prompt_len). Returns (n, prompt_len + max_new_tokens)
-    top beams."""
+    top beams. For encoder-decoder models pass encoder_ids (n, enc_len)
+    and a prompt of start tokens — each sample's encoder statics and
+    cross-attention K/V are computed once at its init."""
     import jax
 
     assert model.executor is not None, "compile() the model first"
@@ -160,17 +219,27 @@ def incremental_beam_generate(
     plen = prompt_ids.shape[1]
     if max_new_tokens <= 0:
         return prompt_ids.copy()
-    in_t = model._fit_input_tensors[0]
+    in_t = model._fit_input_tensors[-1]
     total = plen + max_new_tokens
     cap = max_len or total
     assert cap >= total, f"max_len {cap} < prompt+new {total}"
     init_caches, step = model.executor.build_decode(num_beams, cap)
     id_dt = in_t.data_type.np_dtype
     prob_hint = model.output_probability_like()
+    if encoder_ids is not None:
+        enc_t = model._fit_input_tensors[0]
+        enc_rows = np.asarray(encoder_ids, enc_t.data_type.np_dtype)
+        assert enc_rows.shape[0] == prompt_ids.shape[0]
 
     outs = []
-    for row in prompt_ids.astype(id_dt):
-        caches = init_caches()
+    for i, row in enumerate(prompt_ids.astype(id_dt)):
+        if encoder_ids is None:
+            caches = init_caches(model.state.params, [])
+        else:
+            enc_block = np.broadcast_to(
+                enc_rows[i], (num_beams,) + enc_rows[i].shape
+            ).copy()
+            caches = init_caches(model.state.params, [enc_block])
         beams = np.full((num_beams, total), pad_token_id, id_dt)
         beams[:, :plen] = row
         scores = np.full(num_beams, -np.inf)
@@ -189,13 +258,17 @@ def incremental_beam_generate(
             beams[:, t] = np.where(done[src_beams], pad_token_id, toks)
             if eos_token_id is not None:
                 done = done[src_beams] | (beams[:, t] == eos_token_id)
-            # caches follow their beams (identity gathers are common early
-            # on; jnp.take keeps the shuffle on-device)
-            caches = jax.tree_util.tree_map(
-                lambda c: jnp.take(c, jnp.asarray(src_beams.astype(np.int32)),
-                                   axis=0),
-                caches,
+            # per-beam caches follow their beams (identity gathers are
+            # common early on; jnp.take keeps the shuffle on-device).
+            # "static" stays untouched: it is beam-invariant and its
+            # constant-derived entries have leading axis 1 — a batch
+            # gather would fill out-of-bounds rows with NaN.
+            idx = jnp.asarray(src_beams.astype(np.int32))
+            gathered = jax.tree_util.tree_map(
+                lambda c: jnp.take(c, idx, axis=0),
+                {"prefix": caches["prefix"], "mha": caches["mha"]},
             )
+            caches = {"static": caches["static"], **gathered}
             if (eos_token_id is not None and done.all()) or t == total - 1:
                 break
             logits, caches = step(

@@ -411,6 +411,188 @@ def test_build_decode_cached_per_shape():
     assert m.executor.build_decode(2, 16) is not b1
 
 
+def _tiny_mt5(batch=2, seq=6, dec_len=5, vocab=64, seed=0):
+    import torch
+    import transformers
+
+    from flexflow_tpu import (DataType, FFConfig, FFModel, LossType,
+                              MetricsType, SGDOptimizer)
+    from flexflow_tpu.frontends.torch.model import PyTorchModel
+
+    torch.manual_seed(seed)
+    cfg_hf = transformers.MT5Config(
+        d_model=32, d_ff=64, num_layers=1, num_decoder_layers=1,
+        num_heads=2, d_kv=16, vocab_size=vocab, decoder_start_token_id=0,
+        pad_token_id=0, eos_token_id=1, dropout_rate=0.0,
+    )
+    mod = transformers.MT5ForConditionalGeneration(cfg_hf).eval()
+    cfg = FFConfig()
+    cfg.batch_size = batch
+    ff = FFModel(cfg)
+    enc_in = ff.create_tensor([batch, seq], DataType.DT_INT64)
+    dec_in = ff.create_tensor([batch, dec_len], DataType.DT_INT64)
+    tm = PyTorchModel(mod, is_hf_model=True,
+                      input_names=["input_ids", "decoder_input_ids"])
+    tm.torch_to_ff(ff, [enc_in, dec_in])
+    ff.compile(optimizer=SGDOptimizer(lr=0.0),
+               loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.METRICS_ACCURACY])
+    tm.load_weights(ff)
+    return ff, mod
+
+
+def test_incremental_seq2seq_matches_full_forward_and_hf():
+    """KV-cache enc-dec decoding on an IMPORTED mt5 graph (attention as
+    primitive batch_matmul/softmax/mask ops): the liveness-analyzed
+    decoder (parallel/decode.py) must produce token-for-token the same
+    output as the O(L^2) full-forward greedy path and as transformers'
+    own generate — the encoder runs once, each token is one decoder
+    step."""
+    pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    import torch
+
+    from flexflow_tpu.runtime.serving import (greedy_generate,
+                                              incremental_seq2seq_generate)
+
+    ff, mod = _tiny_mt5()
+    rng = np.random.RandomState(0)
+    x = rng.randint(2, 64, (2, 6)).astype(np.int64)
+
+    full = greedy_generate(ff, x, max_new_tokens=4, start_token_id=0,
+                           eos_token_id=1, pad_token_id=0)
+    inc = incremental_seq2seq_generate(
+        ff, x, max_new_tokens=4, start_token_id=0, eos_token_id=1,
+        pad_token_id=0,
+    )
+    np.testing.assert_array_equal(full, inc)
+    with torch.no_grad():
+        hf = mod.generate(torch.tensor(x), max_new_tokens=4,
+                          do_sample=False, num_beams=1).numpy()
+    np.testing.assert_array_equal(inc, hf)
+
+
+def test_incremental_beam_matches_full_forward_beam_on_mt5():
+    """Beam search over the incremental enc-dec decoder must pick the
+    same sequences as beam_generate's full-forward beam search (same
+    sum-of-log-probs objective), with the per-sample encoder statics and
+    cross-attention K/V computed once at num_beams batch."""
+    pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+
+    from flexflow_tpu.runtime.serving import (beam_generate,
+                                              incremental_beam_generate)
+
+    ff, _ = _tiny_mt5(batch=4, seed=3, vocab=32)
+    rng = np.random.RandomState(3)
+    x = rng.randint(2, 32, (4, 6)).astype(np.int64)
+
+    want = beam_generate(ff, x, num_beams=3, max_new_tokens=4,
+                         start_token_id=0, pad_token_id=0)
+    starts = np.zeros((4, 1), np.int64)
+    got = incremental_beam_generate(
+        ff, starts, num_beams=3, max_new_tokens=4, max_len=5,
+        encoder_ids=x, pad_token_id=0,
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_incremental_decode_rejects_overlong_cap_with_baked_masks():
+    """mt5 bakes full-length masks/position tables: a decode cap past the
+    compiled decoder length can't be exact and must be rejected."""
+    pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+
+    ff, _ = _tiny_mt5()
+    with pytest.raises(NotImplementedError):
+        ff.executor.build_decode(2, 9)
+
+
+def test_native_cross_attention_decode_matches_full_forward():
+    """Framework-built encoder-decoder (fused MHA ops): cross-attention
+    decodes against the once-computed encoder K/V; per-step logits must
+    match the full causal forward on every prefix."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu import (ActiMode, AggrMode, DataType, FFConfig,
+                              FFModel, LossType, MetricsType, SGDOptimizer)
+
+    vocab, enc_len, dec_len, hidden, heads = 40, 7, 10, 32, 4
+    bs = 2
+    cfg = FFConfig()
+    cfg.batch_size = bs
+    m = FFModel(cfg)
+    enc_ids = m.create_tensor((bs, enc_len), DataType.DT_INT32)
+    dec_ids = m.create_tensor((bs, dec_len), DataType.DT_INT32)
+    enc = m.embedding(enc_ids, vocab, hidden, AggrMode.AGGR_MODE_NONE)
+    enc = m.multihead_attention(enc, enc, enc, hidden, heads)  # bidirectional
+    enc = m.dense(enc, hidden, ActiMode.AC_MODE_RELU)
+    t = m.embedding(dec_ids, vocab, hidden, AggrMode.AGGR_MODE_NONE)
+    t = m.multihead_attention(t, t, t, hidden, heads, causal=True)
+    t = m.multihead_attention(t, enc, enc, hidden, heads)  # cross
+    t = m.dense(t, hidden, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, vocab)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+
+    rng = np.random.RandomState(1)
+    xe = rng.randint(0, vocab, (bs, enc_len)).astype(np.int32)
+    xd = rng.randint(0, vocab, (bs, dec_len)).astype(np.int32)
+
+    full = np.asarray(m.executor.build_forward()(
+        m.state.params, [jnp.asarray(xe), jnp.asarray(xd)]
+    ))
+
+    init_caches, step = m.executor.build_decode(bs, dec_len)
+    caches = init_caches(m.state.params, [xe])
+    for t_ in range(dec_len):
+        logits, caches = step(
+            m.state.params, caches, jnp.int32(t_),
+            [jnp.asarray(xd[:, t_:t_ + 1])],
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits)[:, 0], full[:, t_], rtol=2e-4, atol=2e-4,
+        )
+
+    # block prefill then stepwise — same contract as decoder-only decode
+    caches2 = init_caches(m.state.params, [xe])
+    logits, caches2 = step(
+        m.state.params, caches2, jnp.int32(0), [jnp.asarray(xd[:, :4])]
+    )
+    np.testing.assert_allclose(logits, full[:, :4], rtol=2e-4, atol=2e-4)
+    logits, caches2 = step(
+        m.state.params, caches2, jnp.int32(4), [jnp.asarray(xd[:, 4:5])]
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits)[:, 0], full[:, 4], rtol=2e-4, atol=2e-4,
+    )
+
+
+def test_build_decode_rejects_causal_cross_attention():
+    """The full forward tril-masks causal cross scores; the decode kernel
+    attends the full encoder unmasked, so the combination must be
+    rejected at build time rather than silently diverging."""
+    from flexflow_tpu import (AggrMode, DataType, FFConfig, FFModel,
+                              LossType, MetricsType, SGDOptimizer)
+
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    m = FFModel(cfg)
+    enc_ids = m.create_tensor((2, 6), DataType.DT_INT32)
+    dec_ids = m.create_tensor((2, 6), DataType.DT_INT32)
+    enc = m.embedding(enc_ids, 16, 16, AggrMode.AGGR_MODE_NONE)
+    t = m.embedding(dec_ids, 16, 16, AggrMode.AGGR_MODE_NONE)
+    t = m.multihead_attention(t, t, t, 16, 2, causal=True)
+    t = m.multihead_attention(t, enc, enc, 16, 2, causal=True)  # invalid
+    m.dense(t, 4)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    with pytest.raises(NotImplementedError):
+        m.executor.build_decode(2, 6)
+
+
 def test_as_log_probs_uses_structural_hint():
     """The beam scorer must take the probability-vs-logits answer from the
     graph's tail op, not value sniffing: a logits row that coincidentally
