@@ -112,7 +112,7 @@ def main():
     # the remote-tunnel round-trip latency. The reference's bench likewise
     # replays a Legion trace per iteration (flexflow_cffi.py:2093-2102).
     scan = ex.build_train_scan()
-    spd = 25  # steps per dispatch
+    spd = 50  # steps per dispatch
     xs = [jax.numpy.broadcast_to(x, (spd,) + x.shape)]
     ys = jax.numpy.broadcast_to(y, (spd,) + y.shape)
     keys = jax.random.split(key, spd)
@@ -126,7 +126,7 @@ def main():
         state, partials = scan(state, xs, ys, keys)
     sync(state)
 
-    chunks = 6
+    chunks = 3
     iters = spd * chunks
     t0 = time.perf_counter()
     for _ in range(chunks):

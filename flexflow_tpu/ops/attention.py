@@ -88,31 +88,10 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
     )
     if cdt is not None:
         wq, wk, wv, wo = (w.astype(cdt) for w in (wq, wk, wv, wo))
-    # (b, s, e) @ (e, h, d) -> (b, s, h, d). Three separate gemms: packing
-    # q/k/v into one gemm against a concatenated weight (cuDNN-MHA style)
-    # was tried and wins ~4.5% in isolation but loses ~6% inside the full
-    # jitted train step (the per-step concat + slices cost XLA more in
-    # layout/fusion than the bigger gemm saves).
-    q = jnp.einsum("bse,ehd->bshd", q_in, wq, preferred_element_type=jnp.float32)
-    k = jnp.einsum("bse,ehd->bshd", k_in, wk, preferred_element_type=jnp.float32)
-    v = jnp.einsum("bse,ehd->bshd", v_in, wv, preferred_element_type=jnp.float32)
-    q = q.astype(q_in.dtype)
-    k = k.astype(q_in.dtype)
-    v = v.astype(q_in.dtype)
-
-    seq_len = q.shape[1]
+    b, seq_len, _ = q_in.shape
+    kv_len = k_in.shape[1]
+    h = params.num_heads
     use_dropout = params.dropout > 0.0 and ctx.training and ctx.rng is not None
-    # Dispatch: on TPU the fused Pallas kernel (fwd + bwd in VMEM,
-    # kernels/attention.py) wins whenever its score tile fits — measured
-    # 416 vs 313 samples/s against the XLA dense path on the bench config
-    # (seq 512, hidden 1024 — the dense path moves 134 MB of f32 scores
-    # per layer through HBM). The dense path remains for dropout (rng
-    # threading), non-TPU backends, and as the general fallback; past a
-    # per-chip score-byte budget the O(seq)-memory chunked/ring kernels
-    # take over regardless. Shapes here are global; batch/head axes shard
-    # over the mesh, so the per-chip footprint divides by n_devices.
-    b, _, h, _ = q.shape
-    kv_len = k.shape[1]
     seq_degree = data_degree = model_degree = 1
     if ctx.mesh is not None:
         seq_degree = ctx.mesh.shape.get("seq", 1)
@@ -140,6 +119,58 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
             "dense path (streaming kernels don't thread the dropout rng)"
         )
     from ..kernels.attention import flash_supported
+
+    # Single-chip/unsharded fast path: project q/k/v straight into the
+    # kernel's folded (b*h, s, d) layout — the head transpose rides the
+    # projection einsum for free instead of costing a per-layer HBM
+    # round-trip each way (fold + unfold, fwd and bwd).
+    if (impl in ("auto", "flash")
+            and jax.default_backend() == "tpu"
+            and not use_dropout
+            and flash_supported(seq_len, kv_len)
+            and data_degree * model_degree * seq_degree == 1):
+        from ..kernels.attention import flash_attention_folded
+
+        dqk, dv = params.qk_head_dim, params.v_head_dim
+        qf = jnp.einsum("bse,ehd->bhsd", q_in, wq,
+                        preferred_element_type=jnp.float32)
+        kf = jnp.einsum("bse,ehd->bhsd", k_in, wk,
+                        preferred_element_type=jnp.float32)
+        vf = jnp.einsum("bse,ehd->bhsd", v_in, wv,
+                        preferred_element_type=jnp.float32)
+        qf = qf.astype(q_in.dtype).reshape(b * h, seq_len, dqk)
+        kf = kf.astype(q_in.dtype).reshape(b * h, kv_len, dqk)
+        vf = vf.astype(q_in.dtype).reshape(b * h, kv_len, dv)
+        attn = flash_attention_folded(qf, kf, vf, params.causal)
+        out = jnp.einsum(
+            "bhsd,hde->bse", attn.reshape(b, h, seq_len, dv), wo,
+            preferred_element_type=jnp.float32,
+        ).astype(q_in.dtype)
+        if params.bias:
+            out = out + weights["bias_o"].astype(out.dtype)
+        return [out]
+
+    # (b, s, e) @ (e, h, d) -> (b, s, h, d). Three separate gemms: packing
+    # q/k/v into one gemm against a concatenated weight (cuDNN-MHA style)
+    # was tried and wins ~4.5% in isolation but loses ~6% inside the full
+    # jitted train step (the per-step concat + slices cost XLA more in
+    # layout/fusion than the bigger gemm saves).
+    q = jnp.einsum("bse,ehd->bshd", q_in, wq, preferred_element_type=jnp.float32)
+    k = jnp.einsum("bse,ehd->bshd", k_in, wk, preferred_element_type=jnp.float32)
+    v = jnp.einsum("bse,ehd->bshd", v_in, wv, preferred_element_type=jnp.float32)
+    q = q.astype(q_in.dtype)
+    k = k.astype(q_in.dtype)
+    v = v.astype(q_in.dtype)
+
+    # Dispatch: on TPU the fused Pallas kernel (fwd + bwd in VMEM,
+    # kernels/attention.py) wins whenever its score tile fits — measured
+    # 416 vs 313 samples/s against the XLA dense path on the bench config
+    # (seq 512, hidden 1024 — the dense path moves 134 MB of f32 scores
+    # per layer through HBM). The dense path remains for dropout (rng
+    # threading), non-TPU backends, and as the general fallback; past a
+    # per-chip score-byte budget the O(seq)-memory chunked/ring kernels
+    # take over regardless. Shapes here are global; batch/head axes shard
+    # over the mesh, so the per-chip footprint divides by n_devices.
 
     # pallas_call has no GSPMD partitioning rule: on a non-trivial mesh the
     # fused kernel must run under shard_map over the batch/head axes (each

@@ -164,8 +164,11 @@ class _Propagator:
             and op.params.aggr == AggrMode.AGGR_MODE_NONE
         ):
             a = ins[0]
-            if t == OperatorType.OP_LINEAR and a.live == len(in_shapes[0]) - 1:
-                fail("linear contracts the live axis")
+            if t == OperatorType.OP_LINEAR and (
+                a.live == len(in_shapes[0]) - 1
+                or a.prefix == len(in_shapes[0]) - 1
+            ):
+                fail("linear contracts the live/prefix axis")
             if t == OperatorType.OP_EMBEDDING:
                 # (.., L) ids -> (.., L, E): axes keep their positions
                 set_out(0, AxisInfo(live=a.live, prefix=a.prefix))
@@ -414,9 +417,10 @@ def build_plan(topo, input_pts, constants, decode_input: Optional[int] = None):
         else:
             static_ops.append(op)
 
-    # static guids live ops actually read
-    live_set = {id(o) for o in live_ops}
-    static_out = set()
+    # static guids live ops actually read: outputs of static ops AND
+    # static graph inputs consumed directly (e.g. an explicit attention
+    # mask input added to live scores)
+    static_out = {pt.guid for pt in inputs if pt.guid != decode_pt.guid}
     for op in static_ops:
         for x in op.outputs:
             static_out.add(x.guid)
@@ -426,7 +430,6 @@ def build_plan(topo, input_pts, constants, decode_input: Optional[int] = None):
             if not prop.get(x.guid).is_live and x.guid in static_out:
                 if x.guid not in needed:
                     needed.append(x.guid)
-    del live_set
     return DecodePlan(
         live_ops=live_ops,
         static_ops=static_ops,

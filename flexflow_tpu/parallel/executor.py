@@ -627,6 +627,10 @@ class PCGExecutor:
                 "static": {g: svals[g] for g in static_kept},
                 "prefix": {},
                 "mha": {},
+                # beam-invariant per-op statics (cross-attention encoder
+                # K/V): separate key so serving's beam reorder can skip
+                # gathering them
+                "mha_static": {},
             }
             for g in plan.cached_guids:
                 pt = next(x for op in plan.live_ops for x in op.outputs
@@ -643,7 +647,7 @@ class PCGExecutor:
                     op.params, batch, max_len, cdt
                 )
             for op in mha_cross:
-                caches["mha"][op.name] = cross_decode_kv(
+                caches["mha_static"][op.name] = cross_decode_kv(
                     op.params, params.get(op.name, {}),
                     svals[op.inputs[1].guid], svals[op.inputs[2].guid],
                     ctx,
@@ -666,6 +670,7 @@ class PCGExecutor:
                 "static": caches["static"],
                 "prefix": dict(caches["prefix"]),
                 "mha": dict(caches["mha"]),
+                "mha_static": caches["mha_static"],
             }
 
             def get_static(g):
@@ -707,7 +712,7 @@ class PCGExecutor:
 
                     outs = _forward_decode_cross(
                         op.params, w, vals[op.inputs[0].guid], ctx,
-                        new_caches["mha"][op.name],
+                        caches["mha_static"][op.name],
                     )
                 elif ot == OperatorType.OP_BATCHMATMUL:
                     a_pt, b_pt = op.inputs

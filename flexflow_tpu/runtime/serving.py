@@ -52,18 +52,34 @@ def greedy_generate(
     )
     want = dec_len - 1 if max_new_tokens is None else max_new_tokens
     steps = min(want, dec_len - 1)
+    enc = np.asarray(encoder_ids, enc_t.data_type.np_dtype)
 
-    dec = np.full((bs, dec_len), pad_token_id,
-                  dec_t.data_type.np_dtype)
+    def next_logits(t, dec):
+        return np.asarray(fwd(model.state.params, [enc, dec],
+                              model.state.net_state))[:, t]
+
+    return _greedy_decode_loop(
+        bs, dec_len, steps, next_logits, dec_t.data_type.np_dtype,
+        start_token_id=start_token_id, eos_token_id=eos_token_id,
+        pad_token_id=pad_token_id,
+    )
+
+
+def _greedy_decode_loop(bs, dec_len, steps, next_logits, dec_dt, *,
+                        start_token_id, eos_token_id, pad_token_id):
+    """The shared greedy seq2seq loop: greedy_generate (full forward per
+    token) and incremental_seq2seq_generate (KV-cache step per token)
+    differ ONLY in how position t's logits are produced — sharing the
+    scaffold keeps their documented token-exact equivalence structural.
+    next_logits(t, dec) -> (bs, vocab) values for position t given the
+    decoder buffer so far."""
+    dec = np.full((bs, dec_len), pad_token_id, dec_dt)
     dec[:, 0] = start_token_id
     if steps <= 0:
         return dec[:, :1]
-    enc = np.asarray(encoder_ids, enc_t.data_type.np_dtype)
     finished = np.zeros(bs, bool)
     for t in range(steps):
-        logits = np.asarray(fwd(model.state.params, [enc, dec],
-                                model.state.net_state))
-        nxt = logits[:, t].argmax(-1)
+        nxt = next_logits(t, dec).argmax(-1)
         if eos_token_id is not None:
             nxt = np.where(finished, pad_token_id, nxt)
             finished |= nxt == eos_token_id
@@ -103,30 +119,28 @@ def incremental_seq2seq_generate(
     )
     want = dec_len - 1 if max_new_tokens is None else max_new_tokens
     steps = min(want, dec_len - 1)
-    dec_dt = dec_t.data_type.np_dtype
-    out = np.full((bs, dec_len), pad_token_id, dec_dt)
-    out[:, 0] = start_token_id
     if steps <= 0:
-        return out[:, :1]
+        out = np.full((bs, 1), start_token_id, dec_t.data_type.np_dtype)
+        return out
     init_caches, step = ex.build_decode(bs, dec_len)
     caches = init_caches(
         model.state.params,
         [np.asarray(encoder_ids, enc_t.data_type.np_dtype)],
     )
-    finished = np.zeros(bs, bool)
-    for t in range(steps):
+
+    def next_logits(t, dec):
+        nonlocal caches
         logits, caches = step(
             model.state.params, caches, jnp.int32(t),
-            [jnp.asarray(out[:, t : t + 1])],
+            [jnp.asarray(dec[:, t : t + 1])],
         )
-        nxt = np.asarray(logits)[:, -1].argmax(-1)
-        if eos_token_id is not None:
-            nxt = np.where(finished, pad_token_id, nxt)
-            finished |= nxt == eos_token_id
-        out[:, t + 1] = nxt
-        if eos_token_id is not None and finished.all():
-            break
-    return out[:, : t + 2]
+        return np.asarray(logits)[:, -1]
+
+    return _greedy_decode_loop(
+        bs, dec_len, steps, next_logits, dec_t.data_type.np_dtype,
+        start_token_id=start_token_id, eos_token_id=eos_token_id,
+        pad_token_id=pad_token_id,
+    )
 
 
 def incremental_generate(
@@ -260,15 +274,17 @@ def incremental_beam_generate(
                 done = done[src_beams] | (beams[:, t] == eos_token_id)
             # per-beam caches follow their beams (identity gathers are
             # common early on; jnp.take keeps the shuffle on-device).
-            # "static" stays untouched: it is beam-invariant and its
-            # constant-derived entries have leading axis 1 — a batch
-            # gather would fill out-of-bounds rows with NaN.
+            # "static" and "mha_static" (cross-attention encoder K/V) stay
+            # untouched: they are beam-invariant, and constant-derived
+            # static entries have leading axis 1 — a batch gather would
+            # fill out-of-bounds rows with NaN.
             idx = jnp.asarray(src_beams.astype(np.int32))
             gathered = jax.tree_util.tree_map(
                 lambda c: jnp.take(c, idx, axis=0),
                 {"prefix": caches["prefix"], "mha": caches["mha"]},
             )
-            caches = {"static": caches["static"], **gathered}
+            caches = {"static": caches["static"],
+                      "mha_static": caches["mha_static"], **gathered}
             if (eos_token_id is not None and done.all()) or t == total - 1:
                 break
             logits, caches = step(

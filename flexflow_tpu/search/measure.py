@@ -42,21 +42,35 @@ def _dummy(shape, data_type: DataType, rng: np.random.RandomState):
     return jnp.asarray(rng.rand(*shape).astype(np.float32), dt)
 
 
-def _perturb_first_float(ws: Dict, ins: list, c):
-    """Make one float operand depend on the scan carry so XLA's
-    loop-invariant code motion cannot hoist the measured op out of the
-    repetition loop (the perturbation is ~1e-30, numerically inert)."""
+def _chain_first_float(ws: Dict, ins: list, feedback):
+    """Tie one float operand to the scan carry NONLINEARLY so XLA cannot
+    hoist the measured op out of the repetition loop. A perturbation
+    linear in the carry is not enough: dot distributes over addition, so
+    (x + c*eps) @ W rewrites to the loop-invariant x@W plus a hoisted
+    rank-1 correction, and the 'measurement' collapses to a scale-add
+    (observed on TPU: a 4096x1024x1024 gemm timed at a physically
+    impossible 2879 TF/s). sin(c + iota) is elementwise-nonlinear in c,
+    so even a distributing rewrite must run a same-shape matmul every
+    iteration. The 1e-30 scale keeps it numerically inert."""
+    import jax
     import jax.numpy as jnp
+
+    def tie(a):
+        mix = jax.lax.broadcasted_iota(
+            jnp.float32, a.shape, max(0, a.ndim - 1)
+        )
+        d = jnp.sin(feedback.astype(jnp.float32) + mix) * 1e-30
+        return (a.astype(jnp.float32) + d).astype(a.dtype)
 
     for i, a in enumerate(ins):
         if jnp.issubdtype(a.dtype, jnp.floating):
             ins = list(ins)
-            ins[i] = a + (c * 1e-30).astype(a.dtype)
+            ins[i] = tie(a)
             return ws, ins
     for k in ws:
         if jnp.issubdtype(ws[k].dtype, jnp.floating):
             ws = dict(ws)
-            ws[k] = ws[k] + (c * 1e-30).astype(ws[k].dtype)
+            ws[k] = tie(ws[k])
             return ws, ins
     return ws, ins
 
@@ -154,7 +168,7 @@ class OperatorMeasurer:
                     if jnp.issubdtype(a.dtype, jnp.floating)]
 
         def fwd_body(c, _):
-            ws2, ins2 = _perturb_first_float(weights, inputs, c)
+            ws2, ins2 = _chain_first_float(weights, inputs, c)
             return c + fwd_once(ws2, ins2) * 1e-9, ()
 
         def bwd_body(c, _):
@@ -164,7 +178,7 @@ class OperatorMeasurer:
                     full[i] = v
                 return fwd_once(ws_, full)
 
-            ws2, ins2 = _perturb_first_float(weights, inputs, c)
+            ws2, ins2 = _chain_first_float(weights, inputs, c)
             g = jax.grad(loss, argnums=(0, 1))(
                 ws2, [ins2[i] for i in diffable]
             )

@@ -569,6 +569,74 @@ def test_native_cross_attention_decode_matches_full_forward():
     )
 
 
+def test_decode_static_input_consumed_by_live_op():
+    """A static graph input read DIRECTLY by a decoder-side op (an
+    explicit per-position bias input) must land in the decode step's
+    static cache and be sliced per step — per-step logits match the full
+    forward."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu import (ActiMode, AggrMode, DataType, FFConfig,
+                              FFModel, LossType, MetricsType, SGDOptimizer)
+
+    vocab, dec_len, hidden = 24, 8, 16
+    bs = 2
+    cfg = FFConfig()
+    cfg.batch_size = bs
+    m = FFModel(cfg)
+    dec_ids = m.create_tensor((bs, dec_len), DataType.DT_INT32)
+    bias_in = m.create_tensor((bs, dec_len, hidden), DataType.DT_FLOAT)
+    t = m.embedding(dec_ids, vocab, hidden, AggrMode.AGGR_MODE_NONE)
+    t = m.add(t, bias_in)
+    t = m.multihead_attention(t, t, t, hidden, 2, causal=True)
+    t = m.dense(t, vocab)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+
+    rng = np.random.RandomState(2)
+    xd = rng.randint(0, vocab, (bs, dec_len)).astype(np.int32)
+    xb = rng.randn(bs, dec_len, hidden).astype(np.float32)
+    # input order is creation order: (dec_ids, bias_in) — bias is the
+    # static input, dec_ids drives decode
+    full = np.asarray(m.executor.build_forward()(
+        m.state.params, [jnp.asarray(xd), jnp.asarray(xb)]
+    ))
+    init_caches, step = m.executor.build_decode(
+        bs, dec_len, decode_input=0
+    )
+    caches = init_caches(m.state.params, [xb])
+    for t_ in range(dec_len):
+        logits, caches = step(
+            m.state.params, caches, jnp.int32(t_),
+            [jnp.asarray(xd[:, t_:t_ + 1])],
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits)[:, 0], full[:, t_], rtol=2e-4, atol=2e-4,
+        )
+
+
+def test_build_decode_rejects_linear_over_prefix_axis():
+    """A dense layer contracting the prefix (cache-length) axis would
+    read the cache's unwritten zero tail — must be rejected at build."""
+    from flexflow_tpu import (AggrMode, DataType, FFConfig, FFModel,
+                              LossType, MetricsType, SGDOptimizer)
+
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    m = FFModel(cfg)
+    ids = m.create_tensor((2, 6), DataType.DT_INT32)
+    t = m.embedding(ids, 16, 8, AggrMode.AGGR_MODE_NONE)
+    scores = m.batch_matmul(t, m.transpose(t, (0, 2, 1)))  # (2, 6, 6)
+    probs = m.softmax(scores, axis=-1)
+    m.dense(probs, 4)  # contracts the prefix axis — invalid
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+              [MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    with pytest.raises(NotImplementedError):
+        m.executor.build_decode(2, 6)
+
+
 def test_build_decode_rejects_causal_cross_attention():
     """The full forward tril-masks causal cross scores; the decode kernel
     attends the full encoder unmasked, so the combination must be
