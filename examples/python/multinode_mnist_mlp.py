@@ -11,6 +11,8 @@ scripts/multinode_run.sh or by hand:
     FF_COORDINATOR_ADDRESS=localhost:39211 FF_NUM_PROCESSES=2 \
         FF_PROCESS_ID=1 python examples/python/multinode_mnist_mlp.py
 """
+import os
+
 import numpy as np
 
 from flexflow_tpu import (
@@ -29,10 +31,11 @@ def main():
     pid, nprocs, devices = init_distributed()
     print(f"[proc {pid}/{nprocs}] global devices: {len(devices)}", flush=True)
 
+    bs = int(os.environ.get("FF_TEST_BATCH", "32"))
     cfg = FFConfig()
-    cfg.batch_size = 32
+    cfg.batch_size = bs
     model = FFModel(cfg)
-    x = model.create_tensor((32, 784), DataType.DT_FLOAT)
+    x = model.create_tensor((bs, 784), DataType.DT_FLOAT)
     t = model.dense(x, 256, ActiMode.AC_MODE_RELU)
     t = model.dense(t, 10)
     model.compile(
@@ -41,10 +44,13 @@ def main():
         metrics=[MetricsType.METRICS_ACCURACY,
                  MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY],
     )
-    rng = np.random.RandomState(0)  # same data on every host (DP demo)
+    # same data on every host (DP contract); FF_TEST_DIVERGE deliberately
+    # violates it on non-zero ranks (negative test for the fit() guard)
+    seed = 1 if (os.environ.get("FF_TEST_DIVERGE") and pid != 0) else 0
+    rng = np.random.RandomState(seed)
     xs = rng.rand(256, 784).astype(np.float32)
     ys = rng.randint(0, 10, (256, 1)).astype(np.int32)
-    pm = model.fit(xs, ys, batch_size=32, epochs=2, verbose=pid == 0)
+    pm = model.fit(xs, ys, batch_size=bs, epochs=2, verbose=pid == 0)
     if pid == 0:
         print(f"[proc 0] trained {pm.train_all} samples across "
               f"{nprocs} processes ok", flush=True)

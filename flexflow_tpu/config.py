@@ -76,6 +76,9 @@ class FFConfig:
     # analytic roofline (reference: the Simulator always measures,
     # simulator.cc:489; here it's opt-in because it pays real compiles)
     measure_operator_costs: bool = False
+    # persist measured-search microbenchmarks across runs (reference: the
+    # Simulator's cached measurements); empty = in-memory only
+    measured_cache_path: str = ""
     export_strategy_file: str = ""
     import_strategy_file: str = ""
     export_strategy_computation_graph_file: str = ""
@@ -151,6 +154,8 @@ class FFConfig:
                     self.profiling = True
                 elif a == "--measured-search":
                     self.measure_operator_costs = True
+                elif a == "--measured-cache":
+                    self.measured_cache_path = take(); i += 1
                 elif a == "--search-num-nodes":
                     self.search_num_nodes = int(take()); i += 1
                 elif a == "--search-num-workers":

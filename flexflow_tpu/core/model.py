@@ -872,6 +872,7 @@ class FFModel:
                 compute_dtype=(
                     jnp.bfloat16 if cfg.allow_mixed_precision else None
                 ),
+                cache_path=cfg.measured_cache_path or None,
             )
         sh = SearchHelper(cost_model)
         degrees = []
@@ -881,14 +882,26 @@ class FFModel:
             d *= 2
         budget = cfg.search_budget if cfg.search_budget > 0 else 10
         xfers = generate_all_pcg_xfers(degrees or [1], cfg)
-        if cfg.substitution_json_path:
-            # reference: --substitution-json declarative rules
-            from .substitution_loader import (
-                load_rule_collection_from_path,
-                rules_to_substitutions,
-            )
+        # declarative rules: --substitution-json, or the shipped collection
+        # (reference loads substitutions/graph_subst_3_v2.json by default;
+        # ours is search/substitutions/graph_subst_tpu_v1.json — it adds
+        # per-op partition sandwiches and column-parallel matmul, which
+        # the programmatic xfers don't express)
+        import os as _os
 
+        from ..search.substitution_loader import (
+            default_rules_path,
+            load_rule_collection_from_path,
+            rules_to_substitutions,
+        )
+
+        if cfg.substitution_json_path:
+            # explicit --substitution-json: a missing file must raise, not
+            # silently fall back to the bundled defaults
             rules = load_rule_collection_from_path(cfg.substitution_json_path)
+            xfers = xfers + rules_to_substitutions(rules)
+        elif _os.path.exists(default_rules_path()):
+            rules = load_rule_collection_from_path(default_rules_path())
             xfers = xfers + rules_to_substitutions(rules)
         gsh = GraphSearchHelper(
             sh,
@@ -925,6 +938,34 @@ class FFModel:
     # ------------------------------------------------------------------
     # training loop (reference: flexflow_cffi.py:2058 fit)
     # ------------------------------------------------------------------
+    def _assert_same_global_batch(self, xs, y, bs: int) -> None:
+        """Multi-host contract (runtime/distributed.py): every process
+        feeds the SAME global batch. A diverging feed silently corrupts
+        training — each process contributes its local shard of what it
+        BELIEVES is the global array and no error ever surfaces — and an
+        uneven batch count desyncs the collectives into a hang. Verify a
+        cheap signature (dataset size, batch size, first-batch checksums)
+        across processes before training and fail loudly on mismatch."""
+        from jax.experimental import multihost_utils
+
+        first = next(self._batches(list(xs) + [y], bs))
+        sig = [float(bs), float(xs[0].shape[0])]
+        for a in first:
+            arr = np.asarray(a)
+            head = arr.reshape(-1)[: 4096]
+            sig += [
+                float(np.sum(arr.astype(np.float64))),
+                float(np.sum(np.abs(head.astype(np.float64)))),
+            ]
+        multihost_utils.assert_equal(
+            np.asarray(sig, np.float32),
+            fail_message=(
+                "multi-host contract violated: every process must feed the "
+                "SAME global batch and dataset (runtime/distributed.py) — "
+                "rank data/batch signatures differ"
+            ),
+        )
+
     def _batches(self, arrays: List[np.ndarray], batch_size: int):
         n = arrays[0].shape[0]
         nb = n // batch_size
@@ -971,6 +1012,8 @@ class FFModel:
         spd = max(1, self.config.iterations_per_dispatch)
         scan_fn = self.executor.build_train_scan() if spd > 1 else None
         self.perf_metrics = PerfMetrics()
+        if jax.process_count() > 1:
+            self._assert_same_global_batch(xs, y, bs)
         start = time.time()
         num_samples = 0
         for epoch in range(ep):

@@ -126,6 +126,217 @@ class PCGExecutor:
         self._fwd = None
         self._decode_builds = {}
         self._seq_len_cache = {}  # ("fwd"|"grad", seq_length) -> jitted fn
+        # generalized pipeline: a pipe mesh axis with no block-stack op
+        # means the graph itself must be stage-partitioned (CNNs,
+        # non-uniform transformers — parallel/pipeline.py gpipe_pcg)
+        self.pipeline_plan = None
+        pipe = mesh.shape.get("pipe", 1) if mesh is not None else 1
+        if pipe > 1 and not any(
+            op.op_type == OperatorType.OP_BLOCK_STACK for op in self.topo
+        ):
+            self.pipeline_plan = self._plan_pcg_pipeline(pipe)
+
+    # -- generalized pipeline planning --------------------------------------
+    def _plan_pcg_pipeline(self, n_stages: int):
+        """Partition the compute graph into `n_stages` contiguous stages
+        balanced by analytic op cost ("the search proposes the cut"), and
+        describe each cut's boundary tensors. Falls back to None (warn)
+        when the graph can't be pipelined exactly."""
+        import warnings
+
+        from ..search.cost_model import op_bytes, op_flops
+        from ..search.machine_model import MachineModel
+        from .pipeline import PcgPipelinePlan, balanced_linear_partition
+
+        ops = [o for o in self.topo if not o.is_parallel_op]
+        if len(ops) < n_stages:
+            warnings.warn("pipeline: fewer compute ops than stages — "
+                          "running unpipelined")
+            return None
+        for op in ops:
+            d = get_op_def(op.op_type)
+            if d.state_spec is not None or op.op_type in (
+                OperatorType.OP_GROUP_BY, OperatorType.OP_AGGREGATE,
+                OperatorType.OP_AGG_SPEC, OperatorType.OP_CACHE,
+            ):
+                warnings.warn(
+                    f"pipeline: {op.op_type.name} (stateful/aux-loss op) "
+                    "can't cross the GPipe schedule — running unpipelined"
+                )
+                return None
+        machine = MachineModel()
+        costs = [
+            machine.compute_cost(op_flops(o), op_bytes(o)) for o in ops
+        ]
+        bounds = balanced_linear_partition(costs, n_stages)
+        stages = [ops[bounds[i]:bounds[i + 1]]
+                  for i in range(len(bounds) - 1)]
+        stages = [s for s in stages if s]
+        if len(stages) < n_stages:
+            warnings.warn("pipeline: degenerate stage partition — "
+                          "running unpipelined")
+            return None
+
+        stage_of = {}
+        for si, sops in enumerate(stages):
+            for o in sops:
+                stage_of[o.guid] = si
+        # parallel ops (degree bookkeeping) are identity device-local:
+        # resolve their outputs back to the producing compute tensor
+        alias: Dict[int, int] = {}
+        for op in self.topo:
+            if op.is_parallel_op:
+                src = alias.get(op.inputs[0].guid, op.inputs[0].guid)
+                for t in op.outputs:
+                    alias[t.guid] = src
+
+        def resolve(g):
+            return alias.get(g, g)
+
+        # graph inputs must all enter at stage 0 (they are injected there)
+        input_guids = {p.guid for p in self.input_pts}
+        for op in ops:
+            for t in op.inputs:
+                if resolve(t.guid) in input_guids and stage_of[op.guid] != 0:
+                    warnings.warn(
+                        "pipeline: a graph input is consumed past stage 0 "
+                        "— running unpipelined"
+                    )
+                    return None
+
+        batch = self.input_pts[0].material_shape()[0]
+        consumers_stage: Dict[int, int] = {}
+        for op in ops:
+            for t in op.inputs:
+                g = resolve(t.guid)
+                consumers_stage[g] = max(
+                    consumers_stage.get(g, -1), stage_of[op.guid]
+                )
+        cuts = []
+        buf_elems = 0
+        for s in range(len(stages) - 1):
+            cut = []
+            total = 0
+            for op in ops:
+                if stage_of[op.guid] > s:
+                    continue
+                for t in op.outputs:
+                    if consumers_stage.get(t.guid, -1) <= s:
+                        continue
+                    shape = tuple(t.material_shape())
+                    if not shape or shape[0] != batch:
+                        warnings.warn(
+                            "pipeline: a cut tensor is not batch-leading "
+                            "— running unpipelined"
+                        )
+                        return None
+                    if not np.issubdtype(t.data_type.np_dtype, np.floating):
+                        warnings.warn(
+                            "pipeline: non-float cut tensor — running "
+                            "unpipelined"
+                        )
+                        return None
+                    cut.append((t.guid, shape[1:], t.data_type.jnp_dtype))
+                    n = 1
+                    for d_ in shape[1:]:
+                        n *= d_
+                    total += n
+            cuts.append(cut)
+            buf_elems = max(buf_elems, total)
+        out_pt = self.logits_pt
+        return PcgPipelinePlan(
+            stages=stages,
+            cuts=cuts,
+            buf_elems=buf_elems,
+            out_guid=resolve(out_pt.guid),
+            out_shape=tuple(out_pt.material_shape()),
+            out_dtype=out_pt.data_type.jnp_dtype,
+            n_stages=len(stages),
+            alias=alias,
+        )
+
+    def _pipeline_stage_runners(self, training: bool, rng):
+        """One runner per stage: executes that stage's ops exactly like
+        apply()'s walk, minus sharding constraints (runners execute inside
+        shard_map on device-local values)."""
+        compute_index = {}
+        idx = 0
+        for op in self.topo:
+            if not op.is_parallel_op:
+                compute_index[op.guid] = idx
+                idx += 1
+
+        alias = getattr(self.pipeline_plan, "alias", {})
+
+        def make_runner(sops):
+            def run(params, vals, tick):
+                consts = {}
+                for guid, (pt, value) in self.constants.items():
+                    if isinstance(value, np.ndarray):
+                        consts[guid] = jnp.asarray(
+                            value, pt.data_type.jnp_dtype
+                        )
+                    else:
+                        consts[guid] = jnp.full(
+                            pt.material_shape(), value,
+                            pt.data_type.jnp_dtype,
+                        )
+                vals = dict(vals)
+                for op in sops:
+                    d = get_op_def(op.op_type)
+                    ins = []
+                    for t in op.inputs:
+                        g = alias.get(t.guid, t.guid)
+                        if g in vals:
+                            ins.append(vals[g])
+                        else:
+                            ins.append(consts[g])
+                    # fold the tick too: each micro-batch must draw its own
+                    # dropout mask (one shared mask would correlate the
+                    # micro-batches vs the unpipelined path)
+                    op_rng = (
+                        jax.random.fold_in(
+                            jax.random.fold_in(rng, compute_index[op.guid]),
+                            tick,
+                        )
+                        if rng is not None else None
+                    )
+                    ctx = FwdCtx(
+                        training=training, rng=op_rng, seq_length=-1,
+                        compute_dtype=self.compute_dtype, aux_losses=None,
+                        n_devices=1, mesh=None,  # device-local inside shard_map
+                    )
+                    outs = d.forward(
+                        op.params, params.get(op.name, {}), ins, ctx
+                    )
+                    for t, v in zip(op.outputs, outs):
+                        vals[t.guid] = v
+                return vals
+            return run
+
+        return [make_runner(s) for s in self.pipeline_plan.stages]
+
+    def _apply_pipelined(self, params, inputs: Dict[int, jax.Array], *,
+                         training: bool, rng):
+        """Forward through the generalized GPipe schedule; returns
+        {logits_guid: value} (micro-batched stages; weights replicated
+        over the pipe axis)."""
+        from .pipeline import gpipe_pcg
+
+        plan = self.pipeline_plan
+        # input value order: resolve via a guid->value map; parallel ops
+        # on inputs (degree bookkeeping) are identity device-local
+        guids = [pt.guid for pt in self.input_pts]
+        arrays = [inputs[g] for g in guids]
+        out = gpipe_pcg(
+            plan,
+            self._pipeline_stage_runners(training, rng),
+            params,
+            arrays,
+            guids,
+            self.mesh,
+        )
+        return {plan.out_guid: out, self.logits_pt.guid: out}
 
     # -- parameter init (reference: initializer Legion tasks per weight) ----
     def init_params(self) -> Dict[str, Dict[str, jax.Array]]:
@@ -213,6 +424,19 @@ class PCGExecutor:
         Differentiable aux losses (MoE balance) are appended to aux_out;
         stateful ops read net_state and write updates into net_out (the
         train step threads both; eval passes net_state read-only)."""
+        if self.pipeline_plan is not None:
+            if seq_length >= 0:
+                raise NotImplementedError(
+                    "per-iteration seq_length truncation changes the cut "
+                    "tensor shapes and is not supported with the "
+                    "generalized pipeline (pipeline_parallel_degree > 1 on "
+                    "a non-block-stack graph)"
+                )
+            # generalized GPipe over the stage-partitioned graph; returns
+            # only the output tensor (stage internals live per-device)
+            return self._apply_pipelined(
+                params, inputs, training=training, rng=rng
+            )
         vals: Dict[int, jax.Array] = dict(inputs)
         for guid, (pt, value) in self.constants.items():
             if isinstance(value, np.ndarray):  # baked array constant

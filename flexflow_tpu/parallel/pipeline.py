@@ -132,3 +132,219 @@ def gpipe_spmd(
         out_specs=x_spec,
     )
     return fn(stacked_params, x)
+
+
+# ---------------------------------------------------------------------------
+# Generalized pipeline over an ARBITRARY PCG (non-uniform models, CNNs)
+# ---------------------------------------------------------------------------
+# The block-stack path above needs identical layers (stage placement = a
+# sharding of stacked weights). For an arbitrary op chain the stages are
+# heterogeneous: different subgraphs, different activation shapes. Under
+# SPMD that becomes: every device runs `lax.switch` over its stage index
+# (each branch = one stage's subgraph), and inter-stage activations travel
+# in a FIXED-SIZE flat f32 buffer (padded to the widest cut) so ppermute
+# has one uniform carrier type. Weights stay replicated over the pipe axis
+# — this trades the block-stack path's weight-memory sharding for
+# generality (compute still pipelines; the reference has neither:
+# OP_PIPELINE is enum-only, ffconst.h:158).
+
+import dataclasses
+from typing import Any, List, Tuple
+
+
+@dataclasses.dataclass
+class PcgPipelinePlan:
+    """Stage partition of a PCG's compute ops (contiguous in topo order)."""
+
+    stages: List[List]  # per stage: PCGOps
+    # per cut s (between stage s and s+1): [(guid, shape_wo_batch, dtype)]
+    cuts: List[List[Tuple[int, Tuple[int, ...], Any]]]
+    buf_elems: int  # flat f32 elems per sample, max over cuts + output
+    out_guid: int
+    out_shape: Tuple[int, ...]  # global shape
+    out_dtype: Any
+    n_stages: int
+    # parallel-op output guid -> producing compute tensor guid (identity
+    # bookkeeping resolved at plan time)
+    alias: dict = dataclasses.field(default_factory=dict)
+
+
+def balanced_linear_partition(costs: List[float], k: int) -> List[int]:
+    """Contiguous partition of `costs` into k groups minimizing the max
+    group sum (classic linear-partition DP) — this is how "the search
+    proposes the cut": op costs come from the analytic cost model.
+    Returns cut indices: group j = ops[cut[j]:cut[j+1]]."""
+    n = len(costs)
+    k = min(k, n)
+    prefix = [0.0]
+    for c in costs:
+        prefix.append(prefix[-1] + c)
+
+    def group(a, b):
+        return prefix[b] - prefix[a]
+
+    INF = float("inf")
+    dp = [[INF] * (k + 1) for _ in range(n + 1)]
+    cut = [[0] * (k + 1) for _ in range(n + 1)]
+    dp[0][0] = 0.0
+    for i in range(1, n + 1):
+        for j in range(1, min(i, k) + 1):
+            for m in range(j - 1, i):
+                v = max(dp[m][j - 1], group(m, i))
+                if v < dp[i][j]:
+                    dp[i][j] = v
+                    cut[i][j] = m
+    bounds = [n]
+    i, j = n, k
+    while j > 0:
+        i = cut[i][j]
+        bounds.append(i)
+        j -= 1
+    return list(reversed(bounds))
+
+
+def gpipe_pcg(
+    plan: PcgPipelinePlan,
+    stage_runners: List,  # stage s: fn(params, vals_dict) -> vals_dict
+    params,
+    input_arrays: List,  # global graph inputs, batch-leading
+    input_guids: List[int],
+    mesh,
+    *,
+    n_micro: int = 0,
+    axis_name: str = "pipe",
+    data_axis: str = "data",
+):
+    """Run the planned stages as a GPipe schedule. Inputs are injected at
+    stage 0 (ints allowed — they bypass the f32 cut buffer); the final
+    output returns replicated over the pipe axis."""
+    n_stages = plan.n_stages
+    dp = mesh.shape.get(data_axis, 1)
+    batch = input_arrays[0].shape[0]
+    b_local = batch // dp
+    n_micro = n_micro or n_stages
+    n_micro = max(1, min(n_micro, b_local))
+    while b_local % n_micro:
+        n_micro -= 1
+    out_flat = 1
+    for s in plan.out_shape[1:]:
+        out_flat *= s
+    buf_elems = max(plan.buf_elems, out_flat)
+
+    def unpack(buf, cut, mb):
+        vals = {}
+        off = 0
+        for guid, shp, dt in cut:
+            size = 1
+            for s in shp:
+                size *= s
+            vals[guid] = buf[:, off:off + size].reshape((mb,) + shp).astype(dt)
+            off += size
+        return vals
+
+    def pack(vals, cut, mb):
+        parts = [
+            vals[guid].astype(jnp.float32).reshape(mb, -1)
+            for guid, _, _ in cut
+        ]
+        flat = (jnp.concatenate(parts, axis=1) if parts
+                else jnp.zeros((mb, 0), jnp.float32))
+        pad = buf_elems - flat.shape[1]
+        if pad:
+            flat = jnp.pad(flat, ((0, 0), (0, pad)))
+        return flat
+
+    def pipelined(params, *inputs_local):
+        # Make the replicated params VARYING up front: consumed as-is
+        # inside the scan they'd each get an implicit pvary whose
+        # transpose is a per-tick psum INSIDE the backward While loop,
+        # racing the reverse ppermute across devices (observed XLA:CPU
+        # rendezvous deadlock: half the mesh at an allreduce, half at a
+        # permute). One explicit pvary here moves the whole param-grad
+        # psum after the scan, where it is data-dependent on every
+        # ppermute and cannot race.
+        axes = (data_axis, axis_name)
+        params = jax.tree_util.tree_map(
+            lambda l: lax.pvary(l, axes), params
+        )
+        stage = lax.axis_index(axis_name)
+        mb = inputs_local[0].shape[0] // n_micro
+        mbs = [a.reshape((n_micro, mb) + a.shape[1:]) for a in inputs_local]
+        ticks = n_micro + n_stages - 1
+        # carriers are varying over BOTH the pipe axis (ppermute/stage
+        # predicates) and the data axis (they mix with data-sharded
+        # activations inside the branches)
+        zero_buf = lax.pcast(
+            jnp.zeros((mb, buf_elems), jnp.float32),
+            (data_axis, axis_name), to="varying",
+        )
+        zero_out = lax.pcast(
+            jnp.zeros((n_micro, mb, out_flat), jnp.float32),
+            (data_axis, axis_name), to="varying",
+        )
+        perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+        def make_branch(s):
+            def branch(buf, inj, t):
+                if s == 0:
+                    vals = dict(zip(input_guids, inj))
+                else:
+                    vals = unpack(buf, plan.cuts[s - 1], mb)
+                vals = stage_runners[s](params, vals, t)
+                if s == n_stages - 1:
+                    out = vals[plan.out_guid].astype(jnp.float32)
+                    flat = out.reshape(mb, -1)
+                    pad = buf_elems - flat.shape[1]
+                    return jnp.pad(flat, ((0, 0), (0, pad)))
+                return pack(vals, plan.cuts[s], mb)
+            return branch
+
+        branches = [make_branch(s) for s in range(n_stages)]
+
+        def tick(carry, t):
+            buf, outbuf = carry
+            # injected inputs must carry the pipe-varying vma type so every
+            # switch branch (buf-derived or inj-derived) has one output type
+            inj = [
+                lax.pcast(
+                    lax.dynamic_index_in_dim(
+                        m, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+                    ),
+                    (axis_name,), to="varying",
+                )
+                for m in mbs
+            ]
+            y = lax.switch(stage, branches, buf, inj, t)
+            out_idx = t - (n_stages - 1)
+            oi = jnp.clip(out_idx, 0, n_micro - 1)
+            old = lax.dynamic_index_in_dim(outbuf, oi, 0, keepdims=False)
+            valid = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+            outbuf = lax.dynamic_update_index_in_dim(
+                outbuf, jnp.where(valid, y[:, :out_flat], old), oi, 0
+            )
+            buf_next = lax.ppermute(y, axis_name, perm)
+            return (buf_next, outbuf), None
+
+        # unrolled: the tick count is small (n_micro + n_stages - 1) and
+        # XLA:CPU's thunk executor races independent collectives across
+        # devices when they sit inside a While body (observed deadlock:
+        # half the mesh at the param-grad allreduce, half at a ppermute);
+        # a flat thunk graph gives every device one static order
+        (_, outbuf), _ = lax.scan(tick, (zero_buf, zero_out),
+                                  jnp.arange(ticks), unroll=True)
+        out = lax.psum(outbuf, axis_name)
+        local_shape = (b_local,) + tuple(plan.out_shape[1:])
+        return out.reshape(local_shape).astype(plan.out_dtype)
+
+    in_specs = tuple(
+        P(*((data_axis,) + (None,) * (a.ndim - 1))) for a in input_arrays
+    )
+    param_specs = jax.tree_util.tree_map(lambda _: P(), params)
+    out_spec = P(*((data_axis,) + (None,) * (len(plan.out_shape) - 1)))
+    fn = shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(param_specs,) + in_specs,
+        out_specs=out_spec,
+    )
+    return fn(params, *input_arrays)

@@ -103,14 +103,58 @@ def op_weight_bytes(op: PCGOp) -> int:
     return sum(_vol(w.material_shape()) * w.data_type.size for w in op.weights)
 
 
+_DEFAULT_CALIBRATION: Optional[dict] = None
+_DEFAULT_CALIBRATION_LOADED = False
+
+
+def load_default_calibration() -> Optional[dict]:
+    """The shipped on-silicon calibration (tools/calibrate_cost_model.py
+    output, flexflow_tpu/search/calibration_v5e.json): per-op-class
+    efficiencies fitted from measured fwd/bwd times on a real v5e chip —
+    the analytic analog of the reference shipping its simulator tuned
+    against real GPU microbenchmarks."""
+    global _DEFAULT_CALIBRATION, _DEFAULT_CALIBRATION_LOADED
+    if not _DEFAULT_CALIBRATION_LOADED:
+        _DEFAULT_CALIBRATION_LOADED = True
+        import json
+        import os
+
+        path = os.path.join(os.path.dirname(__file__),
+                            "calibration_v5e.json")
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    _DEFAULT_CALIBRATION = json.load(f)
+            except (OSError, ValueError):
+                _DEFAULT_CALIBRATION = None
+    return _DEFAULT_CALIBRATION
+
+
 class CostModel:
     """Per-(op, machine-view) cost oracle with memoization
     (reference: Simulator::measure_operator_cost's hash_map cache,
-    simulator.cc:489-537 + strict_hash_to_operator_cost)."""
+    simulator.cc:489-537 + strict_hash_to_operator_cost).
 
-    def __init__(self, machine: MachineModel, *, bf16: bool = True):
+    calibration: None loads the shipped per-op-class efficiency fit
+    (calibration_v5e.json); False disables calibration; a dict or a JSON
+    path supplies a custom one. The fit refines the roofline's fixed
+    mxu/hbm efficiency constants per op class where silicon measurements
+    say otherwise."""
+
+    def __init__(self, machine: MachineModel, *, bf16: bool = True,
+                 calibration=None):
         self.machine = machine
         self.bf16 = bf16
+        if calibration is None:
+            calibration = load_default_calibration()
+        elif calibration is False:
+            calibration = None
+        elif isinstance(calibration, str):
+            import json
+
+            with open(calibration) as f:
+                calibration = json.load(f)
+        self.calibration = calibration
         self._cache: Dict[Tuple, CostMetrics] = {}
         self._xfer_cache: Dict[Tuple, float] = {}
         # measured-mode overrides: key -> (fwd, bwd) seconds
@@ -118,6 +162,19 @@ class CostModel:
         # optional on-device microbenchmark oracle (search/measure.py,
         # reference: Simulator::measure_operator_cost's real timing path)
         self.measure_fn = None
+
+    def _calibrated_efficiencies(self, op_type) -> Tuple[Optional[float],
+                                                         Optional[float]]:
+        """(mxu_eff, hbm_eff) overrides for this op class, if fitted."""
+        if not self.calibration:
+            return None, None
+        cls = self.calibration.get("op_class", {}).get(op_type.name)
+        g_m = self.calibration.get("mxu_efficiency")
+        g_h = self.calibration.get("hbm_efficiency")
+        if cls:
+            return cls.get("mxu_efficiency", g_m), cls.get("hbm_efficiency",
+                                                           g_h)
+        return g_m, g_h
 
     def _key(self, op: PCGOp, view: MachineView):
         # weights are part of the key: their sharding degrees decide the
@@ -145,11 +202,24 @@ class CostModel:
         if key in self.measured:
             fwd, bwd = self.measured[key]
         else:
-            fwd = self.machine.compute_cost(flops, membytes, self.bf16)
+            mxu_eff, hbm_eff = self._calibrated_efficiencies(op.op_type)
+            fwd = self.machine.compute_cost(
+                flops, membytes, self.bf16,
+                mxu_eff=mxu_eff, hbm_eff=hbm_eff,
+            )
             # backward ≈ 2× forward for weight ops (dgrad+wgrad), ≈ forward
             # for the rest (reference measures both; ratio matches its
-            # observed GEMM fwd:bwd split)
-            bwd = 2.0 * fwd if op.weights else fwd
+            # observed GEMM fwd:bwd split); calibration refines per class
+            ratio = None
+            if self.calibration:
+                cls = self.calibration.get("op_class", {}).get(
+                    op.op_type.name
+                )
+                if cls:
+                    ratio = cls.get("bwd_over_fwd")
+            if ratio is None:
+                ratio = 2.0 if op.weights else 1.0
+            bwd = ratio * fwd
         # weight gradient sync (reference: NCCL allreduce per weight per
         # view, optimizer.cc nccl_update_task). Per weight: a sharded
         # weight only syncs across its REPLICAS — each device owns

@@ -250,25 +250,84 @@ class SearchHelper:
                 _rlog.info("best sequence cost %.4f", best.cost)
                 return best
 
-        # 2. fallback: greedy chain (connected, no bottleneck — rare diamond
-        #    patterns): pick views greedily in topo order.
-        views_map: Dict[int, MachineView] = {}
-        total = 0.0
-        cur_bounds = dict(bounds)
+        # 2. fallback: connected, no bottleneck (diamond patterns — e.g.
+        #    Inception towers reconverging after substitution). Bounded
+        #    exact branch-and-bound over per-op views, beam search past the
+        #    budget. (Round 1 picked views greedily in topo order here,
+        #    which could silently return measurably suboptimal placements.)
+        with _rlog.enter("diamond assign: %d ops", len(ops)):
+            return self._diamond_assign(ops, bounds, fixed, res)
+
+    # exact enumeration budget (total view combinations) and beam width for
+    # the no-bottleneck fallback
+    DIAMOND_EXACT_BUDGET = 8192
+    DIAMOND_BEAM_WIDTH = 16
+
+    def _diamond_assign(self, ops, bounds, fixed, res) -> GraphCostResult:
+        view_lists: List[List[MachineView]] = []
+        combos = 1
         for op in ops:
             vs = [fixed[op.guid]] if op.guid in fixed else self.valid_views(op, res)
-            best_v, best_c = None, float("inf")
-            for v in vs:
-                c = self.node_cost(op, v, cur_bounds)
-                if c < best_c:
-                    best_v, best_c = v, c
-            if best_v is None:
+            if not vs:
                 return GraphCostResult.infinity()
-            views_map[op.guid] = best_v
-            total += best_c
-            for t in op.outputs:
-                cur_bounds[t.guid] = best_v
-        return GraphCostResult(total, views_map)
+            view_lists.append(vs)
+            combos = min(combos * len(vs), self.DIAMOND_EXACT_BUDGET + 1)
+
+        # beam pass: always run — it seeds branch-and-bound's incumbent
+        # (beam width 1 degenerates to the old greedy, wider is strictly
+        # more coverage)
+        beam: List[Tuple[float, Dict[int, MachineView], Dict[int, MachineView]]]
+        beam = [(0.0, dict(bounds), {})]
+        for op, vs in zip(ops, view_lists):
+            nxt = []
+            for cost, cur_bounds, assign in beam:
+                for v in vs:
+                    c = cost + self.node_cost(op, v, cur_bounds)
+                    if c == float("inf"):
+                        continue
+                    nb = dict(cur_bounds)
+                    for t in op.outputs:
+                        nb[t.guid] = v
+                    na = dict(assign)
+                    na[op.guid] = v
+                    nxt.append((c, nb, na))
+            if not nxt:
+                return GraphCostResult.infinity()
+            nxt.sort(key=lambda s: s[0])
+            beam = nxt[: self.DIAMOND_BEAM_WIDTH]
+        best_cost, _, best_assign = beam[0]
+        best = GraphCostResult(best_cost, best_assign)
+        if combos > self.DIAMOND_EXACT_BUDGET:
+            return best
+
+        # exact: DFS over view choices, pruning partial costs against the
+        # beam incumbent — within the budget this is the true optimum
+        n = len(ops)
+
+        def dfs(i, cost, cur_bounds, assign):
+            nonlocal best
+            if cost >= best.cost:
+                return
+            if i == n:
+                best = GraphCostResult(cost, dict(assign))
+                return
+            op = ops[i]
+            scored = []
+            for v in view_lists[i]:
+                c = self.node_cost(op, v, cur_bounds)
+                if cost + c < best.cost:
+                    scored.append((c, v))
+            scored.sort(key=lambda s: s[0])
+            for c, v in scored:
+                nb = dict(cur_bounds)
+                for t in op.outputs:
+                    nb[t.guid] = v
+                assign[op.guid] = v
+                dfs(i + 1, cost + c, nb, assign)
+                del assign[op.guid]
+
+        dfs(0, 0.0, dict(bounds), {})
+        return best
 
     def _nonsequence(self, a, b, bounds, fixed, res, graph) -> GraphCostResult:
         """reference: find_optimal_nonsequence_graph_time (graph.cc ~230-290):

@@ -96,17 +96,22 @@ class MachineModel:
         return 2 * (n - 1) / n * num_bytes / slowest + 2 * (n - 1) * max_lat
 
     def compute_cost(
-        self, flops: float, mem_bytes: float, dtype_is_bf16: bool = True
+        self, flops: float, mem_bytes: float, dtype_is_bf16: bool = True,
+        *, mxu_eff: Optional[float] = None, hbm_eff: Optional[float] = None,
     ) -> float:
         """Roofline: max of MXU time and HBM time (the TPU-native
         replacement for the reference's on-device microbenchmarks,
         simulator.cc measure_operator_cost — analytic because XLA's fusion
-        makes per-op on-device timing unrepresentative anyway)."""
+        makes per-op on-device timing unrepresentative anyway).
+        mxu_eff/hbm_eff override the model's global efficiency constants
+        (the per-op-class calibration fit, search/cost_model.py)."""
         peak = (
             self.chip.peak_flops_bf16 if dtype_is_bf16 else self.chip.peak_flops_f32
         )
-        t_flops = flops / (peak * self.mxu_efficiency)
-        t_mem = mem_bytes / (self.chip.hbm_bandwidth * self.hbm_efficiency)
+        t_flops = flops / (peak * (mxu_eff or self.mxu_efficiency))
+        t_mem = mem_bytes / (
+            self.chip.hbm_bandwidth * (hbm_eff or self.hbm_efficiency)
+        )
         return max(t_flops, t_mem)
 
 

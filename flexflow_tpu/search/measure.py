@@ -83,7 +83,8 @@ class OperatorMeasurer:
     hash (simulator.cc strict_hash_to_operator_cost)."""
 
     def __init__(self, *, repeats: int = 50, warmup: int = 1,
-                 compute_dtype=None, differenced: Optional[bool] = None):
+                 compute_dtype=None, differenced: Optional[bool] = None,
+                 cache_path: Optional[str] = None):
         self.repeats = repeats
         self.warmup = warmup
         self.compute_dtype = compute_dtype
@@ -96,6 +97,44 @@ class OperatorMeasurer:
         self._differenced = differenced
         self._cache: Dict[Tuple, Tuple[float, float]] = {}
         self._warned: set = set()
+        # disk persistence (reference: the Simulator caches its on-device
+        # microbenchmarks across runs, simulator.cc:489-537): measurements
+        # survive process restarts, so repeated --measured-search compiles
+        # pay the silicon cost once per (op, shard-shape)
+        self.cache_path = cache_path
+        self._disk: Dict[str, Tuple[float, float]] = {}
+        if cache_path:
+            import json
+            import os
+
+            if os.path.exists(cache_path):
+                try:
+                    with open(cache_path) as f:
+                        self._disk = {k: tuple(v)
+                                      for k, v in json.load(f).items()}
+                except (OSError, ValueError) as e:
+                    warnings.warn(
+                        f"measured-search: ignoring unreadable cache "
+                        f"{cache_path}: {e}"
+                    )
+
+    @staticmethod
+    def _disk_key(key) -> str:
+        op_type, params, shard_shapes, w_shapes, parts = key
+        return f"{op_type.name}|{params!r}|{shard_shapes}|{w_shapes}|{parts}"
+
+    def _disk_put(self, key, fb) -> None:
+        if not self.cache_path:
+            return
+        import json
+
+        self._disk[self._disk_key(key)] = fb
+        try:
+            with open(self.cache_path, "w") as f:
+                json.dump({k: list(v) for k, v in self._disk.items()}, f,
+                          indent=0)
+        except OSError as e:
+            warnings.warn(f"measured-search: cache write failed: {e}")
 
     @property
     def differenced(self) -> bool:
@@ -112,6 +151,10 @@ class OperatorMeasurer:
         key = (op.op_type, op.params, shard_shapes, w_shapes, parts)
         if key in self._cache:
             return self._cache[key]
+        disk = self._disk.get(self._disk_key(key))
+        if disk is not None:
+            self._cache[key] = disk
+            return disk
         try:
             fb = self._measure(op, shard_shapes, w_shapes)
         except Exception as e:
@@ -127,6 +170,8 @@ class OperatorMeasurer:
             fb = None
         if fb is None:
             fb = (float("nan"), float("nan"))
+        else:
+            self._disk_put(key, fb)
         self._cache[key] = fb
         return fb
 
@@ -228,10 +273,11 @@ class OperatorMeasurer:
 
 
 def attach_measured_mode(cost_model, *, repeats: int = 50,
-                         compute_dtype=None) -> None:
+                         compute_dtype=None,
+                         cache_path: Optional[str] = None) -> None:
     """Wire an OperatorMeasurer into a CostModel: every cost-cache miss
     first tries real silicon; NaN (unmeasurable) falls back to the
-    analytic roofline."""
+    analytic roofline. cache_path persists measurements across runs."""
     import jax
 
     backend = jax.default_backend()
@@ -242,5 +288,5 @@ def attach_measured_mode(cost_model, *, repeats: int = 50,
             "skews the search — use for testing only"
         )
     cost_model.measure_fn = OperatorMeasurer(
-        repeats=repeats, compute_dtype=compute_dtype
+        repeats=repeats, compute_dtype=compute_dtype, cache_path=cache_path
     )

@@ -138,6 +138,15 @@ def load_rule_collection_from_path(path: str) -> List[Rule]:
         return load_rule_collection(json.load(f))
 
 
+def default_rules_path() -> str:
+    """The shipped rule collection (tools/generate_substitutions.py;
+    reference analog: substitutions/graph_subst_3_v2.json)."""
+    import os
+
+    return os.path.join(os.path.dirname(__file__), "substitutions",
+                        "graph_subst_tpu_v1.json")
+
+
 # ---------------------------------------------------------------------------
 # rule application
 # ---------------------------------------------------------------------------
@@ -323,12 +332,37 @@ def _infer_outputs(op: PCGOp, src_op: Optional[PCGOp]) -> List[ParallelTensor]:
         [t.material_shape() for t in op.inputs],
         [t.data_type for t in op.inputs],
     )
-    return [
+    outs = [
         ParallelTensor(
             dims=[ParallelDim(size=s, degree=1) for s in shape], data_type=dt
         )
         for shape, dt in zip(shapes, dtypes)
     ]
+    # Propagate input partition degrees to outputs (reference: each op's
+    # ParallelDimMappingRecords, operator.h:22-49). Without this a rule's
+    # partition/compute/combine sandwich is cosmetic: the DP only grants
+    # an op multi-part machine views when its OUTPUT degree says so
+    # (dp_search.valid_views keys off get_total_degree).
+    t = op.op_type
+    ins = op.inputs
+    for out in outs:
+        if t == OperatorType.OP_BATCHMATMUL and len(ins) == 2:
+            a, b = ins
+            # (..., m, k) x (..., k, n): batch+m dims follow a, n follows b
+            for i in range(len(out.dims) - 1):
+                if i < len(a.dims) - 1:
+                    out.dims[i].degree = a.dims[i].degree
+            out.dims[-1].degree = b.dims[-1].degree
+        elif t == OperatorType.OP_LINEAR and ins:
+            for i in range(len(out.dims) - 1):
+                if i < len(ins[0].dims):
+                    out.dims[i].degree = ins[0].dims[i].degree
+        elif ins and len(ins[0].dims) == len(out.dims):
+            # rank-preserving (elementwise / softmax / activations):
+            # positionwise carry-over from the first input
+            for i in range(len(out.dims)):
+                out.dims[i].degree = ins[0].dims[i].degree
+    return outs
 
 
 def rules_to_substitutions(rules: List[Rule]) -> List[Substitution]:

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Generate the shipped substitution-rule collection.
+
+The reference ships substitutions/graph_subst_3_v2.json (~700 KB of
+TASO-generated rewrite rules, loaded by src/runtime/substitution_loader.cc).
+This emits our equivalent asset — flexflow_tpu/search/substitutions/
+graph_subst_tpu_v1.json — in the SAME `_t`-tagged schema, covering the
+per-op partition/combine rewrites the declarative path adds on top of the
+programmatic xfers (search/substitution.py):
+
+  * per-op sample-dim (dim 0) partition sandwiches for Linear, Softmax,
+    elementwise add/mul, and BatchMatmul — unlike the programmatic
+    `partition_batch`, these parallelize ONE op without requiring every
+    activation in the graph to have a divisible batch dim;
+  * column-parallel BatchMatmul (partition the rhs' LAST dim) — not in
+    the programmatic vocabulary at all: it is the only way the search
+    can parallelize a batch-1 matmul chain.
+
+Regenerate with:  python tools/generate_substitutions.py
+"""
+import json
+import os
+
+DEGREES = (2, 4, 8)
+
+
+def t(op_id, ts_id=0):
+    return {"_t": "Tensor", "opId": op_id, "tsId": ts_id}
+
+
+def para(dim, degree):
+    return [
+        {"_t": "Parameter", "key": "PM_PARALLEL_DIM", "value": dim},
+        {"_t": "Parameter", "key": "PM_PARALLEL_DEGREE", "value": degree},
+    ]
+
+
+def op(type_str, inputs, params=None):
+    return {"_t": "Operator", "type": type_str, "input": inputs,
+            "para": params or []}
+
+
+def rule(name, src, dst, src_out, dst_out):
+    return {
+        "_t": "Rule", "name": name, "srcOp": src, "dstOp": dst,
+        "mappedOutput": [{"_t": "MapOutput", "srcOpId": src_out[0],
+                          "srcTsId": src_out[1], "dstOpId": dst_out[0],
+                          "dstTsId": dst_out[1]}],
+    }
+
+
+def unary_batch(op_type, short, d):
+    """partition(dim0) -> op -> combine(dim0)."""
+    return rule(
+        f"partition_{short}_batch_{d}",
+        src=[op(op_type, [t(-1)])],
+        dst=[
+            op("OP_PARTITION", [t(-1)], para(0, d)),
+            op(op_type, [t(0)]),
+            op("OP_COMBINE", [t(1)], para(0, d)),
+        ],
+        src_out=(0, 0), dst_out=(2, 0),
+    )
+
+
+def binary_batch(op_type, short, d):
+    """Both operands partitioned over dim 0."""
+    return rule(
+        f"partition_{short}_batch_{d}",
+        src=[op(op_type, [t(-1), t(-2)])],
+        dst=[
+            op("OP_PARTITION", [t(-1)], para(0, d)),
+            op("OP_PARTITION", [t(-2)], para(0, d)),
+            op(op_type, [t(0), t(1)]),
+            op("OP_COMBINE", [t(2)], para(0, d)),
+        ],
+        src_out=(0, 0), dst_out=(3, 0),
+    )
+
+
+def matmul_column(d, rank):
+    """Column-parallel batch matmul: shard the rhs' last dim; the lhs is
+    consumed whole. Rank-specific because PM_PARALLEL_DIM is absolute."""
+    dim = rank - 1
+    return rule(
+        f"partition_matmul_col{rank}_{d}",
+        src=[op("OP_BATCHMATMUL", [t(-1), t(-2)])],
+        dst=[
+            op("OP_PARTITION", [t(-2)], para(dim, d)),
+            op("OP_BATCHMATMUL", [t(-1), t(0)]),
+            op("OP_COMBINE", [t(1)], para(dim, d)),
+        ],
+        src_out=(0, 0), dst_out=(2, 0),
+    )
+
+
+def main():
+    rules = []
+    for d in DEGREES:
+        rules.append(unary_batch("OP_LINEAR", "linear", d))
+        rules.append(unary_batch("OP_SOFTMAX", "softmax", d))
+        rules.append(unary_batch("OP_RELU", "relu", d))
+        rules.append(binary_batch("OP_EW_ADD", "ewadd", d))
+        rules.append(binary_batch("OP_EW_MUL", "ewmul", d))
+        rules.append(binary_batch("OP_BATCHMATMUL", "matmul", d))
+        rules.append(matmul_column(d, rank=3))
+        rules.append(matmul_column(d, rank=2))
+    out = {"rule": rules}
+    path = os.path.join(os.path.dirname(__file__), "..", "flexflow_tpu",
+                        "search", "substitutions",
+                        "graph_subst_tpu_v1.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {path} ({len(rules)} rules)")
+
+
+if __name__ == "__main__":
+    main()
