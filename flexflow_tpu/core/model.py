@@ -833,9 +833,37 @@ class FFModel:
             input_order=ordered_inputs,
             remat=self.config.remat,
             constants=constants,
+            plan_cost_model=self._build_cost_model(),
         )
         self.state = self.executor.init_state()
         self.perf_metrics = PerfMetrics()
+
+    def _build_cost_model(self):
+        """The cost oracle for stage planning (and the search): the
+        configured machine (file / search-dims / --machine-model-version)
+        with the shipped calibration."""
+        from ..search import CostModel, MachineModel, parse_machine_config
+
+        cfg = self.config
+        if cfg.machine_model_file:
+            machine = parse_machine_config(cfg.machine_model_file)
+        else:
+            nodes = (cfg.search_num_nodes if cfg.search_num_nodes > 0
+                     else cfg.numNodes)
+            workers = (cfg.search_num_workers if cfg.search_num_workers > 0
+                       else cfg.workersPerNode)
+            machine = MachineModel(num_nodes=nodes, workers_per_node=workers)
+        if cfg.machine_model_version >= 1 and not hasattr(machine, "topology"):
+            from ..search.network import TopologyAwareMachineModel
+
+            machine = TopologyAwareMachineModel(
+                num_nodes=machine.num_nodes,
+                workers_per_node=machine.workers_per_node,
+                ici_bandwidth=machine.ici_bandwidth,
+                dcn_bandwidth=machine.dcn_bandwidth,
+                chip=machine.chip,
+            )
+        return CostModel(machine, bf16=cfg.allow_mixed_precision)
 
     def _run_strategy_search(self, ndev: int):
         """Unity search over the lowered PCG (reference: compile's
@@ -852,17 +880,11 @@ class FFModel:
         )
 
         cfg = self.config
-        if cfg.machine_model_file:
-            machine = parse_machine_config(cfg.machine_model_file)
-        else:
-            nodes = cfg.search_num_nodes if cfg.search_num_nodes > 0 else cfg.numNodes
-            workers = (
-                cfg.search_num_workers
-                if cfg.search_num_workers > 0
-                else cfg.workersPerNode
-            )
-            machine = MachineModel(num_nodes=nodes, workers_per_node=workers)
-        cost_model = CostModel(machine, bf16=cfg.allow_mixed_precision)
+        # (--machine-model-version 1 selects the EnhancedMachineModel
+        # analog — per-link ICI hops, DCN hierarchy, congestion;
+        # search/network.py)
+        cost_model = self._build_cost_model()
+        machine = cost_model.machine
         if cfg.measure_operator_costs:
             # --measured-search: per-op on-device timing feeds the search
             from ..search.measure import attach_measured_mode

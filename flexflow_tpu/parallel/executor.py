@@ -94,9 +94,13 @@ class PCGExecutor:
         input_order: Optional[List] = None,
         remat: bool = False,
         constants: Optional[Dict] = None,
+        plan_cost_model=None,
     ):
         self.graph = graph
         self.mesh = mesh
+        # cost oracle for pipeline stage planning (the same calibrated
+        # model the strategy search uses; None = default v5e constants)
+        self._plan_cost_model = plan_cost_model
         self.remat = remat
         # guid -> (ParallelTensor, python float OR baked np.ndarray):
         # materialized as jnp.full / jnp.asarray at trace time, excluded
@@ -164,10 +168,19 @@ class PCGExecutor:
                     "can't cross the GPipe schedule — running unpipelined"
                 )
                 return None
-        machine = MachineModel()
-        costs = [
-            machine.compute_cost(op_flops(o), op_bytes(o)) for o in ops
-        ]
+        if self._plan_cost_model is not None:
+            from ..pcg.machine_view import MachineView
+
+            v1 = MachineView(start_device_id=0, dim=(1,), stride=(1,))
+            costs = [
+                self._plan_cost_model.measure_operator_cost(o, v1).total_time
+                for o in ops
+            ]
+        else:
+            machine = MachineModel()
+            costs = [
+                machine.compute_cost(op_flops(o), op_bytes(o)) for o in ops
+            ]
         bounds = balanced_linear_partition(costs, n_stages)
         stages = [ops[bounds[i]:bounds[i + 1]]
                   for i in range(len(bounds) - 1)]

@@ -285,23 +285,35 @@ class CostModel:
         self._xfer_cache[key] = worst
         return worst
 
-    def parallel_op_cost(self, op: PCGOp) -> float:
-        """Cost of an explicit parallel op node (reshard collectives)."""
+    def parallel_op_cost(self, op: PCGOp, view=None) -> float:
+        """Cost of an explicit parallel op node (reshard collectives),
+        priced through the machine model's collective methods so a
+        topology-aware machine (hop distances, DCN hierarchy) changes the
+        number — the reference's EnhancedMachineModel routes these through
+        its per-link comm devices (machine_model.cc)."""
         t = op.op_type
         if t not in PARALLEL_OP_TYPES:
             return 0.0
         x = op.inputs[0]
         total = _vol(x.material_shape()) * x.data_type.size
         m = self.machine
+
+        def group(deg):
+            if view is not None:
+                ids = view.device_ids()
+                if len(ids) >= deg:
+                    return ids[:deg]
+            return range(deg)
+
         if t == OperatorType.OP_REPLICATE:
             deg = op.params.replicate_degree
-            return (deg - 1) * total / m.ici_bandwidth
+            return m.replicate_cost(total, group(deg))
         if t == OperatorType.OP_REDUCTION:
             deg = op.params.reduction_degree
-            return m.allreduce_cost(total / deg, range(deg))
-        if t in (OperatorType.OP_REPARTITION, OperatorType.OP_COMBINE):
-            return total / m.ici_bandwidth
+            return m.allreduce_cost(total / deg, group(deg))
         if t == OperatorType.OP_ALL_TO_ALL:
             deg = op.params.degree
-            return total * (deg - 1) / deg / m.ici_bandwidth
-        return total / m.ici_bandwidth
+            return m.all_to_all_cost(total, group(deg))
+        deg = getattr(op.params, "repartition_degree",
+                      getattr(op.params, "combine_degree", 2))
+        return m.reshard_cost(total, group(deg))

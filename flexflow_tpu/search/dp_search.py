@@ -101,7 +101,11 @@ class SearchHelper:
         cm = self.cost_model.measure_operator_cost(op, view)
         total = cm.total_time
         if op.is_parallel_op:
-            total += self.cost_model.parallel_op_cost(op)
+            # the collective happens across the INPUT's placement (a
+            # combine/reduction's own view has degree-1 outputs, i.e. one
+            # device); fall back to the op's view when no producer is known
+            src = bounds.get(op.inputs[0].guid) if op.inputs else None
+            total += self.cost_model.parallel_op_cost(op, src or view)
         for t in op.inputs:
             src = bounds.get(t.guid)
             total += self.cost_model.estimate_xfer_cost(t, src, view)

@@ -95,6 +95,32 @@ class MachineModel:
         max_lat = max(self.link_latency(ids[i], ids[(i + 1) % n]) for i in range(n))
         return 2 * (n - 1) / n * num_bytes / slowest + 2 * (n - 1) * max_lat
 
+    # collective costs the parallel-op nodes price against (overridden by
+    # the topology model with hop/DCN-aware versions — reference:
+    # EnhancedMachineModel's per-link comm devices, machine_model.cc)
+    def replicate_cost(self, num_bytes: float, device_ids) -> float:
+        """Broadcast one copy to every device in the group."""
+        ids = list(device_ids)
+        n = len(ids)
+        if n <= 1 or num_bytes <= 0:
+            return 0.0
+        return (n - 1) * num_bytes / self.ici_bandwidth
+
+    def all_to_all_cost(self, num_bytes: float, device_ids) -> float:
+        """Each device exchanges its (n-1)/n share with every peer."""
+        ids = list(device_ids)
+        n = len(ids)
+        if n <= 1 or num_bytes <= 0:
+            return 0.0
+        return num_bytes * (n - 1) / n / self.ici_bandwidth
+
+    def reshard_cost(self, num_bytes: float, device_ids) -> float:
+        """Repartition/Combine: one pass of the tensor over the group."""
+        ids = list(device_ids)
+        if len(ids) <= 1 or num_bytes <= 0:
+            return 0.0
+        return num_bytes / self.ici_bandwidth
+
     def compute_cost(
         self, flops: float, mem_bytes: float, dtype_is_bf16: bool = True,
         *, mxu_eff: Optional[float] = None, hbm_eff: Optional[float] = None,

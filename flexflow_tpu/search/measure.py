@@ -103,20 +103,50 @@ class OperatorMeasurer:
         # pay the silicon cost once per (op, shard-shape)
         self.cache_path = cache_path
         self._disk: Dict[str, Tuple[float, float]] = {}
-        if cache_path:
-            import json
-            import os
+        self._disk_loaded = False
 
-            if os.path.exists(cache_path):
-                try:
-                    with open(cache_path) as f:
-                        self._disk = {k: tuple(v)
-                                      for k, v in json.load(f).items()}
-                except (OSError, ValueError) as e:
-                    warnings.warn(
-                        f"measured-search: ignoring unreadable cache "
-                        f"{cache_path}: {e}"
-                    )
+    def _cache_meta(self) -> Dict[str, str]:
+        import jax
+
+        return {
+            "device": jax.devices()[0].device_kind,
+            "dtype": str(self.compute_dtype or "f32"),
+        }
+
+    def _load_disk(self) -> None:
+        """Lazy (first measurement): the cache is only valid for the SAME
+        device kind and compute dtype — timings from another chip replayed
+        silently would poison every downstream cost."""
+        self._disk_loaded = True
+        if not self.cache_path:
+            return
+        import json
+        import os
+
+        if not os.path.exists(self.cache_path):
+            return
+        try:
+            with open(self.cache_path) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            warnings.warn(
+                f"measured-search: ignoring unreadable cache "
+                f"{self.cache_path}: {e}"
+            )
+            return
+        meta = data.pop("__meta__", None)
+        if meta is not None and meta != self._cache_meta():
+            warnings.warn(
+                f"measured-search: cache {self.cache_path} was measured on "
+                f"{meta} but this run is {self._cache_meta()} — ignoring it"
+            )
+            return
+        if meta is None:
+            warnings.warn(
+                f"measured-search: cache {self.cache_path} has no device "
+                "metadata (older format); assuming it matches this device"
+            )
+        self._disk = {k: tuple(v) for k, v in data.items()}
 
     @staticmethod
     def _disk_key(key) -> str:
@@ -130,9 +160,10 @@ class OperatorMeasurer:
 
         self._disk[self._disk_key(key)] = fb
         try:
+            payload = {"__meta__": self._cache_meta()}
+            payload.update({k: list(v) for k, v in self._disk.items()})
             with open(self.cache_path, "w") as f:
-                json.dump({k: list(v) for k, v in self._disk.items()}, f,
-                          indent=0)
+                json.dump(payload, f, indent=0)
         except OSError as e:
             warnings.warn(f"measured-search: cache write failed: {e}")
 
@@ -151,6 +182,8 @@ class OperatorMeasurer:
         key = (op.op_type, op.params, shard_shapes, w_shapes, parts)
         if key in self._cache:
             return self._cache[key]
+        if not self._disk_loaded:
+            self._load_disk()
         disk = self._disk.get(self._disk_key(key))
         if disk is not None:
             self._cache[key] = disk

@@ -93,46 +93,181 @@ class TorusTopology:
 
 @dataclasses.dataclass
 class TopologyAwareMachineModel(MachineModel):
-    """MachineModel whose intra-node transfers route over an ICI torus with
-    per-link congestion (reference: EnhancedMachineModel's per-device comm
-    links + congestion factors, machine_model.cc)."""
+    """MachineModel whose intra-slice transfers route over an ICI torus
+    with per-link congestion, and whose inter-slice traffic rides a DCN
+    hierarchy (reference: EnhancedMachineModel's per-device comm links +
+    congestion, machine_model.cc; NominalCommDevice path expansion,
+    network.cc).
+
+    Each "node" is one slice: `topology` describes a single slice's torus
+    (device ids within a slice are row-major torus coordinates); slices
+    talk over DCN with a per-slice egress bandwidth. A multi-hop or
+    cross-slice collective therefore costs MORE than a neighbor-ring one
+    of the same byte count — which is what lets the search prefer
+    contiguous placements (the flat model cannot tell them apart)."""
 
     topology: Optional[TorusTopology] = None
     congestion_factor: float = 0.15  # extra latency fraction per active flow
 
     def __post_init__(self):
         if self.topology is None:
-            self.topology = TorusTopology(dims=(self.num_nodes, self.workers_per_node))
+            self.topology = TorusTopology(dims=(self.workers_per_node,))
+        assert self.topology.num_chips == self.workers_per_node, (
+            "topology describes ONE slice: dims must multiply to "
+            "workers_per_node"
+        )
         self._link_load: Dict[Tuple[int, int], int] = {}
 
     def reset_congestion(self):
         self._link_load.clear()
 
+    def _local(self, device_id: int) -> int:
+        return device_id % self.workers_per_node
+
+    def _hops(self, a: int, b: int) -> Optional[int]:
+        """ICI hop distance, or None when a and b sit on different slices
+        (DCN, not hop-countable)."""
+        if self.node_of(a) != self.node_of(b):
+            return None
+        return self.topology.hop_distance(self._local(a), self._local(b))
+
     def xfer_cost(self, num_bytes: float, src: int, dst: int) -> float:
+        """Stateless point-to-point estimate: hops on the slice torus,
+        DCN across slices. Congestion is modelled for CONCURRENT flow
+        sets via concurrent_flows_cost — accumulating load across
+        independent cost queries would make search costs order-dependent
+        (mutually exclusive candidate placements don't share links)."""
         if src == dst or num_bytes <= 0:
             return 0.0
-        path = self.topology.shortest_path(src, dst)
+        if self.node_of(src) != self.node_of(dst):
+            # DCN: slice egress + ingress, no per-hop ICI model
+            return self.dcn_latency + num_bytes / self.dcn_bandwidth
+        path = self.topology.shortest_path(self._local(src), self._local(dst))
         hops = len(path) - 1
         # per-hop store-and-forward is pipelined: one BW term + per-hop latency
-        t = hops * self.ici_latency + num_bytes / self.ici_bandwidth
-        # congestion: links already carrying flows slow down
-        for u, v in zip(path, path[1:]):
-            key = (min(u, v), max(u, v))
-            load = self._link_load.get(key, 0)
-            t *= 1.0 + self.congestion_factor * load
-            self._link_load[key] = load + 1
-        return t
+        return hops * self.ici_latency + num_bytes / self.ici_bandwidth
+
+    def concurrent_flows_cost(self, flows) -> float:
+        """Finish time of a SET of simultaneous transfers
+        [(bytes, src, dst), ...] with per-link contention: each ICI link's
+        service rate divides among the flows routed over it (reference:
+        EnhancedMachineModel's congestion over shared comm devices,
+        machine_model.cc). The slowest flow bounds the set."""
+        self.reset_congestion()
+        paths = []
+        for num_bytes, src, dst in flows:
+            if src == dst or num_bytes <= 0:
+                paths.append(None)
+                continue
+            if self.node_of(src) != self.node_of(dst):
+                paths.append("dcn")
+                continue
+            p = self.topology.shortest_path(self._local(src),
+                                            self._local(dst))
+            paths.append(p)
+            for u, v in zip(p, p[1:]):
+                key = (min(u, v), max(u, v))
+                self._link_load[key] = self._link_load.get(key, 0) + 1
+        worst = 0.0
+        for (num_bytes, src, dst), p in zip(flows, paths):
+            if p is None:
+                continue
+            if p == "dcn":
+                worst = max(
+                    worst, self.dcn_latency + num_bytes / self.dcn_bandwidth
+                )
+                continue
+            load = max(
+                self._link_load[(min(u, v), max(u, v))]
+                for u, v in zip(p, p[1:])
+            )
+            t = (len(p) - 1) * self.ici_latency + num_bytes * (
+                1.0 + self.congestion_factor * (load - 1)
+            ) * load / self.ici_bandwidth
+            worst = max(worst, t)
+        return worst
+
+    def _ring_hop_factor(self, ids) -> Tuple[float, bool]:
+        """(max ICI hops between ring neighbors, crosses_dcn)."""
+        n = len(ids)
+        max_hops, crosses = 1, False
+        for i in range(n):
+            h = self._hops(ids[i], ids[(i + 1) % n])
+            if h is None:
+                crosses = True
+            else:
+                max_hops = max(max_hops, max(1, h))
+        return float(max_hops), crosses
 
     def allreduce_cost(self, num_bytes: float, device_ids) -> float:
-        """Ring allreduce over the torus: ring hops are neighbor links when
-        the view is contiguous, multi-hop otherwise."""
+        """Ring allreduce: neighbor links when the group is a contiguous
+        torus ring, multi-hop (slower) otherwise; groups spanning slices
+        decompose hierarchically — intra-slice reduce-scatter, DCN ring
+        across slices, intra-slice all-gather (how multi-slice XLA
+        lowers psum over ICI+DCN)."""
         ids = list(device_ids)
         n = len(ids)
         if n <= 1 or num_bytes <= 0:
             return 0.0
-        max_hops = max(
-            self.topology.hop_distance(ids[i], ids[(i + 1) % n]) for i in range(n)
-        )
+        slices = {}
+        for d in ids:
+            slices.setdefault(self.node_of(d), []).append(d)
+        if len(slices) > 1:
+            per_slice = max(len(v) for v in slices.values())
+            s = len(slices)
+            intra = 0.0
+            if per_slice > 1:
+                biggest = max(slices.values(), key=len)
+                intra = self.allreduce_cost(num_bytes, biggest)
+            dcn = (2 * (s - 1) / s * (num_bytes / max(1, per_slice))
+                   / self.dcn_bandwidth + 2 * (s - 1) * self.dcn_latency)
+            return intra + dcn
+        max_hops, _ = self._ring_hop_factor(ids)
         per_step = num_bytes / n / self.ici_bandwidth * max_hops
         lat = 2 * (n - 1) * self.ici_latency * max_hops
         return 2 * (n - 1) * per_step + lat
+
+    def replicate_cost(self, num_bytes: float, device_ids) -> float:
+        ids = list(device_ids)
+        n = len(ids)
+        if n <= 1 or num_bytes <= 0:
+            return 0.0
+        max_hops, crosses = self._ring_hop_factor(ids)
+        t = (n - 1) * num_bytes / self.ici_bandwidth * max_hops
+        if crosses:
+            t += self.dcn_latency + num_bytes / self.dcn_bandwidth
+        return t
+
+    def all_to_all_cost(self, num_bytes: float, device_ids) -> float:
+        """All-to-all: every pair exchanges; on a torus the bisection
+        constrains it — scale by the group's mean pair hop distance;
+        cross-slice shares ride DCN."""
+        ids = list(device_ids)
+        n = len(ids)
+        if n <= 1 or num_bytes <= 0:
+            return 0.0
+        hop_sum, pairs, dcn_pairs = 0.0, 0, 0
+        for i in range(n):
+            for j in range(i + 1, n):
+                h = self._hops(ids[i], ids[j])
+                if h is None:
+                    dcn_pairs += 1
+                else:
+                    hop_sum += max(1, h)
+                    pairs += 1
+        mean_hops = (hop_sum / pairs) if pairs else 1.0
+        t = num_bytes * (n - 1) / n / self.ici_bandwidth * mean_hops
+        if dcn_pairs:
+            frac = dcn_pairs / (pairs + dcn_pairs)
+            t += num_bytes * frac / self.dcn_bandwidth + self.dcn_latency
+        return t
+
+    def reshard_cost(self, num_bytes: float, device_ids) -> float:
+        ids = list(device_ids)
+        if len(ids) <= 1 or num_bytes <= 0:
+            return 0.0
+        max_hops, crosses = self._ring_hop_factor(ids)
+        t = num_bytes / self.ici_bandwidth * max_hops
+        if crosses:
+            t += self.dcn_latency + num_bytes / self.dcn_bandwidth
+        return t

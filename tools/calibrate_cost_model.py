@@ -111,15 +111,11 @@ def main():
             fwd_t, bwd_t = meas(op, view)
             if fwd_t != fwd_t:  # NaN: unmeasurable standalone
                 continue
-            # analytic components at the measured (local) shapes
-            parts = 1
-            fl = op_flops(op) / parts
-            by = op_bytes(op) / parts
-            # shard-local: scale flops/bytes by local/global volume ratio
-            gvol = sum(int(np.prod(t.material_shape())) for t in op.inputs)
-            lvol = sum(int(np.prod(s)) for s in shard_shapes)
-            frac = lvol / max(1, gvol)
-            fl, by = fl * frac, by * frac
+            # analytic components at the measured (local) shapes — same
+            # local/global fraction the repeat seed used
+            frac = lvol0 / max(1, gvol0)
+            fl = op_flops(op) * frac
+            by = op_bytes(op) * frac
             rows.append({
                 "model": name, "op": op.op_type.name,
                 "shapes": str(shard_shapes),
@@ -152,9 +148,15 @@ def write_outputs(rows, device_kind, bf16):
     for cls, rs in sorted(by_class.items()):
         mxu = [r["implied_mxu_fwd"] for r in rs]
         hbmv = [r["implied_hbm_fwd"] for r in rs]
-        ratios = [r["bwd_over_fwd"] for r in rs]
+        # bwd/fwd ratios outside [0.5, 4] are differencing noise (a failed
+        # bwd measurement floors at 0.1*fwd) — don't let them poison the
+        # fit; absent a clean ratio the cost model keeps its default
+        ratios = [r["bwd_over_fwd"] for r in rs
+                  if 0.5 <= r["bwd_over_fwd"] <= 4.0]
         med_m, med_h = float(np.median(mxu)), float(np.median(hbmv))
-        entry = {"n": len(rs), "bwd_over_fwd": round(float(np.median(ratios)), 3)}
+        entry = {"n": len(rs)}
+        if ratios:
+            entry["bwd_over_fwd"] = round(float(np.median(ratios)), 3)
         # whichever implied efficiency is physical (<=1) and larger
         # explains the measurement; clamp tiny ops' noise
         if med_m <= 1.2 and med_m >= med_h:
@@ -205,7 +207,7 @@ def write_outputs(rows, device_kind, bf16):
         for cls, e in sorted(op_class.items()):
             eff = e.get("mxu_efficiency", e.get("hbm_efficiency"))
             f.write(f"| {cls} | {e['n']} | {e['bound']} | {eff} | "
-                    f"{e['bwd_over_fwd']} |\n")
+                    f"{e.get('bwd_over_fwd', '-')} |\n")
         f.write("\n## Raw measurements\n\n")
         f.write("| model | op | local shapes | fwd µs | bwd µs | "
                 "implied mxu | implied hbm |\n|---|---|---|---|---|---|---|\n")
