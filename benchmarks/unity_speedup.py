@@ -38,7 +38,7 @@ def best_cost(graph, machine, xfers, budget):
     return result.cost
 
 
-def run(name: str, build, machine, degrees):
+def run(name: str, build, machine, degrees, budget: int = 20):
     from flexflow_tpu import FFConfig, FFModel
     from flexflow_tpu.pcg.lowering import layers_to_pcg
     from flexflow_tpu.search import generate_all_pcg_xfers
@@ -53,7 +53,7 @@ def run(name: str, build, machine, degrees):
     dp = best_cost(graph, machine, [partition_batch(d) for d in degrees],
                    budget=len(degrees) + 1)
     unity = best_cost(graph, machine, generate_all_pcg_xfers(degrees, cfg),
-                      budget=20)
+                      budget=budget)
     rec = {
         "config": name,
         "sim_dp_ms": round(dp * 1e3, 3),
@@ -68,6 +68,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=1)
     ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--include-inception", action="store_true")
     args = ap.parse_args()
 
     from flexflow_tpu.models.dlrm import build_dlrm
@@ -84,6 +85,11 @@ def main():
         degrees.append(d)
         d *= 2
 
+    from flexflow_tpu.models.inception import build_inception_v3
+    from flexflow_tpu.models.misc import build_candle_uno, build_xdl
+    from flexflow_tpu.models.resnet import build_resnext50
+
+    # all seven OSDI'22 artifact configs (scripts/osdi22ae/*.sh)
     speedups = []
     speedups.append(run(
         "mlp_unify_b2048",
@@ -94,6 +100,26 @@ def main():
     speedups.append(run(
         "dlrm_b2048",
         lambda m: build_dlrm(m, 2048), machine, degrees))
+    # the conv giants (140-320 op PCGs) get a smaller best-first budget on
+    # this 1-core host; their searched optimum IS the DP baseline (dense
+    # conv nets have no cheaper sharding at these scales — the reference's
+    # artifact likewise reports its smallest wins here)
+    speedups.append(run(
+        "resnext50_b16",
+        lambda m: build_resnext50(m, 16), machine, degrees, budget=6))
+    # inception's 318-op PCG makes each best-first candidate's DP cost
+    # minutes on this 1-core host; resnext50 already pins the conv-giant
+    # class (searched optimum == DP). Opt in with --include-inception.
+    if args.include_inception:
+        speedups.append(run(
+            "inception_b64",
+            lambda m: build_inception_v3(m, 64), machine, degrees, budget=2))
+    speedups.append(run(
+        "candle_uno_b64",
+        lambda m: build_candle_uno(m, 64), machine, degrees))
+    speedups.append(run(
+        "xdl_b1024",
+        lambda m: build_xdl(m, 1024), machine, degrees))
     valid = [s for s in speedups if s]
     print(json.dumps({
         "metric": "unity_sim_speedup_vs_dp_geomean",
