@@ -30,11 +30,18 @@ validity mask (cache position <= query position), which both enforces
 causal attention and hides the cache's unwritten tail; for causal models
 this reproduces the full forward bit-for-bit modulo float association
 (asserted against the full forward in tests/test_serving_qa.py).
-Caveat: causality of PRIMITIVE-op attention cannot be proven statically
-(the mask lives in baked constants) — the analysis ASSUMES the decoder's
-self-attention is causal and the injected mask enforces it, so a
-bidirectional/prefix-LM import decodes causally instead of erroring; the
-fused-MHA path does reject non-causal self-attention at build time.
+
+Causality of PRIMITIVE-op attention: the injected mask is only exact if
+the graph's own attention IS causal, and for imported graphs that fact
+lives in baked mask constants. build_plan PROVES it where it can — it
+walks the live chain between the score matmul and each prefix softmax
+looking for a baked constant aligned to the (query, key) plane whose
+strict upper triangle is masked (additive <= -1e4, or all-False for a
+boolean where-condition) — and otherwise REFUSES to build unless the
+caller passes assume_causal=True. A bidirectional/prefix-LM import
+therefore errors at build time instead of silently decoding causally;
+the fused-MHA path already rejects non-causal self-attention via its
+op params.
 """
 from __future__ import annotations
 
@@ -116,6 +123,9 @@ class _Propagator:
         self.info: Dict[int, AxisInfo] = {}
         self.cached: set = set()
         self.saw_static_slicing = False
+        # softmax ops over a prefix axis (primitive-op attention rows):
+        # each needs a causality proof or an assume_causal opt-in
+        self.prefix_softmaxes: List = []
 
     def get(self, guid) -> AxisInfo:
         return self.info.get(guid, AxisInfo())
@@ -209,6 +219,8 @@ class _Propagator:
                 fail("softmax over the live axis")
             # softmax over the prefix axis is the attention row softmax;
             # the step injects the causality/validity mask there
+            if dim == a.prefix:
+                self.prefix_softmaxes.append(op)
             set_out(0, a)
             return
 
@@ -384,11 +396,190 @@ class _Propagator:
         fail("op mixes sequence positions and has no decode rule")
 
 
-def build_plan(topo, input_pts, constants, decode_input: Optional[int] = None):
+def _is_causal_mask_constant(arr, live_ax: int, prefix_ax: int) -> bool:
+    """True iff the baked constant masks every future position in the
+    (query=live, key=prefix) plane: additive masks have strict-upper
+    entries <= -1e4 for every leading index; boolean where-conditions
+    (True = keep) have them all False. Entries on/below the diagonal are
+    unconstrained — a combined bias+mask (T5-style) still proves causal."""
+    v = np.asarray(arr)
+    if v.ndim < 2:
+        return False
+    v = np.moveaxis(v, (live_ax, prefix_ax), (-2, -1))
+    L = min(v.shape[-2], v.shape[-1])
+    iu = np.triu_indices(n=v.shape[-2], k=1, m=v.shape[-1])
+    if iu[0].size == 0:
+        return L > 0  # 1x1 plane: nothing future-facing to mask
+    upper = v[..., iu[0], iu[1]]
+    if v.dtype == np.bool_:
+        return not bool(upper.any())
+    if not np.issubdtype(v.dtype, np.floating):
+        return False
+    return bool(np.all(upper <= -1e4))
+
+
+def _static_chain_causal(guid: int, q_ax: int, k_ax: int, producer,
+                         constants, live_len: int, depth: int = 0) -> bool:
+    """Does the STATIC value `guid` carry a causal mask on its (q_ax, k_ax)
+    plane? Baked constants are checked directly; computed statics (e.g.
+    T5's position_bias = relative-bias-embedding + baked causal mask) are
+    traced through mask-preserving ops: EW_ADD (adding anything finite to
+    a -inf-masked entry keeps it masked), axis-remapping transpose/
+    (un)squeeze, and cast/identity. Anything else ends the proof."""
+    if depth > 32:
+        return False
+    if guid in constants:
+        _, value = constants[guid]
+        if not isinstance(value, np.ndarray):
+            return False
+        if (value.ndim <= max(q_ax, k_ax)
+                or value.shape[q_ax] != live_len
+                or value.shape[k_ax] != live_len):
+            return False
+        return _is_causal_mask_constant(value, q_ax, k_ax)
+    p = producer.get(guid)
+    if p is None:
+        return False  # a graph input: value unknown at build time
+    t = p.op_type
+    out_rank = len(p.outputs[0].material_shape())
+    if t == OperatorType.OP_CAST:
+        # only float->float preserves additive-mask semantics (a -1e9 mask
+        # cast to bool becomes all-True — the OPPOSITE of masked)
+        import numpy as _np
+        src_f = _np.issubdtype(p.inputs[0].data_type.np_dtype, _np.floating)
+        dst_f = _np.issubdtype(p.outputs[0].data_type.np_dtype, _np.floating)
+        if not (src_f and dst_f):
+            return False
+        return _static_chain_causal(p.inputs[0].guid, q_ax, k_ax, producer,
+                                    constants, live_len, depth + 1)
+    if getattr(p, "is_parallel_op", False) or t in (
+        OperatorType.OP_NOOP, OperatorType.OP_IDENTITY,
+        OperatorType.OP_DROPOUT,
+    ):
+        return _static_chain_causal(p.inputs[0].guid, q_ax, k_ax, producer,
+                                    constants, live_len, depth + 1)
+    if t in (OperatorType.OP_EW_ADD,):
+        for x in p.inputs:
+            s = tuple(x.material_shape())
+            off = out_rank - len(s)
+            qa, ka = q_ax - off, k_ax - off
+            if (qa >= 0 and ka >= 0 and s[qa] == live_len
+                    and s[ka] == live_len
+                    and _static_chain_causal(x.guid, qa, ka, producer,
+                                             constants, live_len, depth + 1)):
+                return True
+        return False
+    if t == OperatorType.OP_TRANSPOSE:
+        perm = list(p.params.perm)
+        return _static_chain_causal(p.inputs[0].guid, perm[q_ax], perm[k_ax],
+                                    producer, constants, live_len, depth + 1)
+    if t == OperatorType.OP_UNSQUEEZE:
+        added = sorted(ax % out_rank for ax in p.params.axes)
+        if q_ax in added or k_ax in added:
+            return False
+
+        def back(axis):
+            return axis - sum(1 for ad in added if ad < axis)
+        return _static_chain_causal(p.inputs[0].guid, back(q_ax), back(k_ax),
+                                    producer, constants, live_len, depth + 1)
+    if t == OperatorType.OP_SQUEEZE:
+        in_rank = len(p.inputs[0].material_shape())
+        removed = sorted(ax % in_rank for ax in p.params.axes)
+
+        def fwd(axis):
+            for r in removed:
+                if r <= axis:
+                    axis += 1
+            return axis
+        return _static_chain_causal(p.inputs[0].guid, fwd(q_ax), fwd(k_ax),
+                                    producer, constants, live_len, depth + 1)
+    return False
+
+
+def _prove_causal(softmax_op, prop: "_Propagator", live_ops, static_ops,
+                  constants, live_len: int) -> bool:
+    """Walk the live chain feeding a prefix softmax (back to the score
+    matmul that created the prefix axis) and look for a static operand,
+    aligned to the (live, prefix) plane, that provably masks the strict
+    upper triangle (directly baked, or computed from a baked causal mask —
+    _static_chain_causal). Finding one proves the graph's own attention is
+    causal, so the injected decode mask reproduces the full forward."""
+    producer = {}
+    for op in list(live_ops) + list(static_ops):
+        for t in op.outputs:
+            producer[t.guid] = op
+
+    seen = set()
+    stack = [softmax_op.inputs[0].guid]
+    while stack:
+        guid = stack.pop()
+        if guid in seen:
+            continue
+        seen.add(guid)
+        p = producer.get(guid)
+        if p is None:
+            continue
+        if getattr(p, "is_parallel_op", False):
+            stack.append(p.inputs[0].guid)
+            continue
+        out_info = prop.get(p.outputs[0].guid)
+        if out_info.prefix is None:
+            continue  # left the attention-score region
+        made_prefix = all(
+            prop.get(x.guid).prefix is None for x in p.inputs
+        )
+        out_rank = len(p.outputs[0].material_shape())
+        # Check this op's non-live operands for a provable mask — but ONLY
+        # where the op APPLIES the operand in a mask-preserving way:
+        #   * EW_ADD: adding a -inf-masked operand masks the output;
+        #   * WHERE(cond, x, y): a tril boolean condition proves causal
+        #     only if the else-branch y is itself provably <= -1e4.
+        # An EW_SUB of a tril-negative constant would UNMASK the future,
+        # and a WHERE with a finite else-branch doesn't mask at all — a
+        # causal-looking constant on those ops must not count as proof.
+        t = p.op_type
+        if t == OperatorType.OP_EW_ADD:
+            candidates = list(p.inputs)
+        elif t == OperatorType.OP_WHERE and len(p.inputs) == 3:
+            y = p.inputs[2]
+            y_masked = False
+            if y.guid in constants:
+                _, yv = constants[y.guid]
+                yarr = np.asarray(yv)
+                y_masked = (np.issubdtype(yarr.dtype, np.floating)
+                            and bool(np.all(yarr <= -1e4)))
+            candidates = [p.inputs[0]] if y_masked else []
+        else:
+            candidates = []
+        for x in candidates:
+            if prop.get(x.guid).is_live:
+                continue
+            amap = _static_alignment(
+                tuple(x.material_shape()), out_rank, out_info, live_len,
+            )
+            axes = dict((kind, ax) for ax, kind in amap)
+            if "live" in axes and "prefix" in axes and _static_chain_causal(
+                x.guid, axes["live"], axes["prefix"], producer, constants,
+                live_len,
+            ):
+                return True
+        if not made_prefix:
+            for x in p.inputs:
+                if prop.get(x.guid).is_live:
+                    stack.append(x.guid)
+    return False
+
+
+def build_plan(topo, input_pts, constants, decode_input: Optional[int] = None,
+               assume_causal: bool = False):
     """Classify ops/tensors and validate decodability.
 
     decode_input: index into input_pts of the decode-driven input; default
     is the last input (enc-dec convention: (encoder_ids, decoder_ids)).
+    assume_causal: skip the causality proof for primitive-op attention
+    (graphs whose masks are computed rather than baked can't be verified
+    at build time — the caller vouches that decoder self-attention is
+    causal).
     """
     inputs = list(input_pts)
     if decode_input is None:
@@ -430,6 +621,19 @@ def build_plan(topo, input_pts, constants, decode_input: Optional[int] = None):
             if not prop.get(x.guid).is_live and x.guid in static_out:
                 if x.guid not in needed:
                     needed.append(x.guid)
+
+    if not assume_causal:
+        for sm in prop.prefix_softmaxes:
+            if not _prove_causal(sm, prop, live_ops, static_ops, constants,
+                                 live_len):
+                raise NotImplementedError(
+                    f"{sm.name} ({sm.op_type.name}): primitive-op attention "
+                    "whose causality can't be proven from baked mask "
+                    "constants — the decode step would inject a causal "
+                    "mask, which is wrong for bidirectional/prefix-LM "
+                    "graphs. Pass assume_causal=True to vouch that "
+                    "decoder self-attention is causal."
+                )
     return DecodePlan(
         live_ops=live_ops,
         static_ops=static_ops,

@@ -719,7 +719,8 @@ class PCGExecutor:
 
     # -- incremental decode (serving KV cache) ------------------------------
     def build_decode(self, batch: int, max_len: int, cache_dtype=None,
-                     decode_input: Optional[int] = None):
+                     decode_input: Optional[int] = None,
+                     assume_causal: bool = False):
         """(init_caches, step) for KV-cache autoregressive decoding over an
         arbitrary causal decoder or encoder-decoder PCG (the liveness/
         prefix analysis in parallel/decode.py — graphs imported from HF
@@ -741,13 +742,31 @@ class PCGExecutor:
         from . import decode as dec
         from ..ops.attention import cross_decode_kv, init_decode_cache
 
-        key = (batch, max_len, cache_dtype, decode_input)
+        key = (batch, max_len, cache_dtype, decode_input, assume_causal)
         cached = self._decode_builds.get(key)
         if cached is not None:
             return cached
 
         plan = dec.build_plan(self.topo, self.input_pts, self.constants,
-                              decode_input)
+                              decode_input, assume_causal=assume_causal)
+        # prefix caches patch ONLY axis 0 to the decode batch; a graph that
+        # folds batch with heads on axis 0 (B*H, ...) would get a
+        # wrong-sized cache when decoding at a different batch than
+        # compile (beam search at num_beams) — reject at build like the
+        # other exactness checks
+        compile_batch = plan.decode_pt.material_shape()[0]
+        for g in plan.cached_guids:
+            pt = next(x for op in plan.live_ops for x in op.outputs
+                      if x.guid == g)
+            if plan.info[g].live != 0 and \
+                    pt.material_shape()[0] != compile_batch:
+                raise NotImplementedError(
+                    f"cached tensor guid {g} has axis-0 size "
+                    f"{pt.material_shape()[0]} != compiled batch "
+                    f"{compile_batch}: its batch dim is folded with "
+                    "another axis, so decoding at a different batch "
+                    "would mis-size the cache"
+                )
         if plan.requires_cap_le_live_len and max_len > plan.live_len:
             raise NotImplementedError(
                 f"max_len {max_len} > compiled decoder length "

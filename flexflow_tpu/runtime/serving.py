@@ -97,6 +97,7 @@ def incremental_seq2seq_generate(
     start_token_id: int = 0,
     eos_token_id: Optional[int] = None,
     pad_token_id: int = 0,
+    assume_causal: bool = False,
 ) -> np.ndarray:
     """KV-cache greedy decode for a compiled encoder-decoder FFModel —
     same signature and token-exact output as greedy_generate, but
@@ -122,7 +123,8 @@ def incremental_seq2seq_generate(
     if steps <= 0:
         out = np.full((bs, 1), start_token_id, dec_t.data_type.np_dtype)
         return out
-    init_caches, step = ex.build_decode(bs, dec_len)
+    init_caches, step = ex.build_decode(bs, dec_len,
+                                        assume_causal=assume_causal)
     caches = init_caches(
         model.state.params,
         [np.asarray(encoder_ids, enc_t.data_type.np_dtype)],
@@ -151,6 +153,9 @@ def incremental_generate(
     max_len: Optional[int] = None,
     eos_token_id: Optional[int] = None,
     pad_token_id: int = 0,
+    static_inputs=(),
+    decode_input: Optional[int] = None,
+    assume_causal: bool = False,
 ) -> np.ndarray:
     """KV-cache autoregressive decoding for a causal decoder-only FFModel
     (token ids in, per-position vocab logits out): each step feeds ONE
@@ -160,7 +165,14 @@ def incremental_generate(
     lacks entirely (its Triton prototype serves single forwards).
 
     prompt_ids: (batch, prompt_len) int array. Returns (batch, total_len)
-    including the prompt."""
+    including the prompt.
+
+    static_inputs: arrays for any non-decode graph inputs (e.g. an
+    explicit attention-mask input), passed through to init_caches;
+    decode_input selects which graph input the prompt drives (default:
+    build_decode's convention, the last); assume_causal vouches for
+    primitive-op attention whose causality can't be proven from baked
+    constants (parallel/decode.py)."""
     assert model.executor is not None, "compile() the model first"
     prompt_ids = np.asarray(prompt_ids)
     bs, plen = prompt_ids.shape
@@ -169,9 +181,13 @@ def incremental_generate(
     total = plen + max_new_tokens
     cap = max_len or total
     assert cap >= total, f"max_len {cap} < prompt+new {total}"
-    init_caches, step = model.executor.build_decode(bs, cap)
-    caches = init_caches(model.state.params, [])
-    in_t = model._fit_input_tensors[0]
+    init_caches, step = model.executor.build_decode(
+        bs, cap, decode_input=decode_input, assume_causal=assume_causal
+    )
+    caches = init_caches(model.state.params, list(static_inputs))
+    dec_idx = (decode_input if decode_input is not None
+               else len(model._fit_input_tensors) - 1)
+    in_t = model._fit_input_tensors[dec_idx]
     id_dt = in_t.data_type.np_dtype
 
     out = np.full((bs, total), pad_token_id, id_dt)
@@ -213,6 +229,8 @@ def incremental_beam_generate(
     eos_token_id: Optional[int] = None,
     pad_token_id: int = 0,
     encoder_ids: Optional[np.ndarray] = None,
+    static_inputs=(),
+    assume_causal: bool = False,
 ) -> np.ndarray:
     """Beam search over the KV-cache decoder: the decode step is built at
     batch=num_beams (build_decode jits for any batch, so no
@@ -237,7 +255,9 @@ def incremental_beam_generate(
     total = plen + max_new_tokens
     cap = max_len or total
     assert cap >= total, f"max_len {cap} < prompt+new {total}"
-    init_caches, step = model.executor.build_decode(num_beams, cap)
+    init_caches, step = model.executor.build_decode(
+        num_beams, cap, assume_causal=assume_causal
+    )
     id_dt = in_t.data_type.np_dtype
     prob_hint = model.output_probability_like()
     if encoder_ids is not None:
@@ -248,12 +268,16 @@ def incremental_beam_generate(
     outs = []
     for i, row in enumerate(prompt_ids.astype(id_dt)):
         if encoder_ids is None:
-            caches = init_caches(model.state.params, [])
+            # static_inputs (if any) must be shaped for batch=num_beams
+            caches = init_caches(model.state.params, list(static_inputs))
         else:
             enc_block = np.broadcast_to(
                 enc_rows[i], (num_beams,) + enc_rows[i].shape
             ).copy()
-            caches = init_caches(model.state.params, [enc_block])
+            # static_inputs are the non-decode inputs AFTER the encoder
+            # ids (input order), shaped for batch=num_beams
+            caches = init_caches(model.state.params,
+                                 [enc_block] + list(static_inputs))
         beams = np.full((num_beams, total), pad_token_id, id_dt)
         beams[:, :plen] = row
         scores = np.full(num_beams, -np.inf)

@@ -107,6 +107,45 @@ _DEFAULT_CALIBRATION: Optional[dict] = None
 _DEFAULT_CALIBRATION_LOADED = False
 
 
+def validate_calibration(cal: dict) -> dict:
+    """Reject out-of-range calibration values at load time: efficiencies
+    must lie in (0, 1] (a 0.0 or negative value would otherwise silently
+    produce infinite/negative op costs) and bwd/fwd ratios must be
+    positive."""
+    def check_eff(name, v):
+        if v is None:
+            return
+        if not isinstance(v, (int, float)) or not (0.0 < v <= 1.0):
+            raise ValueError(
+                f"calibration {name}={v!r} outside (0, 1]"
+            )
+
+    if not isinstance(cal, dict):
+        raise ValueError(f"calibration must be a dict, got {type(cal)}")
+    op_class = cal.get("op_class", {})
+    if not isinstance(op_class, dict):
+        raise ValueError("calibration op_class must be a dict")
+    check_eff("mxu_efficiency", cal.get("mxu_efficiency"))
+    check_eff("hbm_efficiency", cal.get("hbm_efficiency"))
+    for op_name, cls in op_class.items():
+        if not isinstance(cls, dict):
+            raise ValueError(
+                f"calibration op_class[{op_name}] must be a dict"
+            )
+        check_eff(f"op_class[{op_name}].mxu_efficiency",
+                  cls.get("mxu_efficiency"))
+        check_eff(f"op_class[{op_name}].hbm_efficiency",
+                  cls.get("hbm_efficiency"))
+        ratio = cls.get("bwd_over_fwd")
+        if ratio is not None and (
+                not isinstance(ratio, (int, float)) or ratio <= 0):
+            raise ValueError(
+                f"calibration op_class[{op_name}].bwd_over_fwd={ratio!r} "
+                "must be positive"
+            )
+    return cal
+
+
 def load_default_calibration() -> Optional[dict]:
     """The shipped on-silicon calibration (tools/calibrate_cost_model.py
     output, flexflow_tpu/search/calibration_v5e.json): per-op-class
@@ -124,7 +163,7 @@ def load_default_calibration() -> Optional[dict]:
         if os.path.exists(path):
             try:
                 with open(path) as f:
-                    _DEFAULT_CALIBRATION = json.load(f)
+                    _DEFAULT_CALIBRATION = validate_calibration(json.load(f))
             except (OSError, ValueError):
                 _DEFAULT_CALIBRATION = None
     return _DEFAULT_CALIBRATION
@@ -153,7 +192,9 @@ class CostModel:
             import json
 
             with open(calibration) as f:
-                calibration = json.load(f)
+                calibration = validate_calibration(json.load(f))
+        elif isinstance(calibration, dict):
+            validate_calibration(calibration)
         self.calibration = calibration
         self._cache: Dict[Tuple, CostMetrics] = {}
         self._xfer_cache: Dict[Tuple, float] = {}

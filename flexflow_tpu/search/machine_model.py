@@ -134,10 +134,15 @@ class MachineModel:
         peak = (
             self.chip.peak_flops_bf16 if dtype_is_bf16 else self.chip.peak_flops_f32
         )
-        t_flops = flops / (peak * (mxu_eff or self.mxu_efficiency))
-        t_mem = mem_bytes / (
-            self.chip.hbm_bandwidth * (hbm_eff or self.hbm_efficiency)
-        )
+        # `is None`, not truthiness: a calibrated efficiency of 0.0 from a
+        # hand-edited file must be rejected upstream, never silently
+        # replaced by the global constant
+        if mxu_eff is None:
+            mxu_eff = self.mxu_efficiency
+        if hbm_eff is None:
+            hbm_eff = self.hbm_efficiency
+        t_flops = flops / (peak * mxu_eff)
+        t_mem = mem_bytes / (self.chip.hbm_bandwidth * hbm_eff)
         return max(t_flops, t_mem)
 
 

@@ -348,6 +348,17 @@ def _infer_outputs(op: PCGOp, src_op: Optional[PCGOp]) -> List[ParallelTensor]:
     for out in outs:
         if t == OperatorType.OP_BATCHMATMUL and len(ins) == 2:
             a, b = ins
+            # a partitioned contraction dim is a PARTIAL SUM needing
+            # OP_REDUCTION — degree propagation can't express it, and
+            # silently dropping the degree lets the search mis-price the
+            # candidate (e.g. a "batch" rule matched against a rank-2
+            # matmul, where rhs dim 0 IS the contraction dim). Raising
+            # here makes apply_rule skip the match site.
+            if a.dims[-1].degree > 1 or b.dims[-2].degree > 1:
+                raise ValueError(
+                    "batchmatmul contraction dim partitioned: needs an "
+                    "OP_REDUCTION rewrite, not degree propagation"
+                )
             # (..., m, k) x (..., k, n): batch+m dims follow a, n follows b
             for i in range(len(out.dims) - 1):
                 if i < len(a.dims) - 1:

@@ -111,6 +111,28 @@ def test_sharded_op_cheaper_but_sync_appears(machine):
     assert c4.sync_time > 0.0
 
 
+def test_calibration_values_validated_on_load(machine):
+    """ADVICE r2: a hand-edited calibration with an efficiency of 0.0 (or
+    any falsy/out-of-range value) must be rejected at load, not silently
+    treated as absent by an `or` fallback."""
+    import pytest as _pytest
+
+    for bad in (
+        {"mxu_efficiency": 0.0},
+        {"hbm_efficiency": -0.5},
+        {"op_class": {"OP_LINEAR": {"mxu_efficiency": 1.5}}},
+        {"op_class": {"OP_LINEAR": {"bwd_over_fwd": 0.0}}},
+    ):
+        with _pytest.raises(ValueError):
+            CostModel(machine, calibration=bad)
+    # in-range values load fine
+    CostModel(machine, calibration={
+        "mxu_efficiency": 0.6,
+        "op_class": {"OP_LINEAR": {"mxu_efficiency": 0.5,
+                                   "bwd_over_fwd": 2.0}},
+    })
+
+
 def test_allreduce_and_xfer_costs(machine):
     assert machine.allreduce_cost(1 << 20, [0, 1, 2, 3]) > 0
     assert machine.xfer_cost(1 << 20, 0, 0) == 0.0

@@ -5,6 +5,7 @@ is the ONNX frontend and the backend is runtime/serving.BatchScheduler over
 the jitted forward."""
 import threading
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -616,6 +617,45 @@ def test_decode_static_input_consumed_by_live_op():
         )
 
 
+def test_incremental_generate_accepts_static_inputs():
+    """ADVICE r2: incremental_generate hardcoded init_caches(params, [])
+    — a decoder-only graph with an extra static input (explicit bias/mask
+    input) had no way to supply it. static_inputs + decode_input now pass
+    through to build_decode/init_caches."""
+    from flexflow_tpu import (AggrMode, DataType, FFConfig, FFModel,
+                              LossType, MetricsType, SGDOptimizer)
+    from flexflow_tpu.runtime.serving import incremental_generate
+
+    vocab, dec_len, hidden = 24, 8, 16
+    bs = 2
+    cfg = FFConfig()
+    cfg.batch_size = bs
+    m = FFModel(cfg)
+    dec_ids = m.create_tensor((bs, dec_len), DataType.DT_INT32)
+    bias_in = m.create_tensor((bs, dec_len, hidden), DataType.DT_FLOAT)
+    t = m.embedding(dec_ids, vocab, hidden, AggrMode.AGGR_MODE_NONE)
+    t = m.add(t, bias_in)
+    t = m.multihead_attention(t, t, t, hidden, 2, causal=True)
+    t = m.dense(t, vocab)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+
+    rng = np.random.RandomState(4)
+    prompt = rng.randint(0, vocab, (bs, 3)).astype(np.int32)
+    xb = rng.randn(bs, dec_len, hidden).astype(np.float32)
+    out = incremental_generate(
+        m, prompt, max_new_tokens=3, max_len=dec_len,
+        static_inputs=[xb], decode_input=0,
+    )
+    assert out.shape == (bs, 6)
+    assert (out[:, :3] == prompt).all()
+    # without static_inputs the init assert fires with a clear message
+    with pytest.raises(AssertionError, match="static"):
+        incremental_generate(m, prompt, max_new_tokens=3,
+                             max_len=dec_len, decode_input=0)
+
+
 def test_build_decode_rejects_linear_over_prefix_axis():
     """A dense layer contracting the prefix (cache-length) axis would
     read the cache's unwritten zero tail — must be rejected at build."""
@@ -659,6 +699,85 @@ def test_build_decode_rejects_causal_cross_attention():
               [MetricsType.METRICS_ACCURACY])
     with pytest.raises(NotImplementedError):
         m.executor.build_decode(2, 6)
+
+
+def _bidirectional_primitive_attention_model():
+    """A decodable-shaped primitive-op attention graph with NO causal
+    mask anywhere — i.e. a bidirectional/prefix-LM import."""
+    from flexflow_tpu import (AggrMode, DataType, FFConfig, FFModel,
+                              LossType, MetricsType, SGDOptimizer)
+
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    m = FFModel(cfg)
+    ids = m.create_tensor((2, 6), DataType.DT_INT32)
+    t = m.embedding(ids, 16, 8, AggrMode.AGGR_MODE_NONE)
+    scores = m.batch_matmul(t, m.transpose(t, (0, 2, 1)))  # (2, 6, 6)
+    probs = m.softmax(scores, axis=-1)
+    ctx = m.batch_matmul(probs, t)  # (2, 6, 8)
+    m.dense(ctx, 4)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+              [MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    return m
+
+
+def test_build_decode_rejects_unproven_causality():
+    """ADVICE r2: a bidirectional import (primitive-op attention with no
+    causal mask constant) must ERROR at build time — the injected decode
+    mask would silently change its semantics vs the full forward. The
+    explicit assume_causal=True opt-in vouches for causality and builds."""
+    m = _bidirectional_primitive_attention_model()
+    with pytest.raises(NotImplementedError, match="assume_causal"):
+        m.executor.build_decode(2, 6)
+    # the opt-in builds (and then decodes causally, as vouched)
+    init_caches, step = m.executor.build_decode(2, 6, assume_causal=True)
+    caches = init_caches(m.state.params, [])
+    logits, _ = step(m.state.params, caches, jnp.int32(0),
+                     [jnp.zeros((2, 1), np.int32)])
+    assert np.asarray(logits).shape == (2, 1, 4)
+
+
+def test_prove_causal_accepts_baked_tril_mask():
+    """Causality IS provable when the graph bakes a lower-triangular
+    additive mask feeding the prefix softmax (the mt5 import proves this
+    through its static position_bias chain; this pins the direct-constant
+    case) — build_decode succeeds without assume_causal and matches the
+    full forward."""
+    from flexflow_tpu import (AggrMode, DataType, FFConfig, FFModel,
+                              LossType, MetricsType, SGDOptimizer)
+
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    m = FFModel(cfg)
+    ids = m.create_tensor((2, 6), DataType.DT_INT32)
+    t = m.embedding(ids, 16, 8, AggrMode.AGGR_MODE_NONE)
+    scores = m.batch_matmul(t, m.transpose(t, (0, 2, 1)))  # (2, 6, 6)
+    mask = np.where(
+        np.tril(np.ones((6, 6), bool)), 0.0, -1e9
+    ).astype(np.float32)[None]
+    masked = m.add(scores, m.create_constant_tensor(mask, DataType.DT_FLOAT))
+    probs = m.softmax(masked, axis=-1)
+    ctx = m.batch_matmul(probs, t)
+    m.dense(ctx, 4)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+              [MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    init_caches, step = m.executor.build_decode(2, 6)  # no assume_causal
+    caches = init_caches(m.state.params, [])
+    rng = np.random.RandomState(3)
+    xs = rng.randint(0, 16, (2, 6)).astype(np.int32)
+    full = np.asarray(m.executor.build_forward()(
+        m.state.params, [jnp.asarray(xs)]
+    ))
+    for t_ in range(6):
+        logits, caches = step(
+            m.state.params, caches, jnp.int32(t_),
+            [jnp.asarray(xs[:, t_:t_ + 1])],
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits)[:, 0], full[:, t_], rtol=2e-4, atol=2e-4,
+        )
 
 
 def test_as_log_probs_uses_structural_hint():

@@ -131,6 +131,37 @@ def test_json_rule_degree_propagates_to_op_output():
     assert lin.outputs[0].dims[0].degree == 2
 
 
+def test_batch_matmul_rule_skips_rank2_contraction_sites():
+    """ADVICE r2: partition_matmul_batch_* partitions BOTH operands on
+    dim 0 — valid data parallelism at rank >= 3, but at rank 2 the rhs
+    dim 0 IS the contraction dim (a partial sum needing OP_REDUCTION).
+    The loader must skip the rank-2 match site instead of silently
+    dropping the rhs degree and letting the search mis-price it."""
+    from flexflow_tpu.search.substitution_loader import default_rules_path
+
+    rules = load_rule_collection_from_path(default_rules_path())
+    batch_rules = [r for r in rules if "matmul_batch" in r.name]
+    assert batch_rules, "shipped corpus lost its matmul batch rules"
+
+    # rank-2 matmul: every batch rule must produce NO candidates
+    m2 = FFModel(FFConfig())
+    a2 = m2.create_tensor((64, 32), DataType.DT_FLOAT)
+    b2 = m2.create_tensor((32, 16), DataType.DT_FLOAT)
+    m2.batch_matmul(a2, b2)
+    g2, _ = layers_to_pcg(m2.layers)
+    for r in batch_rules:
+        assert list(apply_rule(g2, r)) == [], r.name
+
+    # rank-3: the same rules still fire (true batch dim)
+    m3 = FFModel(FFConfig())
+    a3 = m3.create_tensor((8, 32, 32), DataType.DT_FLOAT)
+    b3 = m3.create_tensor((8, 32, 16), DataType.DT_FLOAT)
+    m3.batch_matmul(a3, b3)
+    g3, _ = layers_to_pcg(m3.layers)
+    fired = [r.name for r in batch_rules if list(apply_rule(g3, r))]
+    assert fired, "rank-3 batch matmul rules stopped applying"
+
+
 def test_column_parallel_matmul_rule_beats_programmatic_xfers():
     """A batch-1 matmul chain: the programmatic xfer vocabulary has no
     rewrite for it (batch partitioning needs a divisible sample dim), but

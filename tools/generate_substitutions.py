@@ -64,7 +64,10 @@ def unary_batch(op_type, short, d):
 
 
 def binary_batch(op_type, short, d):
-    """Both operands partitioned over dim 0."""
+    """Both operands partitioned over dim 0. For OP_BATCHMATMUL this is
+    only meaningful at rank >= 3 (at rank 2 the rhs dim 0 is the
+    contraction dim — a partial sum, not data parallelism); the loader's
+    _infer_outputs rejects such matches, so rank-2 sites are skipped."""
     return rule(
         f"partition_{short}_batch_{d}",
         src=[op(op_type, [t(-1), t(-2)])],
