@@ -123,12 +123,24 @@ class Graph:
         return True
 
     def hash(self) -> int:
-        """Structural hash (reference: Graph::hash used in dp_state_hash)."""
+        """Structural hash (reference: Graph::hash used in dp_state_hash).
+
+        MUST fold output and weight shape keys, not just inputs: rewrites
+        that only change weight/output parallel degrees (attention
+        head-partition, embedding channel-split) are otherwise
+        hash-identical to the unrewritten graph — the best-first search
+        deduplicates by this hash and would silently drop the whole
+        attribute-/parameter-parallel candidate class."""
         h = 17
         for op in self.topo_order():
             key = (op.op_type, op.params)
             mv = op.machine_view.hash() if op.machine_view else 0
-            h = hash((h, key, mv, tuple(t.get_shape().key() for t in op.inputs)))
+            h = hash((
+                h, key, mv,
+                tuple(t.shape_key() for t in op.inputs),
+                tuple(t.shape_key() for t in op.outputs),
+                tuple(w.shape_key() for w in op.weights),
+            ))
         return h
 
     # -- dot export (reference: Graph::export_strategy_computation_graph,

@@ -25,6 +25,13 @@ class MachineView:
     dim: Tuple[int, ...] = (1,)
     stride: Tuple[int, ...] = (1,)
 
+    def __post_init__(self):
+        # hash() is on the DP search's innermost memo-key path (tens of
+        # millions of calls on a 300-op PCG) — precompute once
+        object.__setattr__(self, "_hash", hash(
+            (self.device_type, self.start_device_id, self.dim, self.stride)
+        ))
+
     @property
     def ndims(self) -> int:
         return len(self.dim)
@@ -58,7 +65,7 @@ class MachineView:
         return ids
 
     def hash(self) -> int:
-        return hash((self.device_type, self.start_device_id, self.dim, self.stride))
+        return self._hash
 
     def __repr__(self):
         return (
@@ -82,13 +89,19 @@ class MachineResource:
         return self.num_nodes * self.available_procs_per_node
 
     def is_valid_machine_view(self, view: MachineView) -> bool:
-        """reference: machine_view.cc MachineResource::is_valid_machine_view."""
+        """reference: machine_view.cc MachineResource::is_valid_machine_view.
+        The local-proc window STARTS at start_gpu_id's local offset — the
+        two halves of a vertical machine split must be DISJOINT device
+        sets, or "concurrent" towers would silently share chips (and no
+        boundary transfer or congestion could ever be priced between
+        them)."""
+        lo = self.start_gpu_id % self.all_procs_per_node
         for dev_id in (view.start_device_id, view.device_ids()[-1]):
             node = dev_id // self.all_procs_per_node
             local = dev_id % self.all_procs_per_node
             if node < self.start_node_id or node >= self.start_node_id + self.num_nodes:
                 return False
-            if local >= self.available_procs_per_node:
+            if local < lo or local >= lo + self.available_procs_per_node:
                 return False
         return True
 

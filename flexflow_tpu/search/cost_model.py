@@ -326,6 +326,48 @@ class CostModel:
         self._xfer_cache[key] = worst
         return worst
 
+    def concurrent_xfer_penalty(self, flows) -> float:
+        """Congestion surcharge for transfers that happen AT THE SAME TIME
+        (an op pulling several inputs; concurrent nonsequence halves
+        pulling their boundary tensors; a diamond sink draining its
+        towers). flows: [(tensor, src_view, dst_view), ...].
+
+        Priced through the machine's concurrent_flows_cost (the
+        topology-aware link-sharing model, network.py — reference:
+        EnhancedMachineModel congestion over shared comm devices,
+        machine_model.cc): penalty = finish time of the flow SET minus the
+        slowest flow alone, i.e. exactly the cost the independent
+        per-transfer estimates miss. Flat machine models (no
+        concurrent_flows_cost) price zero — link sharing is invisible to
+        them by construction."""
+        conc_fn = getattr(self.machine, "concurrent_flows_cost", None)
+        if conc_fn is None:
+            return 0.0
+        pt_flows = []
+        for tensor, src_view, dst_view in flows:
+            if src_view is None or dst_view is None:
+                continue
+            if src_view.hash() == dst_view.hash():
+                continue
+            total = _vol(tensor.material_shape()) * tensor.data_type.size
+            if total <= 0:
+                continue
+            dst_ids = dst_view.device_ids()
+            per_dst = total / max(1, len(dst_ids))
+            pt_flows.append((per_dst, src_view.start_device_id,
+                             dst_view.start_device_id))
+        if len(pt_flows) < 2:
+            return 0.0
+        key = ("conc", tuple(sorted(pt_flows)))
+        cached = self._xfer_cache.get(key)
+        if cached is not None:
+            return cached
+        together = conc_fn(pt_flows)
+        alone = max(conc_fn([f]) for f in pt_flows)
+        penalty = max(0.0, together - alone)
+        self._xfer_cache[key] = penalty
+        return penalty
+
     def parallel_op_cost(self, op: PCGOp, view=None) -> float:
         """Cost of an explicit parallel op node (reshard collectives),
         priced through the machine model's collective methods so a

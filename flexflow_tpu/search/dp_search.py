@@ -57,6 +57,7 @@ class SearchHelper:
         self._memo: Dict[Tuple, GraphCostResult] = {}
         self._view_cache: Dict[Tuple, List[MachineView]] = {}
         self._node_cost_cache: Dict[Tuple, float] = {}
+        self._comp_cache: Dict[Tuple, List[List[PCGOp]]] = {}
 
     # -- machine view enumeration (reference: register_all_machine_views +
     #    Op::get_valid_machine_views) -----------------------------------
@@ -67,6 +68,29 @@ class SearchHelper:
         key = (degree, res.hash())
         if key in self._view_cache:
             return self._view_cache[key]
+        if degree == 1:
+            # Degree-1 ops run whole on ONE chip; the only placement that
+            # can matter is co-location with neighbors. One canonical
+            # start PER NODE keeps the cross-node choice (a consumer can
+            # follow its producer's node and dodge a DCN hop) while
+            # collapsing the intra-node singleton starts, which are
+            # cost-equivalent up to hop latency: the bandwidth term is
+            # start-independent, and sharded producers start at the
+            # sub-machine's own canonical chip, where estimate_xfer_cost
+            # already co-locates. 8 -> 1 views on a single slice shrinks
+            # the DP's boundary-view enumeration ~8x on unpartitioned
+            # regions (the bulk of a 300-op conv PCG).
+            lo = res.start_gpu_id % res.all_procs_per_node
+            views = [
+                MachineView(
+                    start_device_id=node * res.all_procs_per_node + lo,
+                    dim=(1,), stride=(1,),
+                )
+                for node in range(res.start_node_id,
+                                  res.start_node_id + res.num_nodes)
+            ]
+            self._view_cache[key] = views
+            return views
         views = [
             v
             for v in enumerate_machine_views(
@@ -75,8 +99,6 @@ class SearchHelper:
             if v.num_parts() == degree and res.is_valid_machine_view(v)
         ]
         views = views[: self.max_views_per_op]
-        if not views and degree == 1:
-            views = [MachineView(start_device_id=res.start_gpu_id, dim=(1,), stride=(1,))]
         self._view_cache[key] = views
         return views
 
@@ -106,9 +128,15 @@ class SearchHelper:
             # device); fall back to the op's view when no producer is known
             src = bounds.get(op.inputs[0].guid) if op.inputs else None
             total += self.cost_model.parallel_op_cost(op, src or view)
+        flows = []
         for t in op.inputs:
             src = bounds.get(t.guid)
             total += self.cost_model.estimate_xfer_cost(t, src, view)
+            flows.append((t, src, view))
+        if len(flows) > 1:
+            # an op's input transfers are simultaneous — shared links pay
+            # congestion (topology model; zero on flat machines)
+            total += self.cost_model.concurrent_xfer_penalty(flows)
         self._node_cost_cache[key] = total
         return total
 
@@ -220,11 +248,22 @@ class SearchHelper:
         # parallel towers the reference runs concurrently on half machines.
         prefix_max = max_reach[0]  # furthest reach of edges from ops[0..i-1]
         bottleneck = -1
-        for i in range(1, len(ops) - 1):
-            if prefix_max <= i:
-                bottleneck = i
-                break  # first bottleneck — reference splits at the earliest
-            prefix_max = max(prefix_max, max_reach[i])
+        # source peel: when removing the first op disconnects the rest,
+        # peeling it (pre = [ops[0]], post = the towers) is an exact
+        # sequence split — post sees the source's view via post_bounds —
+        # and it UNLOCKS the nonsequence machine-split option for
+        # shared-producer towers (reference: dominator-rooted splits,
+        # graph.cc find_split_node; without this, a connected
+        # source+towers blob falls to the diamond assigner, which never
+        # considers concurrent halves)
+        if len(ops) > 2 and len(self._components(ops[1:], graph)) > 1:
+            bottleneck = 0
+        if bottleneck < 0:
+            for i in range(1, len(ops) - 1):
+                if prefix_max <= i:
+                    bottleneck = i
+                    break  # first bottleneck — reference splits earliest
+                prefix_max = max(prefix_max, max_reach[i])
         if bottleneck >= 0:
             bn = ops[bottleneck]
             pre, post = ops[: bottleneck + 1], ops[bottleneck + 1 :]
@@ -254,13 +293,126 @@ class SearchHelper:
                 _rlog.info("best sequence cost %.4f", best.cost)
                 return best
 
-        # 2. fallback: connected, no bottleneck (diamond patterns — e.g.
-        #    Inception towers reconverging after substitution). Bounded
-        #    exact branch-and-bound over per-op views, beam search past the
-        #    budget. (Round 1 picked views greedily in topo order here,
-        #    which could silently return measurably suboptimal placements.)
+        # 2. sink-converging diamond (Inception modules: k independent
+        #    towers meeting at a concat): decompose EXACTLY — per tower,
+        #    DP the tower with its exit op's view fixed to each candidate
+        #    u; the sink's per-input xfer terms are separable per tower
+        #    given the sink view v, so
+        #      cost = min_v [ sink_op(v) + Σ_j min_u (tower_j(u) +
+        #                                            xfer(exit_j, u, v)) ].
+        #    This replaces the branch-and-bound/beam fallback for the
+        #    300-op conv PCGs where that blew up (minutes per candidate).
+        r = self._sink_converge(ops, bounds, fixed, res, graph)
+        if r is not None:
+            return r
+
+        # 3. fallback: connected, no bottleneck, not sink-converging.
+        #    Bounded exact branch-and-bound over per-op views, beam search
+        #    past the budget. (Round 1 picked views greedily in topo order
+        #    here, which could silently return measurably suboptimal
+        #    placements.)
         with _rlog.enter("diamond assign: %d ops", len(ops)):
             return self._diamond_assign(ops, bounds, fixed, res)
+
+    def _sink_converge(self, ops, bounds, fixed, res, graph
+                       ) -> Optional[GraphCostResult]:
+        """Exact decomposition when the LAST op is the unique junction of
+        otherwise-independent towers. Returns None when the pattern
+        doesn't hold (multiple exit ops per tower feeding the sink, a
+        parallel-op sink whose collective is priced on its input's
+        placement, or fewer than 2 towers). Towers are costed
+        sequentially on the full machine, matching the fallback's
+        assumption (reference: find_optimal_nonsequence_graph_time's
+        sequential branch)."""
+        sink = ops[-1]
+        if sink.is_parallel_op:
+            return None
+        comps = self._components(ops[:-1], graph)
+        if len(comps) < 2:
+            return None
+        prod = graph.producers()
+        comp_of = {o.guid: ci for ci, c in enumerate(comps) for o in c}
+        # sink inputs grouped by producing tower; require one exit op each
+        exit_of: Dict[int, int] = {}  # comp index -> exit op guid
+        tower_feeds: Dict[int, List] = {}  # comp index -> sink input pts
+        for t in sink.inputs:
+            p = prod.get(t.guid)
+            if not p or p[0].guid not in comp_of:
+                continue  # external input: priced in the base term
+            ci = comp_of[p[0].guid]
+            if exit_of.setdefault(ci, p[0].guid) != p[0].guid:
+                return None  # two exit ops in one tower: not separable
+            tower_feeds.setdefault(ci, []).append(t)
+        op_by_guid = {o.guid: o for o in ops}
+
+        # per-tower DP under each candidate exit view (memoized _cost_of)
+        tower_tables: List[Tuple[List, Dict]] = []  # (feeds, {view: result})
+        free_cost = 0.0  # towers not feeding the sink: unconstrained
+        free_views: Dict[int, MachineView] = {}
+        for ci, comp in enumerate(comps):
+            if ci not in exit_of:
+                r = self._cost_of(tuple(comp), bounds, fixed, res, graph)
+                if r.cost == float("inf"):
+                    return GraphCostResult.infinity()
+                free_cost += r.cost
+                free_views.update(r.views)
+                continue
+            e_op = op_by_guid[exit_of[ci]]
+            cands = ([fixed[e_op.guid]] if e_op.guid in fixed
+                     else self.valid_views(e_op, res))
+            table = {}
+            for u in cands:
+                f2 = dict(fixed)
+                f2[e_op.guid] = u
+                r = self._cost_of(tuple(comp), bounds, f2, res, graph)
+                if r.cost != float("inf"):
+                    table[u] = r
+            if not table:
+                return GraphCostResult.infinity()
+            tower_tables.append((tower_feeds[ci], table))
+
+        sink_views = ([fixed[sink.guid]] if sink.guid in fixed
+                      else self.valid_views(sink, res))
+        best = GraphCostResult.infinity()
+        for v in sink_views:
+            cm = self.cost_model.measure_operator_cost(sink, v)
+            total = free_cost + cm.total_time
+            choice = []
+            flows = []  # the sink drains every tower at once
+            for feeds, table in tower_tables:
+                tb_best, tb_r, tb_u = float("inf"), None, None
+                for u, r in table.items():
+                    c = r.cost + sum(
+                        self.cost_model.estimate_xfer_cost(t, u, v)
+                        for t in feeds
+                    )
+                    if c < tb_best:
+                        tb_best, tb_r, tb_u = c, r, u
+                if tb_r is None:
+                    total = float("inf")
+                    break
+                total += tb_best
+                choice.append(tb_r)
+                flows.extend((t, tb_u, v) for t in feeds)
+            # external (non-tower) sink inputs
+            for t in sink.inputs:
+                p = prod.get(t.guid)
+                if not p or p[0].guid not in comp_of:
+                    src = bounds.get(t.guid)
+                    total += self.cost_model.estimate_xfer_cost(t, src, v)
+                    flows.append((t, src, v))
+            if total != float("inf") and len(flows) > 1:
+                # same congestion surcharge node_cost applies to
+                # multi-input ops (post-hoc on the chosen exits: keeps the
+                # per-tower selection separable)
+                total += self.cost_model.concurrent_xfer_penalty(flows)
+            if total < best.cost:
+                views = dict(free_views)
+                for r in choice:
+                    views.update(r.views)
+                views[sink.guid] = v
+                best = GraphCostResult(total, views)
+        return best
 
     # exact enumeration budget (total view combinations) and beam width for
     # the no-bottleneck fallback
@@ -333,9 +485,47 @@ class SearchHelper:
         dfs(0, 0.0, dict(bounds), {})
         return best
 
+    def _boundary_congestion(self, a, b, bounds, ra, rb, graph) -> float:
+        """Concurrent halves prefetch their boundary tensors AT THE SAME
+        TIME (under SPMD the inputs of a concurrently-placed region are
+        copied in together): price the combined flow set's link sharing
+        (reference: EnhancedMachineModel congestion; zero on flat
+        machines). Each half's ops consuming a bound tensor contribute
+        one flow from the producer's view to the consumer's assigned
+        view. Sharing WITHIN one multi-input op was already charged by
+        node_cost's per-op penalty (inside ra/rb.cost) — subtract it so
+        the surcharge prices only the contention the halves add."""
+        flows = []
+        already = 0.0
+        for part, r in ((a, ra), (b, rb)):
+            for op in part:
+                view = r.views.get(op.guid)
+                if view is None:
+                    continue
+                op_flows = []
+                for t in op.inputs:
+                    src = bounds.get(t.guid)
+                    if src is not None:
+                        op_flows.append((t, src, view))
+                flows.extend(op_flows)
+                if len(op_flows) > 1:
+                    # node_cost charged this op's input flow set (src-less
+                    # inputs are filtered inside the penalty): that exact
+                    # amount is already inside ra/rb.cost
+                    already += self.cost_model.concurrent_xfer_penalty(
+                        op_flows)
+        if len(flows) < 2:
+            return 0.0
+        return max(
+            0.0,
+            self.cost_model.concurrent_xfer_penalty(flows) - already,
+        )
+
     def _nonsequence(self, a, b, bounds, fixed, res, graph) -> GraphCostResult:
         """reference: find_optimal_nonsequence_graph_time (graph.cc ~230-290):
-        try sequential on full machine vs concurrent on split halves."""
+        try sequential on full machine vs concurrent on split halves.
+        Concurrent options carry a boundary-congestion surcharge on
+        topology-aware machines (_boundary_congestion)."""
         # sequential: both use the full machine, times add
         ra = self._cost_of(a, bounds, fixed, res, graph)
         rb = self._cost_of(b, bounds, fixed, res, graph)
@@ -353,6 +543,9 @@ class SearchHelper:
             ra2 = self._cost_of(a, bounds, fixed, half, graph)
             rb2 = self._cost_of(b, bounds, fixed, other, graph)
             cost2 = max(ra2.cost, rb2.cost)
+            if cost2 != float("inf"):
+                cost2 += self._boundary_congestion(a, b, bounds, ra2, rb2,
+                                                   graph)
             if cost2 < best.cost:
                 views = dict(ra2.views)
                 views.update(rb2.views)
@@ -366,6 +559,9 @@ class SearchHelper:
             ra3 = self._cost_of(a, bounds, fixed, top, graph)
             rb3 = self._cost_of(b, bounds, fixed, bot, graph)
             cost3 = max(ra3.cost, rb3.cost)
+            if cost3 != float("inf"):
+                cost3 += self._boundary_congestion(a, b, bounds, ra3, rb3,
+                                                   graph)
             if cost3 < best.cost:
                 views = dict(ra3.views)
                 views.update(rb3.views)
@@ -373,6 +569,13 @@ class SearchHelper:
         return best
 
     def _components(self, ops, graph) -> List[List[PCGOp]]:
+        # connectivity depends only on the op set, not bounds/fixed/res —
+        # the DP revisits the same subgraph under thousands of boundary
+        # states, so memoize (554k calls / 78s on Inception otherwise)
+        ck = tuple(o.guid for o in ops)
+        cached = self._comp_cache.get(ck)
+        if cached is not None:
+            return cached
         guids = {o.guid for o in ops}
         parent = {o.guid: o.guid for o in ops}
 
@@ -396,4 +599,6 @@ class SearchHelper:
         groups: Dict[int, List[PCGOp]] = {}
         for o in ops:
             groups.setdefault(find(o.guid), []).append(o)
-        return list(groups.values())
+        out = list(groups.values())
+        self._comp_cache[ck] = out
+        return out

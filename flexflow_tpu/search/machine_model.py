@@ -149,7 +149,16 @@ class MachineModel:
 def parse_machine_config(path: str) -> MachineModel:
     """Parse a key = value machine description file (same shape as the
     reference's machine_config_example; accepts both GPU-era and TPU-era
-    key spellings)."""
+    key spellings).
+
+    Topology keys select the EnhancedMachineModel analog
+    (TopologyAwareMachineModel, search/network.py — per-link ICI torus
+    hops, DCN hierarchy across slices, congestion):
+      topology_dims = 4x8         # ICI torus of ONE slice
+      machine_model_version = 1   # same switch as --machine-model-version
+      congestion_factor = 0.15
+      ici_latency / dcn_latency   # seconds
+    """
     kv: Dict[str, str] = {}
     with open(path) as f:
         for line in f:
@@ -184,7 +193,28 @@ def parse_machine_config(path: str) -> MachineModel:
         ["dcn_bandwidth", "inter_node_bandwidth", "nic_bandwidth"],
         m.dcn_bandwidth,
     )
+    m.ici_latency = get_f(["ici_latency"], m.ici_latency)
+    m.dcn_latency = get_f(["dcn_latency"], m.dcn_latency)
     m.chip.peak_flops_bf16 = get_f(["peak_flops_bf16"], m.chip.peak_flops_bf16)
     m.chip.hbm_bandwidth = get_f(["hbm_bandwidth"], m.chip.hbm_bandwidth)
     m.chip.hbm_capacity = get_i(["hbm_capacity", "device_mem"], m.chip.hbm_capacity)
+
+    version = get_i(["machine_model_version"], 0)
+    topo_str = kv.get("topology_dims", "")
+    if version >= 1 or topo_str:
+        from .network import TopologyAwareMachineModel, TorusTopology
+
+        dims = (tuple(int(d) for d in topo_str.replace("x", " ").split())
+                if topo_str else (m.workers_per_node,))
+        return TopologyAwareMachineModel(
+            num_nodes=m.num_nodes,
+            workers_per_node=m.workers_per_node,
+            chip=m.chip,
+            ici_bandwidth=m.ici_bandwidth,
+            ici_latency=m.ici_latency,
+            dcn_bandwidth=m.dcn_bandwidth,
+            dcn_latency=m.dcn_latency,
+            topology=TorusTopology(dims=dims),
+            congestion_factor=get_f(["congestion_factor"], 0.15),
+        )
     return m

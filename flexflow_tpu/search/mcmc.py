@@ -57,6 +57,7 @@ def simulate_runtime(
         view = views[op.guid]
         cm = cost_model.measure_operator_cost(op, view)
         lb = 0.0
+        flows = []
         for t in op.inputs:
             p = prod.get(t.guid)
             if p is None:
@@ -67,6 +68,12 @@ def simulate_runtime(
                 ready_fwd.get(t.guid, 0.0)
                 + cost_model.estimate_xfer_cost(t, src_view, view),
             )
+            flows.append((t, src_view, view))
+        if len(flows) > 1:
+            # an op's input transfers overlap in time — price link sharing
+            # (reference: simulator task overlap over EnhancedMachineModel
+            # comm devices; zero on flat machines)
+            lb += cost_model.concurrent_xfer_penalty(flows)
         dur = cm.forward_time
         if op.is_parallel_op:
             dur += cost_model.parallel_op_cost(op)
@@ -88,8 +95,20 @@ def simulate_runtime(
         view = views[op.guid]
         cm = cost_model.measure_operator_cost(op, view)
         lb = makespan if not consumers.get(op.guid) else 0.0
+        grad_flows = []
+        flow_keys = set()  # (consumer, tensor) dedupe: consumers holds one
+        # entry PER consumed input, and a consumer reading two outputs of
+        # this op is still one gradient transfer per tensor
         for c in consumers.get(op.guid, []):
             lb = max(lb, bwd_end.get(c.guid, makespan))
+            for t in op.outputs:
+                if any(x.guid == t.guid for x in c.inputs) and \
+                        (c.guid, t.guid) not in flow_keys:
+                    flow_keys.add((c.guid, t.guid))
+                    grad_flows.append((t, views[c.guid], view))
+        if len(grad_flows) > 1:
+            # gradients from several consumers arrive simultaneously
+            lb += cost_model.concurrent_xfer_penalty(grad_flows)
         dur = cm.backward_time
         if op.is_parallel_op:
             dur += cost_model.parallel_op_cost(op)

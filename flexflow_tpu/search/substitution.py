@@ -349,10 +349,103 @@ def partition_seq_allgather(degree: int) -> Substitution:
     return Substitution(f"partition_seq_allgather_{degree}", apply)
 
 
+def merge_parallel_linears() -> Substitution:
+    """TASO-style ALGEBRAIC rewrite (reference: the fusion family of
+    substitutions/graph_subst_3_v2.json rules): two Linear ops consuming
+    the SAME input with identical settings merge into ONE Linear of
+    out1+out2 channels followed by a Split. One bigger MXU GEMM instead
+    of two, and — decisive for the search — the merged out-channel can
+    column-shard at degrees neither original out_dim divides by."""
+
+    def apply(graph: Graph) -> Iterator[Graph]:
+        from ..ops.registry import get_op_def
+        from ..ops.tensor_ops import SplitParams
+
+        by_input: Dict[int, List[PCGOp]] = {}
+        for op in _find_ops(graph, OperatorType.OP_LINEAR):
+            if (op.outputs and op.outputs[0].get_total_degree() == 1
+                    and not any(w.get_total_degree() > 1 for w in op.weights)):
+                by_input.setdefault(op.inputs[0].guid, []).append(op)
+        for ops in by_input.values():
+            for i in range(len(ops)):
+                for j in range(i + 1, len(ops)):
+                    a, b = ops[i], ops[j]
+                    pa, pb = a.params, b.params
+                    if (pa.use_bias != pb.use_bias
+                            or pa.activation != pb.activation
+                            or pa.data_type != pb.data_type
+                            or pa.kernel_reg_lambda != pb.kernel_reg_lambda
+                            or pa.kernel_reg_type != pb.kernel_reg_type):
+                        continue
+                    # graph outputs must keep their identity: only merge
+                    # linears whose outputs are consumed inside the graph
+                    if not _consumers(graph, a.outputs[0]) or \
+                            not _consumers(graph, b.outputs[0]):
+                        continue
+                    g2, _ = copy_graph(graph)
+                    a2 = next(o for o in g2.ops
+                              if o.layer_guid == a.layer_guid
+                              and o.name == a.name)
+                    b2 = next(o for o in g2.ops
+                              if o.layer_guid == b.layer_guid
+                              and o.name == b.name)
+                    x = a2.inputs[0]
+                    o1, o2 = pa.out_channels, pb.out_channels
+                    params = dataclasses.replace(pa, out_channels=o1 + o2)
+                    merged = PCGOp(OperatorType.OP_LINEAR, params, [x],
+                                   name=f"{a2.name}+{b2.name}")
+                    out_dims = [dataclasses.replace(d) for d in x.dims[:-1]]
+                    out_dims.append(ParallelDim(size=o1 + o2, degree=1))
+                    out = ParallelTensor(dims=out_dims, data_type=x.data_type)
+                    out.owner_op = merged
+                    merged.outputs.append(out)
+                    # fresh weights from the op definition (search runs
+                    # pre-init, so a merged kernel is just a bigger init)
+                    d = get_op_def(OperatorType.OP_LINEAR)
+                    merged.weight_tags = []
+                    for spec in d.weights(params, [x.material_shape()],
+                                          [x.data_type]):
+                        wpt = ParallelTensor(
+                            dims=[ParallelDim(size=s, degree=1)
+                                  for s in spec.shape],
+                            data_type=spec.dtype, owner_op=merged,
+                        )
+                        merged.weights.append(wpt)
+                        merged.weight_names.append(spec.name)
+                        merged.weight_tags.append(spec.parallel_dim_tags)
+                        merged.initializers[spec.name] = spec.initializer
+                    split = PCGOp(
+                        OperatorType.OP_SPLIT,
+                        SplitParams(sizes=(o1, o2), axis=-1),
+                        [out],
+                    )
+                    for sz in (o1, o2):
+                        sdims = [dataclasses.replace(dd)
+                                 for dd in out.dims[:-1]]
+                        sdims.append(ParallelDim(size=sz, degree=1))
+                        spt = ParallelTensor(dims=sdims,
+                                             data_type=out.data_type)
+                        spt.owner_op = split
+                        split.outputs.append(spt)
+                    for cons, k in _consumers(g2, a2.outputs[0]):
+                        cons.inputs[k] = split.outputs[0]
+                    for cons, k in _consumers(g2, b2.outputs[0]):
+                        cons.inputs[k] = split.outputs[1]
+                    g2.ops = [o for o in g2.ops
+                              if o.guid not in (a2.guid, b2.guid)]
+                    g2.add_op(merged)
+                    g2.add_op(split)
+                    g2._producer_cache = None
+                    if g2.check_correctness():
+                        yield g2
+
+    return Substitution("merge_parallel_linears", apply)
+
+
 def generate_all_pcg_xfers(degrees: List[int], config=None) -> List[Substitution]:
     """reference: GraphSearchHelper::generate_all_pcg_xfers
     (substitution.cc:1726) — one xfer per (kind, degree)."""
-    xfers: List[Substitution] = []
+    xfers: List[Substitution] = [merge_parallel_linears()]
     for d in degrees:
         xfers.append(partition_batch(d))
         xfers.append(partition_linear_combine(d))

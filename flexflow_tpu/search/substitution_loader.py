@@ -151,22 +151,35 @@ def default_rules_path() -> str:
 # rule application
 # ---------------------------------------------------------------------------
 
+_PARALLEL_DEGREE_ATTR = {
+    OperatorType.OP_REPARTITION: "repartition_degree",
+    OperatorType.OP_COMBINE: "combine_degree",
+    OperatorType.OP_REPLICATE: "replicate_degree",
+    OperatorType.OP_REDUCTION: "reduction_degree",
+}
+_PARALLEL_DIM_ATTR = {
+    OperatorType.OP_REPARTITION: "repartition_dim",
+    OperatorType.OP_COMBINE: "combine_dim",
+    OperatorType.OP_REPLICATE: "replicate_dim",
+    OperatorType.OP_REDUCTION: "reduction_dim",
+}
+
+
 def _op_matches(op: PCGOp, pat: OpPattern) -> bool:
     if op.op_type != pat.op_type:
         return False
-    # parameter constraints the pattern pins down
-    deg = pat.params.get("PM_PARALLEL_DEGREE")
-    if deg is not None and op.op_type in _PARALLEL_TYPES:
-        actual = getattr(
-            op.params,
-            {
-                OperatorType.OP_REPARTITION: "repartition_degree",
-                OperatorType.OP_COMBINE: "combine_degree",
-                OperatorType.OP_REPLICATE: "replicate_degree",
-                OperatorType.OP_REDUCTION: "reduction_degree",
-            }[op.op_type],
-        )
-        if actual != deg:
+    # parameter constraints the pattern pins down. BOTH degree and dim
+    # must match for parallel ops: an elision rule for
+    # combine(dim0)->partition(dim0) must not fire on combine(dim0)->
+    # partition(dim1), which is a real reshard, not an identity.
+    if op.op_type in _PARALLEL_TYPES:
+        deg = pat.params.get("PM_PARALLEL_DEGREE")
+        if deg is not None and getattr(
+                op.params, _PARALLEL_DEGREE_ATTR[op.op_type]) != deg:
+            return False
+        dim = pat.params.get("PM_PARALLEL_DIM")
+        if dim is not None and getattr(
+                op.params, _PARALLEL_DIM_ATTR[op.op_type]) != dim:
             return False
     return True
 
@@ -232,7 +245,26 @@ def apply_rule(graph: Graph, rule: Rule) -> Iterator[Graph]:
     graphs (reference: GraphXfer::run building a new graph per match)."""
     if not rule.supported:
         return
+    mapped_src = {(s_op, s_ts) for (s_op, s_ts, _, _) in rule.mapped_outputs}
     for assign in _match_pattern(graph, rule):
+        # interior outputs of matched ops (not in mappedOutput) must have
+        # no consumers OUTSIDE the match — removing their producer would
+        # otherwise orphan a live tensor (reference: GraphXfer::run's
+        # mapped-output completeness check, substitution.cc:596)
+        matched_guids0 = {op.guid for op in assign.values()}
+        escaped = False
+        for pi, op in assign.items():
+            for ts, t in enumerate(op.outputs):
+                if (pi, ts) in mapped_src:
+                    continue
+                if any(c.guid not in matched_guids0
+                       for c, _ in _consumers(graph, t)):
+                    escaped = True
+                    break
+            if escaped:
+                break
+        if escaped:
+            continue
         g2, tmap = copy_graph(graph)
         matched = {i: next(o for o in g2.ops if o.name == assign[i].name)
                    for i in assign}
@@ -268,6 +300,12 @@ def apply_rule(graph: Graph, rule: Rule) -> Iterator[Graph]:
                 if dpat.op_type in _PARALLEL_TYPES:
                     params = _build_parallel_params(dpat.op_type, dpat.params)
                     src_params_op = None
+                elif dpat.op_type == OperatorType.OP_NOOP:
+                    # structural rules (e.g. combine->partition elision)
+                    # synthesize identity NOOPs with no source to inherit
+                    from ..ops.tensor_ops import NoOpParams
+
+                    params, src_params_op = NoOpParams(), None
                 else:
                     params, src_params_op = params_from_matched(dpat.op_type)
                     if params is None:
@@ -283,6 +321,25 @@ def apply_rule(graph: Graph, rule: Rule) -> Iterator[Graph]:
                     nop.weight_names = list(src_params_op.weight_names)
                     nop.weight_tags = list(getattr(src_params_op, "weight_tags", []))
                     nop.initializers = dict(src_params_op.initializers)
+                # PM_PARALLEL_DEGREE on a dst COMPUTE op shards its
+                # "head"-tagged weight dims (attribute parallelism as a
+                # declarative rule — reference substitution.cc:1764
+                # create_partition_attention_combine, expressed in JSON)
+                deg = dpat.params.get("PM_PARALLEL_DEGREE")
+                if deg and dpat.op_type not in _PARALLEL_TYPES:
+                    sharded = False
+                    for w, tags in zip(nop.weights,
+                                       getattr(nop, "weight_tags", [])):
+                        for i, tag in enumerate(tags):
+                            if tag == "head" and w.dims[i].size % deg == 0 \
+                                    and w.dims[i].degree == 1:
+                                w.dims[i].degree = deg
+                                sharded = True
+                    if not sharded:
+                        raise ValueError(
+                            "PM_PARALLEL_DEGREE on a compute op needs a "
+                            "divisible, unsharded head-tagged weight dim"
+                        )
                 new_ops.append(nop)
         except Exception:
             continue  # rule not applicable at this site

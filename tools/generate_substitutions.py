@@ -14,14 +14,22 @@ programmatic xfers (search/substitution.py):
     activation in the graph to have a divisible batch dim;
   * column-parallel BatchMatmul (partition the rhs' LAST dim) — not in
     the programmatic vocabulary at all: it is the only way the search
-    can parallelize a batch-1 matmul chain.
+    can parallelize a batch-1 matmul chain;
+  * STRUCTURAL rules: combine->partition elision (removes a redundant
+    reshard pair the per-op sandwiches leave between adjacent ops) and
+    attention head-partition (attribute parallelism as a declarative
+    rule — PM_PARALLEL_DEGREE on the dst compute op shards the
+    head-tagged weight dims; reference substitution.cc:1764).
+
+Degrees cover 2..32 so the rules reach pod-scale machines (a degree
+that exceeds the searched machine simply never validates).
 
 Regenerate with:  python tools/generate_substitutions.py
 """
 import json
 import os
 
-DEGREES = (2, 4, 8)
+DEGREES = (2, 4, 8, 16, 32)
 
 
 def t(op_id, ts_id=0):
@@ -97,6 +105,38 @@ def matmul_column(d, rank):
     )
 
 
+def combine_partition_elide(dim, d):
+    """combine(dim,d) -> partition(dim,d) is an identity round-trip: the
+    per-op partition sandwiches leave one between every pair of adjacent
+    parallelized ops; eliding it removes two reshard collectives. The
+    loader's dim+degree matching guarantees the pair really round-trips."""
+    return rule(
+        f"elide_combine_partition_d{dim}_{d}",
+        src=[
+            op("OP_COMBINE", [t(-1)], para(dim, d)),
+            op("OP_PARTITION", [t(0)], para(dim, d)),
+        ],
+        dst=[op("OP_NOOP", [t(-1)])],
+        src_out=(1, 0), dst_out=(0, 0),
+    )
+
+
+def attention_head_partition(d):
+    """Attribute parallelism over attention heads as a DECLARATIVE rule:
+    PM_PARALLEL_DEGREE on the dst compute op shards its head-tagged
+    weight dims (reference: substitution.cc:1764
+    create_partition_attention_combine)."""
+    mha_in = [t(-1), t(-2), t(-3)]
+    return rule(
+        f"partition_attention_heads_{d}",
+        src=[op("OP_MULTIHEAD_ATTENTION", mha_in)],
+        dst=[op("OP_MULTIHEAD_ATTENTION", mha_in,
+                [{"_t": "Parameter", "key": "PM_PARALLEL_DEGREE",
+                  "value": d}])],
+        src_out=(0, 0), dst_out=(0, 0),
+    )
+
+
 def main():
     rules = []
     for d in DEGREES:
@@ -108,6 +148,9 @@ def main():
         rules.append(binary_batch("OP_BATCHMATMUL", "matmul", d))
         rules.append(matmul_column(d, rank=3))
         rules.append(matmul_column(d, rank=2))
+        rules.append(combine_partition_elide(0, d))
+        rules.append(combine_partition_elide(1, d))
+        rules.append(attention_head_partition(d))
     out = {"rule": rules}
     path = os.path.join(os.path.dirname(__file__), "..", "flexflow_tpu",
                         "search", "substitutions",
