@@ -1,0 +1,98 @@
+"""Substantiate the XLA gemm ceiling the bench analysis leans on.
+
+BASELINE.md's attainable-step estimate prices the transformer bench's
+projection/FFN gemms at "XLA's observed ~175 TF/s ceiling" — this
+artifact MEASURES that number on the current device for exactly the
+bench config's gemm shapes (hidden 1024, seq 512, batch 8 → m = 4096
+rows), bf16 inputs with f32 accumulation, using the same
+scan-differencing methodology as the calibrated microbenchmarks
+(search/measure.py — additive carries are invalid for linear ops, the
+elementwise sin tie prevents XLA from hoisting the matmul).
+
+Run ON A REAL CHIP from the repo root (no PYTHONPATH):
+    python benchmarks/gemm_ceiling.py
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from flexflow_tpu.ff_types import ActiMode, DataType, OperatorType
+    from flexflow_tpu.ops.linear import LinearParams
+    from flexflow_tpu.pcg.machine_view import MachineView
+    from flexflow_tpu.pcg.op import PCGOp
+    from flexflow_tpu.pcg.parallel_tensor import ParallelDim, ParallelTensor
+    from flexflow_tpu.search.measure import OperatorMeasurer
+
+    print(f"device: {jax.devices()[0].device_kind}", flush=True)
+    meas = OperatorMeasurer(repeats=256, compute_dtype=jax.numpy.bfloat16)
+    view = MachineView(start_device_id=0, dim=(1,), stride=(1,))
+
+    # the bench transformer's per-layer gemm shapes (m = batch*seq = 4096)
+    shapes = [
+        ("proj_1024x1024", 4096, 1024, 1024),   # q/k/v/o projections (x4)
+        ("ffn_up_1024x4096", 4096, 1024, 4096),  # FFN in (x1)
+        ("ffn_dn_4096x1024", 4096, 4096, 1024),  # FFN out (x1)
+    ]
+    results = []
+    for name, m, k, n in shapes:
+        x = ParallelTensor(dims=[ParallelDim(size=m, degree=1),
+                                 ParallelDim(size=k, degree=1)],
+                           data_type=DataType.DT_FLOAT)
+        op = PCGOp(OperatorType.OP_LINEAR,
+                   LinearParams(out_channels=n, use_bias=False,
+                                activation=ActiMode.AC_MODE_NONE),
+                   [x], name=f"gemm_{name}")
+        w = ParallelTensor(dims=[ParallelDim(size=k, degree=1),
+                                 ParallelDim(size=n, degree=1)],
+                           data_type=DataType.DT_FLOAT, owner_op=op)
+        op.weights.append(w)
+        op.weight_names.append("kernel")
+        op.weight_tags = [("in_channel", "out_channel")]
+        out = ParallelTensor(dims=[ParallelDim(size=m, degree=1),
+                                   ParallelDim(size=n, degree=1)],
+                             data_type=DataType.DT_FLOAT, owner_op=op)
+        op.outputs.append(out)
+
+        fwd_s, bwd_s = meas(op, view)
+        fl = 2.0 * m * k * n
+        # backward of a linear = dgrad + wgrad, 2x the forward flops; a
+        # rate above ~1.2x peak is differencing noise (the scan carry
+        # only ties the forward output — bwd can be hoisted), report null
+        bwd_tf = (round(2 * fl / bwd_s / 1e12, 1)
+                  if bwd_s == bwd_s and bwd_s > 0 else None)
+        if bwd_tf is not None and bwd_tf > 1.2 * 197:
+            bwd_tf = None
+        rec = {
+            "shape": name, "m": m, "k": k, "n": n,
+            "fwd_us": round(fwd_s * 1e6, 1),
+            "bwd_us": round(bwd_s * 1e6, 1),
+            "fwd_tflops": round(fl / fwd_s / 1e12, 1),
+            "bwd_tflops": bwd_tf,
+        }
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    # per-layer gemm budget for the bench config: 4 projections + 2 FFN
+    layer_fwd = 4 * results[0]["fwd_us"] + results[1]["fwd_us"] + \
+        results[2]["fwd_us"]
+    flops_fwd = (4 * 2.0 * 4096 * 1024 * 1024
+                 + 2 * 2.0 * 4096 * 1024 * 4096)
+    print(json.dumps({
+        "metric": "xla_gemm_ceiling",
+        "per_layer_gemm_fwd_us": round(layer_fwd, 1),
+        "weighted_fwd_tflops": round(flops_fwd / (layer_fwd * 1e-6) / 1e12,
+                                     1),
+        "unit": "TF/s",
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -925,18 +925,31 @@ class FFModel:
         elif _os.path.exists(default_rules_path()):
             rules = load_rule_collection_from_path(default_rules_path())
             xfers = xfers + rules_to_substitutions(rules)
-        gsh = GraphSearchHelper(
-            sh,
-            xfers,
-            alpha=cfg.search_alpha,
-            budget=budget,
-        )
         res = MachineResource(
             num_nodes=machine.num_nodes,
             all_procs_per_node=machine.workers_per_node,
             available_procs_per_node=machine.workers_per_node,
         )
-        best_graph, result = gsh.graph_optimize(self.graph, res)
+        mem_budget = cfg.device_mem or machine.chip.hbm_capacity
+        if cfg.perform_memory_search:
+            # reference: --memory-search lambda loop (graph.cc:2060-2130)
+            from ..search.memory_optimization import (
+                graph_optimize_with_memory,
+            )
+
+            best_graph, result, _mem, _lam = graph_optimize_with_memory(
+                self.graph, cost_model, res, xfers,
+                device_mem_budget=mem_budget,
+                alpha=cfg.search_alpha, budget=budget,
+            )
+        else:
+            gsh = GraphSearchHelper(
+                sh,
+                xfers,
+                alpha=cfg.search_alpha,
+                budget=budget,
+            )
+            best_graph, result = gsh.graph_optimize(self.graph, res)
         self.graph = best_graph
         self.searched_views = result.views
         self.searched_cost = result.cost
@@ -955,7 +968,94 @@ class FFModel:
             with open(cfg.export_strategy_computation_graph_file, "w") as f:
                 f.write(self.graph.export_dot())
         axis_sizes = strategies.assign_mesh_axes(self.graph, ndev)
+        # Pipeline as a SEARCHED dimension (beyond-parity: the reference's
+        # OP_PIPELINE is enum-only, ffconst.h:158): when the best
+        # unpipelined strategy's per-chip memory exceeds the HBM budget,
+        # evaluate GPipe candidates (bubble fraction + cut-activation
+        # transfers, stage count as the searched degree) and adopt the
+        # cheapest stage count that fits.
+        pipe = self._search_pipeline_degree(
+            cost_model, result, ndev, axis_sizes, mem_budget
+        )
+        if pipe > 1:
+            # the pipeline candidate is a stage split + data parallelism
+            # within each stage; it REPLACES the overflowing strategy's
+            # axes (tensor degrees not matching the new axes demote to
+            # replicated in lowering, as with any searched strategy)
+            axis_sizes = {"data": max(1, ndev // pipe), "pipe": pipe}
+            self.searched_pipeline_degree = pipe
         return build_mesh(axis_sizes)
+
+    def _search_pipeline_degree(self, cost_model, result, ndev, axis_sizes,
+                                mem_budget) -> int:
+        """Propose pipeline parallelism when the searched strategy cannot
+        fit per-chip HBM. Candidate cost for S stages over ndev devices
+        (dp = ndev/S within each stage, M microbatches):
+
+            T(S) ~ max_stage_time/dp * (M + S - 1)/M
+                   + cut_bytes * 2 / ici_bw / dp
+
+        i.e. the GPipe bubble fraction plus fwd+bwd boundary-activation
+        transfers; per-chip memory ~ stage weights (replicated in the
+        stage's dp group) + stage activation shards * the in-flight
+        microbatch count. Returns 1 when the unpipelined strategy fits
+        (a test pins that it is NOT chosen then) or no stage count fits."""
+        from ..search.memory_optimization import measure_memory
+        from ..parallel.pipeline import balanced_linear_partition
+
+        cfg = self.config
+        if ndev < 2:
+            return 1
+        mem = measure_memory(self.graph, result.views, cost_model).max_bytes
+        if mem <= mem_budget:
+            return 1
+        from ..pcg.machine_view import MachineView
+
+        machine = cost_model.machine
+        ops = [o for o in self.graph.ops if not o.is_parallel_op]
+        order = {o.guid: i for i, o in enumerate(self.graph.topo_order())}
+        ops.sort(key=lambda o: order[o.guid])
+        v1 = MachineView(start_device_id=0, dim=(1,), stride=(1,))
+        costs = [cost_model.measure_operator_cost(o, v1).total_time
+                 for o in ops]
+        w_bytes = [
+            sum(t.get_volume() * t.data_type.size for t in o.weights)
+            for o in ops
+        ]
+        a_bytes = [
+            sum(t.get_volume() * t.data_type.size for t in o.outputs)
+            for o in ops
+        ]
+        best_s, best_t = 1, float("inf")
+        S = 2
+        while S <= ndev and len(ops) >= S:
+            if ndev % S == 0:
+                dp = ndev // S
+                M = max(cfg.num_microbatches, S)
+                bounds = balanced_linear_partition(costs, S)
+                stage_t = [sum(costs[bounds[i]:bounds[i + 1]])
+                           for i in range(S)]
+                stage_w = [sum(w_bytes[bounds[i]:bounds[i + 1]])
+                           for i in range(S)]
+                stage_a = [sum(a_bytes[bounds[i]:bounds[i + 1]])
+                           for i in range(S)]
+                cut_bytes = sum(a_bytes[bounds[i + 1] - 1]
+                                for i in range(S - 1))
+                t = (max(stage_t) / dp * (M + S - 1) / M
+                     + cut_bytes * 2 / machine.ici_bandwidth / dp)
+                # stage weights replicate within the stage's dp group;
+                # the scan-based GPipe schedule (backward = reversed scan
+                # under jax.grad) stashes ALL M microbatches' residuals —
+                # per chip that is the stage's full batch-shard of
+                # activations, not just the in-flight window
+                m_per_chip = max(
+                    w + a / dp
+                    for w, a in zip(stage_w, stage_a)
+                )
+                if m_per_chip <= mem_budget and t < best_t:
+                    best_s, best_t = S, t
+            S *= 2
+        return best_s
 
     # ------------------------------------------------------------------
     # training loop (reference: flexflow_cffi.py:2058 fit)

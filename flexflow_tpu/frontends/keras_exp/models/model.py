@@ -194,9 +194,11 @@ class BaseModel:
 
 
 def _convert_tf_keras(model, name):
-    """Live tf.keras model → ONNX ModelProto; requires tensorflow plus a
-    keras→onnx converter (keras2onnx, as the reference uses, or tf2onnx).
-    Gated: raises ImportError with instructions when unavailable."""
+    """Live keras model → ONNX ModelProto. Conversion ladder:
+    keras2onnx (the reference's converter) → tf2onnx → the VENDORED
+    minimal converter (keras2onnx_min — Dense/Conv2D/Pooling/Flatten/
+    Concatenate/Activation, works on any duck-typed functional keras
+    model incl. flexflow_tpu.frontends.keras, no tensorflow needed)."""
     try:
         import keras2onnx  # noqa: F401
 
@@ -210,12 +212,37 @@ def _convert_tf_keras(model, name):
         spec = [tf.TensorSpec(t.shape, t.dtype) for t in model.inputs]
         proto, _ = tf2onnx.convert.from_keras(model, input_signature=spec)
         return proto
-    except ImportError as e:
-        raise ImportError(
-            "flexflow.keras_exp needs tensorflow plus keras2onnx or tf2onnx "
-            "to convert a live tf.keras model; alternatively pass a "
-            "pre-exported ModelProto via Model(..., onnx_model=...)"
-        ) from e
+    except ImportError:
+        pass
+    # the vendored converter only understands the duck-typed functional
+    # contract (tensors expose .source_layer); a real tf.keras model
+    # without a converter installed must keep the informative error, not
+    # fall through to an empty conversion
+    if all(getattr(t, "source_layer", None) is not None
+           for t in model.outputs):
+        try:
+            from ..keras2onnx_min import keras_to_onnx
+
+            return keras_to_onnx(model, name or "keras_exp")
+        except NotImplementedError as e:
+            raise ImportError(
+                "flexflow.keras_exp could not convert this model: the "
+                f"vendored converter says {e}; install tensorflow plus "
+                "keras2onnx or tf2onnx for full-coverage conversion, or "
+                "pass a pre-exported ModelProto via Model(..., onnx_model=...)"
+            ) from e
+    raise ImportError(
+        "flexflow.keras_exp needs keras2onnx or tf2onnx to convert a live "
+        "tf.keras model; alternatively build the model with "
+        "flexflow_tpu.frontends.keras layers (vendored converter) or pass "
+        "a pre-exported ModelProto via Model(..., onnx_model=...)"
+    )
+
+
+class _InputSpec:
+    def __init__(self, shape, dtype=None):
+        self.shape = shape
+        self.dtype = dtype
 
 
 class Model:
@@ -227,16 +254,42 @@ class Model:
                  ffconfig=None):
         assert isinstance(inputs, dict), "keras_exp Model wants {key: input}"
         if onnx_model is None:
-            try:
-                from tensorflow.keras import Model as TFModel
-            except ImportError as e:
-                raise ImportError(
-                    "tensorflow is not installed; pass onnx_model= with a "
-                    "pre-exported ONNX ModelProto instead"
-                ) from e
-            tf_model = TFModel(inputs=list(inputs.values()), outputs=outputs,
-                               name=name)
-            onnx_model = _convert_tf_keras(tf_model, name)
+            outs = (list(outputs) if isinstance(outputs, (list, tuple))
+                    else [outputs])
+            if all(getattr(t, "source_layer", None) is not None
+                   for t in outs):
+                # a functional graph built with flexflow_tpu's own keras
+                # frontend (or anything satisfying its tensor contract):
+                # convert directly, no tensorflow required
+                class _Holder:
+                    pass
+
+                live = _Holder()
+                live.inputs = list(inputs.values())
+                live.outputs = outs
+                live.input_keys = list(inputs.keys())
+                onnx_model = _convert_tf_keras(live, name)
+                # our keras tensors carry sans-batch shapes; BaseModel's
+                # Tensor expects the tf.keras (None, ...) convention
+                inputs = {
+                    k: _InputSpec(shape=(None,) + tuple(t.shape),
+                                  dtype=getattr(t, "dtype", None))
+                    for k, t in inputs.items()
+                }
+            else:
+                try:
+                    from tensorflow.keras import Model as TFModel
+                except ImportError as e:
+                    raise ImportError(
+                        "tensorflow is not installed; build the model with "
+                        "flexflow_tpu.frontends.keras layers (the vendored "
+                        "converter handles Dense/Conv2D/Pooling/Flatten/"
+                        "Concatenate/Activation) or pass onnx_model= with "
+                        "a pre-exported ONNX ModelProto"
+                    ) from e
+                tf_model = TFModel(inputs=list(inputs.values()),
+                                   outputs=outputs, name=name)
+                onnx_model = _convert_tf_keras(tf_model, name)
         self._base_model = BaseModel(inputs=inputs, onnx_model=onnx_model,
                                      ffconfig=ffconfig)
 

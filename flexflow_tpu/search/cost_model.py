@@ -204,12 +204,34 @@ class CostModel:
         # reference: Simulator::measure_operator_cost's real timing path)
         self.measure_fn = None
 
-    def _calibrated_efficiencies(self, op_type) -> Tuple[Optional[float],
-                                                         Optional[float]]:
+    def _calibration_class(self, op_type, flops=None,
+                           membytes=None) -> Optional[dict]:
+        """The fitted entry for this op, shape-regime aware: a class may
+        ship a separate '<NAME>@mem' fit for its memory-bound shapes
+        (VERDICT r2 #8 — OP_LINEAR's implied efficiencies spanned 6x
+        between compute- and memory-bound shapes; one scalar can't serve
+        both). Regime decided by the UNCALIBRATED roofline."""
+        if not self.calibration:
+            return None
+        cls_map = self.calibration.get("op_class", {})
+        name = op_type.name
+        if flops is not None and membytes is not None and \
+                f"{name}@mem" in cls_map:
+            peak = (self.machine.chip.peak_flops_bf16 if self.bf16
+                    else self.machine.chip.peak_flops_f32)
+            t_f = flops / peak
+            t_m = membytes / self.machine.chip.hbm_bandwidth
+            if t_m > t_f:
+                name = f"{name}@mem"
+        return cls_map.get(name)
+
+    def _calibrated_efficiencies(self, op_type, flops=None, membytes=None
+                                 ) -> Tuple[Optional[float],
+                                            Optional[float]]:
         """(mxu_eff, hbm_eff) overrides for this op class, if fitted."""
         if not self.calibration:
             return None, None
-        cls = self.calibration.get("op_class", {}).get(op_type.name)
+        cls = self._calibration_class(op_type, flops, membytes)
         g_m = self.calibration.get("mxu_efficiency")
         g_h = self.calibration.get("hbm_efficiency")
         if cls:
@@ -243,7 +265,9 @@ class CostModel:
         if key in self.measured:
             fwd, bwd = self.measured[key]
         else:
-            mxu_eff, hbm_eff = self._calibrated_efficiencies(op.op_type)
+            mxu_eff, hbm_eff = self._calibrated_efficiencies(
+                op.op_type, flops, membytes
+            )
             fwd = self.machine.compute_cost(
                 flops, membytes, self.bf16,
                 mxu_eff=mxu_eff, hbm_eff=hbm_eff,
@@ -252,12 +276,9 @@ class CostModel:
             # for the rest (reference measures both; ratio matches its
             # observed GEMM fwd:bwd split); calibration refines per class
             ratio = None
-            if self.calibration:
-                cls = self.calibration.get("op_class", {}).get(
-                    op.op_type.name
-                )
-                if cls:
-                    ratio = cls.get("bwd_over_fwd")
+            cls = self._calibration_class(op.op_type, flops, membytes)
+            if cls:
+                ratio = cls.get("bwd_over_fwd")
             if ratio is None:
                 ratio = 2.0 if op.weights else 1.0
             bwd = ratio * fwd

@@ -331,3 +331,54 @@ def test_nonuniform_pipeline_stage_cut_balances_cost():
     assert len(names) == len(set(names))
     assert all(len(s) >= 1 for s in plan.stages)
     assert len(plan.cuts) == 1 and len(plan.cuts[0]) >= 1
+
+
+def test_search_chooses_pipeline_when_memory_overflows():
+    """VERDICT r2 #6: pipeline as a SEARCHED dimension. With a per-chip
+    memory budget the unpipelined strategy overflows, compile's search
+    proposes a GPipe stage count (bubble + cut-transfer costed) and the
+    model trains through the generalized pipeline executor; with ample
+    memory the search must NOT pick pipeline (the negative pin)."""
+    import jax
+    import numpy as np
+
+    from flexflow_tpu import (ActiMode, DataType, FFConfig, FFModel,
+                              LossType, MetricsType, SGDOptimizer)
+
+    def build(device_mem):
+        cfg = FFConfig()
+        cfg.batch_size = 16
+        cfg.search_budget = 2
+        cfg.device_mem = device_mem
+        m = FFModel(cfg)
+        x = m.create_tensor((16, 2048), DataType.DT_FLOAT)
+        t = x
+        for _ in range(4):
+            t = m.dense(t, 2048, ActiMode.AC_MODE_RELU)
+        t = m.dense(t, 10)
+        m.softmax(t)
+        m.compile(SGDOptimizer(lr=0.01),
+                  LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [MetricsType.METRICS_ACCURACY])
+        return m
+
+    # ample memory: pipeline NOT chosen
+    m1 = build(device_mem=1 << 40)
+    assert m1.executor.mesh.shape.get("pipe", 1) == 1
+    assert getattr(m1, "searched_pipeline_degree", 1) == 1
+
+    # ~17 MB of weights per dense; 24 MB budget forces a stage split
+    m2 = build(device_mem=24 << 20)
+    pipe = m2.executor.mesh.shape.get("pipe", 1)
+    assert pipe > 1, m2.executor.mesh.shape
+    assert m2.searched_pipeline_degree == pipe
+    assert m2.executor.pipeline_plan is not None
+    ex = m2.executor
+    step = ex.build_train_step()
+    x = ex.shard_batch(ex.input_pts[0],
+                       np.zeros((16, 2048), np.float32))
+    import jax.numpy as jnp
+    y = jnp.zeros((16, 1), jnp.int32)
+    st, partials = step(m2.state, [x], y, jax.random.PRNGKey(0))
+    jax.block_until_ready(st.params)
+    assert np.isfinite(float(partials["loss"]))

@@ -54,11 +54,61 @@ def zoo_graphs():
         lambda m: build_transformer(m, batch_size=8, seq_length=512,
                                     hidden_size=1024, num_heads=16,
                                     num_layers=1))
+    # second/third transformer shapes: every class needs >= 3 points
+    # (VERDICT r2 #8 — n=1 classes were thin evidence)
+    add("transformer_s128",
+        lambda m: build_transformer(m, batch_size=32, seq_length=128,
+                                    hidden_size=512, num_heads=8,
+                                    num_layers=1), dp_degrees=(1,))
     add("alexnet",
         lambda m: build_alexnet(m, batch_size=64, num_classes=10,
                                 height=224, width=224), dp_degrees=(1,))
     add("dlrm", lambda m: build_dlrm(m, batch_size=64), dp_degrees=(1,))
+    add("dlrm_b512", lambda m: build_dlrm(m, batch_size=512),
+        dp_degrees=(1,))
+    add("dlrm_b2048", lambda m: build_dlrm(m, batch_size=2048),
+        dp_degrees=(1,))
     add("mlp_unify", lambda m: build_mlp_unify(m, batch_size=32),
+        dp_degrees=(1,))
+    add("mlp_unify_b256", lambda m: build_mlp_unify(m, batch_size=256),
+        dp_degrees=(1,))
+    add("mlp_unify_b2048", lambda m: build_mlp_unify(m, batch_size=2048),
+        dp_degrees=(1,))
+
+    # layernorm / primitive batch_matmul+softmax (imported-graph attention)
+    # / MoE classes, absent from the round-2 fit
+    def build_primitive_attention(m, batch, seq, hidden):
+        from flexflow_tpu import DataType
+
+        x = m.create_tensor((batch, seq, hidden), DataType.DT_FLOAT)
+        t = m.layer_norm(x, axes=(-1,))
+        scores = m.batch_matmul(t, m.transpose(t, (0, 2, 1)))
+        probs = m.softmax(scores, axis=-1)
+        ctx = m.batch_matmul(probs, t)
+        t2 = m.layer_norm(ctx, axes=(-1,))
+        m.dense(t2, hidden)
+
+    add("prim_attn_s512",
+        lambda m: build_primitive_attention(m, 8, 512, 1024),
+        dp_degrees=(1,))
+    add("prim_attn_s256",
+        lambda m: build_primitive_attention(m, 16, 256, 512),
+        dp_degrees=(1,))
+    add("prim_attn_s128",
+        lambda m: build_primitive_attention(m, 32, 128, 1024),
+        dp_degrees=(1,))
+
+    def build_moe_graph(m, batch, input_dim, hidden, num_exp):
+        from flexflow_tpu.models.misc import build_moe
+
+        build_moe(m, batch_size=batch, input_dim=input_dim, num_classes=16,
+                  num_exp=num_exp, num_select=2, hidden=hidden)
+
+    add("moe_b256", lambda m: build_moe_graph(m, 256, 512, 1024, 8),
+        dp_degrees=(1,))
+    add("moe_b1024", lambda m: build_moe_graph(m, 1024, 512, 1024, 8),
+        dp_degrees=(1,))
+    add("moe_b4096", lambda m: build_moe_graph(m, 4096, 256, 512, 16),
         dp_degrees=(1,))
     return out
 
@@ -111,6 +161,17 @@ def main():
             fwd_t, bwd_t = meas(op, view)
             if fwd_t != fwd_t:  # NaN: unmeasurable standalone
                 continue
+            if fwd_t > 0 and not (0.5 <= bwd_t / fwd_t <= 4.0):
+                # outlier backward ratio: RE-MEASURE with more repeats
+                # before giving up on it (VERDICT r2 #8 — rejection alone
+                # threw away real signal); the cache keyed on repeats
+                # makes this a distinct measurement
+                meas.repeats = int(min(4096, meas.repeats * 4))
+                print(f"    bwd/fwd={bwd_t/fwd_t:.2f} outlier — "
+                      f"re-measuring R={meas.repeats}", flush=True)
+                f2, b2 = meas(op, view, force=True)
+                if f2 == f2 and f2 > 0 and 0.5 <= b2 / f2 <= 4.0:
+                    fwd_t, bwd_t = f2, b2
             # analytic components at the measured (local) shapes — same
             # local/global fraction the repeat seed used
             frac = lvol0 / max(1, gvol0)
@@ -135,15 +196,38 @@ def main():
     write_outputs(rows, device_kind, bf16)
 
 
+PRESERVE_MARK = "<!-- PRESERVED: hand-written sections below survive regeneration -->"
+
+# classes whose compute- and memory-bound shapes get separate fits
+# (VERDICT r2 #8: OP_LINEAR's implied efficiencies spanned 6x across
+# regimes; CostModel._calibration_class selects '<NAME>@mem' when the
+# uncalibrated roofline says a shape is memory-bound)
+REGIME_SPLIT_CLASSES = {"OP_LINEAR"}
+
+
+def _row_class(r, peak, hbm):
+    name = r["op"]
+    if name in REGIME_SPLIT_CLASSES:
+        if r["bytes"] / hbm > r["flops"] / peak:
+            return f"{name}@mem"
+    return name
+
+
 def write_outputs(rows, device_kind, bf16):
     import numpy as np
+
+    from flexflow_tpu.search.machine_model import MachineModel
+
+    chip = MachineModel().chip
+    peak = chip.peak_flops_bf16 if bf16 else chip.peak_flops_f32
+    hbm = chip.hbm_bandwidth
 
     # fit: an op class is compute-bound if its implied mxu efficiency is
     # the plausible one (<= 1 and larger than implied hbm would allow);
     # otherwise memory-bound. Fit the median per class.
     by_class = {}
     for r in rows:
-        by_class.setdefault(r["op"], []).append(r)
+        by_class.setdefault(_row_class(r, peak, hbm), []).append(r)
     op_class = {}
     for cls, rs in sorted(by_class.items()):
         mxu = [r["implied_mxu_fwd"] for r in rs]
@@ -181,6 +265,23 @@ def write_outputs(rows, device_kind, bf16):
         "hbm_efficiency": round(float(np.median(ew)), 3) if ew else None,
         "op_class": op_class,
     }
+
+    # per-class fit error: median |predicted - measured| / measured of the
+    # calibrated roofline over the class's own rows
+    g_m = calib["mxu_efficiency"] or 0.55
+    g_h = calib["hbm_efficiency"] or 0.8
+    for cls, rs in by_class.items():
+        e = op_class[cls]
+        m_eff = e.get("mxu_efficiency", g_m)
+        h_eff = e.get("hbm_efficiency", g_h)
+        errs = []
+        for r in rs:
+            pred = max(r["flops"] / (peak * m_eff),
+                       r["bytes"] / (hbm * h_eff))
+            if r["fwd_s"] > 0:
+                errs.append(abs(pred - r["fwd_s"]) / r["fwd_s"])
+        if errs:
+            e["fit_err"] = round(float(np.median(errs)), 3)
     out_path = os.path.join(os.path.dirname(__file__), "..",
                             "flexflow_tpu", "search",
                             "calibration_v5e.json")
@@ -188,10 +289,16 @@ def write_outputs(rows, device_kind, bf16):
         json.dump(calib, f, indent=2, sort_keys=True)
     print(f"wrote {out_path}", flush=True)
 
-    # human-readable report with analytic-vs-measured error per class
+    # human-readable report with analytic-vs-measured error per class;
+    # hand-written sections below PRESERVE_MARK survive regeneration
     doc = os.path.join(os.path.dirname(__file__), "..", "docs",
                        "calibration.md")
     os.makedirs(os.path.dirname(doc), exist_ok=True)
+    preserved = ""
+    if os.path.exists(doc):
+        old = open(doc).read()
+        if PRESERVE_MARK in old:
+            preserved = old[old.index(PRESERVE_MARK):]
     with open(doc, "w") as f:
         f.write(
             "# Cost-model calibration ({}, {})\n\n"
@@ -202,12 +309,14 @@ def write_outputs(rows, device_kind, bf16):
             "efficiency factor makes the roofline match the measured "
             "time.\n\n".format(calib["device"], calib["dtype"])
         )
-        f.write("| op class | n | bound | fitted eff | bwd/fwd |\n")
-        f.write("|---|---|---|---|---|\n")
+        f.write("| op class | n | bound | fitted eff | bwd/fwd | "
+                "fit err |\n")
+        f.write("|---|---|---|---|---|---|\n")
         for cls, e in sorted(op_class.items()):
             eff = e.get("mxu_efficiency", e.get("hbm_efficiency"))
             f.write(f"| {cls} | {e['n']} | {e['bound']} | {eff} | "
-                    f"{e.get('bwd_over_fwd', '-')} |\n")
+                    f"{e.get('bwd_over_fwd', '-')} | "
+                    f"{e.get('fit_err', '-')} |\n")
         f.write("\n## Raw measurements\n\n")
         f.write("| model | op | local shapes | fwd µs | bwd µs | "
                 "implied mxu | implied hbm |\n|---|---|---|---|---|---|---|\n")
@@ -217,6 +326,8 @@ def write_outputs(rows, device_kind, bf16):
                 f"{r['fwd_s']*1e6:.1f} | {r['bwd_s']*1e6:.1f} | "
                 f"{r['implied_mxu_fwd']:.3f} | {r['implied_hbm_fwd']:.3f} |\n"
             )
+        if preserved:
+            f.write("\n" + preserved)
     print(f"wrote {doc}", flush=True)
 
 
