@@ -29,8 +29,10 @@ def main():
     from flexflow_tpu.pcg.machine_view import MachineView
     from flexflow_tpu.pcg.op import PCGOp
     from flexflow_tpu.pcg.parallel_tensor import ParallelDim, ParallelTensor
+    from flexflow_tpu.search.machine_model import MachineModel
     from flexflow_tpu.search.measure import OperatorMeasurer
 
+    peak_tf = MachineModel().chip.peak_flops_bf16 / 1e12
     print(f"device: {jax.devices()[0].device_kind}", flush=True)
     meas = OperatorMeasurer(repeats=256, compute_dtype=jax.numpy.bfloat16)
     view = MachineView(start_device_id=0, dim=(1,), stride=(1,))
@@ -68,7 +70,7 @@ def main():
         # only ties the forward output — bwd can be hoisted), report null
         bwd_tf = (round(2 * fl / bwd_s / 1e12, 1)
                   if bwd_s == bwd_s and bwd_s > 0 else None)
-        if bwd_tf is not None and bwd_tf > 1.2 * 197:
+        if bwd_tf is not None and bwd_tf > 1.2 * peak_tf:
             bwd_tf = None
         rec = {
             "shape": name, "m": m, "k": k, "n": n,

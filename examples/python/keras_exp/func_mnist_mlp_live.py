@@ -1,0 +1,41 @@
+"""Functional MNIST MLP through keras_exp's LIVE-model branch (reference:
+examples/python/keras_exp/func_mnist_mlp.py drives a live tf.keras model
+through keras2onnx). Here the live functional graph is built with
+flexflow.keras layers and converted by the vendored keras->ONNX
+converter (frontends/keras_exp/keras2onnx_min.py) — the same Model(...)
+entry point the reference uses, no tensorflow required."""
+import numpy as np
+
+from flexflow.core import FFConfig
+from flexflow.keras import layers as L
+from flexflow.keras.datasets import mnist
+from flexflow.keras_exp.models import Model
+
+from _example_args import example_args
+
+
+def top_level_task(args):
+    num_classes = 10
+    (x_train, y_train), _ = mnist.load_data(n_train=args.num_samples)
+    x_train = x_train.reshape(-1, 784).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+    print("shape: ", x_train.shape)
+
+    x = L.Input((784,))
+    t = L.Dense(512, activation="relu")(x)
+    t = L.Dense(512, activation="relu")(t)
+    t = L.Dense(num_classes)(t)
+    out = L.Activation("softmax")(t)
+
+    ffconfig = FFConfig()
+    ffconfig.batch_size = args.batch_size
+    model = Model(inputs={1: x}, outputs=out, ffconfig=ffconfig)
+    print(model.summary())
+    model.compile(optimizer="SGD", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"])
+    model.fit(x_train, y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    print("Functional API, mnist mlp (live model)")
+    top_level_task(example_args())

@@ -76,10 +76,10 @@ def keras_to_onnx(model, name: str = "keras_exp", seed: int = 0):
         return names[id(t)]
 
     def emit_activation(act, cur):
+        if act is None or act in ("linear", "none"):
+            return cur  # identity — keras's documented Dense default
         node_type = _ACT_NODE.get(act)
         if node_type is None:
-            if act is None:
-                return cur
             raise NotImplementedError(f"keras_to_onnx: activation {act!r}")
         out = fresh("act")
         nodes.append(P.make_node(node_type, [cur], [out]))

@@ -194,11 +194,26 @@ class BaseModel:
 
 
 def _convert_tf_keras(model, name):
-    """Live keras model → ONNX ModelProto. Conversion ladder:
-    keras2onnx (the reference's converter) → tf2onnx → the VENDORED
-    minimal converter (keras2onnx_min — Dense/Conv2D/Pooling/Flatten/
-    Concatenate/Activation, works on any duck-typed functional keras
-    model incl. flexflow_tpu.frontends.keras, no tensorflow needed)."""
+    """Live keras model → ONNX ModelProto. Duck-typed functional models
+    (tensors expose .source_layer — flexflow_tpu.frontends.keras; a real
+    tf.keras model does not) go straight to the VENDORED minimal
+    converter (keras2onnx_min — Dense/Conv2D/Pooling/Flatten/Concatenate/
+    Activation, no tensorflow needed); feeding them to keras2onnx/tf2onnx
+    would crash those converters. tf.keras models use the reference's
+    ladder: keras2onnx → tf2onnx → informative error."""
+    if all(getattr(t, "source_layer", None) is not None
+           for t in model.outputs):
+        try:
+            from ..keras2onnx_min import keras_to_onnx
+
+            return keras_to_onnx(model, name or "keras_exp")
+        except NotImplementedError as e:
+            raise ImportError(
+                "flexflow.keras_exp could not convert this model: the "
+                f"vendored converter says {e}; install tensorflow plus "
+                "keras2onnx or tf2onnx for full-coverage conversion, or "
+                "pass a pre-exported ModelProto via Model(..., onnx_model=...)"
+            ) from e
     try:
         import keras2onnx  # noqa: F401
 
@@ -214,23 +229,6 @@ def _convert_tf_keras(model, name):
         return proto
     except ImportError:
         pass
-    # the vendored converter only understands the duck-typed functional
-    # contract (tensors expose .source_layer); a real tf.keras model
-    # without a converter installed must keep the informative error, not
-    # fall through to an empty conversion
-    if all(getattr(t, "source_layer", None) is not None
-           for t in model.outputs):
-        try:
-            from ..keras2onnx_min import keras_to_onnx
-
-            return keras_to_onnx(model, name or "keras_exp")
-        except NotImplementedError as e:
-            raise ImportError(
-                "flexflow.keras_exp could not convert this model: the "
-                f"vendored converter says {e}; install tensorflow plus "
-                "keras2onnx or tf2onnx for full-coverage conversion, or "
-                "pass a pre-exported ModelProto via Model(..., onnx_model=...)"
-            ) from e
     raise ImportError(
         "flexflow.keras_exp needs keras2onnx or tf2onnx to convert a live "
         "tf.keras model; alternatively build the model with "

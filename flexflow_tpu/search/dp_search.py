@@ -98,6 +98,20 @@ class SearchHelper:
             )
             if v.num_parts() == degree and res.is_valid_machine_view(v)
         ]
+        # aligned-start canonicalization: a contiguous degree-d view whose
+        # local start isn't a multiple of d straddles tile boundaries —
+        # never cheaper than its aligned sibling on either the flat or
+        # the torus model, and dropping the 31 unaligned starts per
+        # degree is what keeps 32-worker searches tractable. Strided
+        # (inter-node) views keep every start.
+        app = res.all_procs_per_node
+        aligned = [
+            v for v in views
+            if len(v.stride) != 1 or v.stride[0] != 1
+            or (v.start_device_id % app) % max(1, min(v.dim[0], app)) == 0
+        ]
+        if aligned:
+            views = aligned
         views = views[: self.max_views_per_op]
         self._view_cache[key] = views
         return views

@@ -25,6 +25,7 @@ CASES = [
     ("onnx", "mnist_mlp_keras.py"),     # keras-layout MatMul
     ("onnx", "resnet.py"),              # Conv/BN/Add/GlobalAveragePool
     ("keras_exp", "func_mnist_mlp.py"),  # keras_exp Model over ONNX export
+    ("keras_exp", "func_mnist_mlp_live.py"),  # LIVE model, vendored converter
     ("keras_exp", "func_cifar10_cnn_concat.py"),  # + conv towers, Concat
     ("native", "mnist_mlp_attach.py"),  # stepwise loop + per-batch attach
     ("native", "demo_gather.py"),       # gather + attached index/label

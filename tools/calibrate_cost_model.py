@@ -164,8 +164,9 @@ def main():
             if fwd_t > 0 and not (0.5 <= bwd_t / fwd_t <= 4.0):
                 # outlier backward ratio: RE-MEASURE with more repeats
                 # before giving up on it (VERDICT r2 #8 — rejection alone
-                # threw away real signal); the cache keyed on repeats
-                # makes this a distinct measurement
+                # threw away real signal); force=True bypasses the cache
+                # READ (the cache key has no repeats component) so the
+                # higher-repeat run actually happens
                 meas.repeats = int(min(4096, meas.repeats * 4))
                 print(f"    bwd/fwd={bwd_t/fwd_t:.2f} outlier — "
                       f"re-measuring R={meas.repeats}", flush=True)
