@@ -1,0 +1,93 @@
+"""Isolated timing of the fused flash fwd / fwd+bwd kernels at the bench
+shape across (g, bk) settings — the tuning data behind _pick_g and the
+backward's kv tiling (kernels/attention.py). Methodology: scan with an
+elementwise-nonlinear carry tie-in so XLA can't hoist the kernel
+(search/measure.py _chain_first_float rationale).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+
+def run(mode: str, g: int, bk: int, iters=200, causal=False):
+    os.environ["FF_FLASH_BWD_G"] = str(g)
+    os.environ["FF_FLASH_BWD_BK"] = str(bk)
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.kernels.attention import flash_attention_folded
+
+    bh, s, d = 128, 512, 64
+    rng = np.random.RandomState(0)
+    qf = jnp.asarray(rng.randn(bh, s, d), jnp.bfloat16)
+    kf = jnp.asarray(rng.randn(bh, s, d), jnp.bfloat16)
+    vf = jnp.asarray(rng.randn(bh, s, d), jnp.bfloat16)
+
+    def tie(a, c):
+        mix = jax.lax.broadcasted_iota(jnp.float32, a.shape, a.ndim - 1)
+        return (a.astype(jnp.float32)
+                + jnp.sin(c + mix) * 1e-30).astype(a.dtype)
+
+    if mode == "null":
+        # harness floor: tie-in + probe, no attention call — subtract
+        # this from the other modes for absolute kernel time
+        def body(c, _):
+            q = tie(qf, c)
+            return c + q.reshape(-1)[0].astype(jnp.float32) * 1e-9, ()
+    elif mode == "fwd":
+        def body(c, _):
+            o = flash_attention_folded(tie(qf, c), kf, vf, causal)
+            return c + o.reshape(-1)[0].astype(jnp.float32) * 1e-9, ()
+    else:
+        def body(c, _):
+            def loss(q, k, v):
+                return flash_attention_folded(q, k, v, causal).astype(
+                    jnp.float32).sum()
+            gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(
+                tie(qf, c), kf, vf)
+            return c + (gq.reshape(-1)[0] + gk.reshape(-1)[0]
+                        + gv.reshape(-1)[0]).astype(jnp.float32) * 1e-9, ()
+
+    @jax.jit
+    def chain(c0):
+        c, _ = jax.lax.scan(body, c0, None, length=iters)
+        return c
+
+    c = chain(jnp.float32(0.0))
+    float(c)  # warm
+    t0 = time.perf_counter()
+    c = chain(jnp.float32(1.0))
+    float(c)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "mode": mode, "g": g, "bk": bk,
+        "us_per_call": round(1e6 * dt / iters, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    import multiprocessing as mp
+
+    cases = [
+        ("null", 0, 0),         # harness floor (tie-in + probe)
+        ("fwd", 0, 0),          # current auto (g=4 full-tile fwd)
+        ("fwdbwd", 2, 512),     # round-2 shipped: g=2, full tile
+        ("fwdbwd", 4, 512),     # round-2's regressing full-tile g=4
+        ("fwdbwd", 4, 256),     # new default: blocked
+        ("fwdbwd", 4, 128),
+        ("fwdbwd", 8, 128),
+        ("fwdbwd", 8, 256),
+        ("fwdbwd", 2, 256),
+    ]
+    only = sys.argv[1:] or None
+    for mode, g, bk in cases:
+        if only and f"{mode}:{g}:{bk}" not in only:
+            continue
+        p = mp.Process(target=run, args=(mode, g, bk))
+        p.start()
+        p.join()

@@ -1,0 +1,135 @@
+"""On-chip ablations of the bench train step: where is the recoverable
+time? Each variant patches ONE component out of the compiled step and
+reports ms/step, so the delta against `base` bounds what optimizing that
+component can buy (methodology mirrors step_breakdown.py; reference
+analog: the per-component budget in BASELINE.md).
+
+Variants run in their own process (jit caches + env flags are
+per-process).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+
+def run(tag: str, *, no_update=False, no_metrics=False, grads_no_update=False,
+        bf16_grads=False, spd=25, chunks=3):
+    import jax
+
+    from flexflow_tpu import (
+        FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
+    )
+    from flexflow_tpu.models.transformer import build_transformer
+
+    batch = 8
+    cfg = FFConfig()
+    cfg.batch_size = batch
+    cfg.allow_mixed_precision = True
+    model = FFModel(cfg)
+    build_transformer(model, batch_size=batch)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR],
+    )
+    ex = model.executor
+    if no_update:
+        # NOTE: with the grads entirely unconsumed XLA dead-code-eliminates
+        # the whole backward pass — this variant measures FORWARD-only
+        # (fwd + loss + metrics), not "step minus update".
+        class _NoOpt:
+            def update(self, params, grads, state):
+                return params, state
+        ex.optimizer = _NoOpt()
+    if grads_no_update:
+        # Backward stays alive (full-reduce probe of every grad leaf into
+        # the opt_state carry — reductions fuse into the producing kernels)
+        # but the param sweep (read p+g, write p) is gone: base minus this
+        # bounds what overlapping/fusing the SGD update could buy.
+        import jax.numpy as jnp
+
+        class _ProbeOpt:
+            def update(self, params, grads, state):
+                probe = sum(
+                    jnp.sum(leaf.astype(jnp.float32))
+                    for leaf in jax.tree_util.tree_leaves(grads)
+                )
+                return params, {"probe": probe}
+        ex.optimizer = _ProbeOpt()
+        model.state = model.state.__class__(
+            params=model.state.params, opt_state={"probe": jnp.float32(0)},
+            step=model.state.step, net_state=model.state.net_state)
+    if bf16_grads:
+        # SGD reading bf16 grads: the f32->bf16 convert fuses into the
+        # grad-producing matmul epilogues (grads hit HBM at half width) and
+        # the update reads half the bytes. Bounds the bf16-grad-store win.
+        import jax.numpy as jnp
+
+        class _Bf16SGD:
+            def update(self, params, grads, state):
+                def upd(w, g):
+                    return w - 0.01 * g.astype(jnp.bfloat16).astype(w.dtype)
+                return jax.tree_util.tree_map(upd, params, grads), state
+        ex.optimizer = _Bf16SGD()
+    if no_metrics:
+        class _NoMetrics:
+            def compute(self, logits, labels):
+                return {}
+        ex.metrics = _NoMetrics()
+    in_pt = ex.input_pts[0]
+    rng = np.random.RandomState(0)
+    x = ex.shard_batch(in_pt, rng.randn(*in_pt.material_shape()).astype(np.float32))
+    y = jax.numpy.asarray(rng.randn(*in_pt.material_shape()).astype(np.float32))
+    state = model.state
+    probe = jax.jit(
+        lambda params: sum(
+            leaf.reshape(-1)[0].astype(jax.numpy.float32)
+            for leaf in jax.tree_util.tree_leaves(params)
+        )
+    )
+
+    def sync(st):
+        return float(np.asarray(probe(st.params)))
+
+    scan = ex.build_train_scan()
+    xs = [jax.numpy.broadcast_to(x, (spd,) + x.shape)]
+    ys = jax.numpy.broadcast_to(y, (spd,) + y.shape)
+    keys = jax.random.split(jax.random.PRNGKey(0), spd)
+    for _ in range(2):
+        state, _ = scan(state, xs, ys, keys)
+    sync(state)
+    t0 = time.perf_counter()
+    for _ in range(chunks):
+        state, _ = scan(state, xs, ys, keys)
+    sync(state)
+    dt = time.perf_counter() - t0
+    iters = spd * chunks
+    print(json.dumps({
+        "tag": tag,
+        "ms_per_step": round(1e3 * dt / iters, 3),
+        "samples_per_s_chip": round(batch * iters / dt, 2),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    import multiprocessing as mp
+
+    variants = [
+        ("base", {}),
+        ("fwd_only", {"no_update": True}),
+        ("no_metrics", {"no_metrics": True}),
+        ("grads_no_update", {"grads_no_update": True}),
+        ("sgd_bf16_grads", {"bf16_grads": True}),
+    ]
+    only = sys.argv[1:] or None
+    for tag, kw in variants:
+        if only and tag not in only:
+            continue
+        p = mp.Process(target=run, args=(tag,), kwargs=kw)
+        p.start()
+        p.join()

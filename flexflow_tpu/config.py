@@ -64,6 +64,14 @@ class FFConfig:
     # Off by default so numerical-alignment tests match f32 references;
     # benchmarks turn it on.
     allow_mixed_precision: bool = False
+    # Store gradients in bf16 under mixed precision (the standard AMP
+    # recipe: half-width grad store + f32 master weights; the f32->bf16
+    # convert fuses into the grad matmuls' epilogues). Measured
+    # single-chip-neutral on the Transformer bench (XLA already fuses the
+    # f32 grad path); the win is cross-chip grad reduce-scatters riding
+    # ICI/DCN at half width. None = follow allow_mixed_precision; set
+    # False to force f32 gradient storage.
+    bf16_grads: Optional[bool] = None
     simulator_work_space_size: int = 64 * 1024 * 1024
     search_num_nodes: int = -1
     search_num_workers: int = -1

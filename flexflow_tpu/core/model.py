@@ -814,6 +814,13 @@ class FFModel:
         compute_dtype = (
             jnp.bfloat16 if self.config.allow_mixed_precision else None
         )
+        # bf16 grad storage rides mixed precision unless explicitly forced
+        # off (config.bf16_grads; AMP-style half-width grads, f32 masters)
+        use_bf16_grads = (
+            self.config.allow_mixed_precision
+            if self.config.bf16_grads is None else self.config.bf16_grads
+        )
+        grad_dtype = jnp.bfloat16 if use_bf16_grads else None
         # Map user input tensors (creation order) to their PCG tensors; only
         # those actually consumed by the graph become executor inputs.
         cur_inputs = self.graph.input_tensors()
@@ -829,6 +836,7 @@ class FFModel:
             self.loss_type,
             self.metrics_obj,
             compute_dtype=compute_dtype,
+            grad_dtype=grad_dtype,
             seed=self.config.seed,
             input_order=ordered_inputs,
             remat=self.config.remat,

@@ -55,13 +55,16 @@ class SGDOptimizer(Optimizer):
 
         if mu == 0.0:
             def upd(w, g):
-                g = g + wd * w
+                # grads may be stored half-width (executor grad_dtype);
+                # the update math runs in the master weight's dtype — the
+                # convert fuses into the read, costing no extra traffic
+                g = g.astype(w.dtype) + wd * w
                 return w - lr * g
 
             return jax.tree_util.tree_map(upd, params, grads), state
 
         def upd_v(v, w, g):
-            g = g + wd * w
+            g = g.astype(w.dtype) + wd * w
             return mu * v + g
 
         v_new = jax.tree_util.tree_map(upd_v, state["v"], params, grads)
@@ -106,7 +109,7 @@ class AdamOptimizer(Optimizer):
         wd = self.weight_decay
 
         def upd(w, g, m, v):
-            g = g + wd * w
+            g = g.astype(w.dtype) + wd * w
             m = self.beta1 * m + (1.0 - self.beta1) * g
             v = self.beta2 * v + (1.0 - self.beta2) * g * g
             return w - alpha_t * m / (jnp.sqrt(v) + self.epsilon), m, v

@@ -90,6 +90,7 @@ class PCGExecutor:
         metrics: Metrics,
         *,
         compute_dtype=None,
+        grad_dtype=None,
         seed: int = 0,
         input_order: Optional[List] = None,
         remat: bool = False,
@@ -112,6 +113,12 @@ class PCGExecutor:
         self.loss_fn = losses_mod.get_loss_fn(loss_type)
         self.metrics = metrics
         self.compute_dtype = compute_dtype
+        # Gradient storage dtype (None = param dtype). bf16 under mixed
+        # precision: converts fuse into the grad matmuls' epilogues, so
+        # grads hit HBM (and any cross-chip reduction) at half width —
+        # the AMP recipe (half-width grads + f32 master weights). The
+        # optimizer update reads them back with f32 promotion.
+        self.grad_dtype = grad_dtype
         self.seed = seed
         self.topo = graph.topo_order()
         # User-facing input order is tensor *creation* order (the order of
@@ -560,6 +567,18 @@ class PCGExecutor:
             self._eval_step = None
             self._fwd = None
 
+    def _cast_grads(self, grads):
+        """Half-width gradient storage (config.bf16_grads): cast every
+        float grad leaf to grad_dtype. Integer/bool leaves (none today)
+        and None pass through."""
+        if self.grad_dtype is None:
+            return grads
+        return jax.tree_util.tree_map(
+            lambda g: g.astype(self.grad_dtype)
+            if jnp.issubdtype(g.dtype, jnp.floating) else g,
+            grads,
+        )
+
     def _make_step(self):
         def step(state: TrainState, batch_inputs, labels, rng):
             def loss_of(params):
@@ -580,6 +599,7 @@ class PCGExecutor:
             (loss, (logits, net_out)), grads = jax.value_and_grad(
                 loss_of, has_aux=True
             )(state.params)
+            grads = self._cast_grads(grads)
             new_net = dict(state.net_state)
             new_net.update(net_out)
             new_params, new_opt = self.optimizer.update(
@@ -665,7 +685,7 @@ class PCGExecutor:
                 return loss, net_out
 
             grads, net_out = jax.grad(loss_of, has_aux=True)(params)
-            return grads, net_out
+            return self._cast_grads(grads), net_out
 
         fn = jax.jit(grad_of)
         if seq_length < 0:
