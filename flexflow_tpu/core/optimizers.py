@@ -70,7 +70,7 @@ class SGDOptimizer(Optimizer):
         v_new = jax.tree_util.tree_map(upd_v, state["v"], params, grads)
         if self.nesterov:
             def upd_w(w, g, v):
-                g = g + wd * w
+                g = g.astype(w.dtype) + wd * w
                 return w - lr * (g + mu * v)
         else:
             def upd_w(w, g, v):

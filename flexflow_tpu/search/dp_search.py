@@ -58,6 +58,17 @@ class SearchHelper:
         self._view_cache: Dict[Tuple, List[MachineView]] = {}
         self._node_cost_cache: Dict[Tuple, float] = {}
         self._comp_cache: Dict[Tuple, List[List[PCGOp]]] = {}
+        # ops-tuple -> guid-tuple, keyed by tuple identity (strong ref to
+        # the tuple pins its id). Sequence/nonsequence splits call
+        # _cost_of with the SAME pre/post tuple once per bottleneck view,
+        # and rebuilding a 300-guid tuple per call was ~30% of a
+        # 32-worker Inception DP evaluation (profiled: 6M generator steps
+        # in _memo_key alone).
+        self._guid_tuples: Dict[int, Tuple] = {}
+        # guid-tuple -> (consumed tensor guids, own op guids): the
+        # _cost_of canonicalization sets, rebuilt 124k times per
+        # 32-worker Inception DP evaluation otherwise
+        self._obs_cache: Dict[Tuple, Tuple[set, set]] = {}
 
     # -- machine view enumeration (reference: register_all_machine_views +
     #    Op::get_valid_machine_views) -----------------------------------
@@ -104,11 +115,24 @@ class SearchHelper:
         # the torus model, and dropping the 31 unaligned starts per
         # degree is what keeps 32-worker searches tractable. Strided
         # (inter-node) views keep every start.
+        #
+        # Starts are additionally anchored to QUARTERS of the node: a
+        # low-degree view at a sub-quarter offset (deg-2 at chips {4,5}
+        # of 32) is cost-equivalent to its quarter-anchored sibling for
+        # everything the leaf cost sees, and concurrent-tower placements
+        # at finer offsets are exactly what the nonsequence machine
+        # splits enumerate (disjoint sub-resources, each re-anchored).
+        # Without this, a degree-2 rewrite on a 32-worker machine gets 16
+        # views per op and one Inception DP evaluation takes minutes
+        # (profiled: dp4 97 s -> ~10 s; 8-worker view sets are unchanged
+        # since there the quarter is <= every tile size).
         app = res.all_procs_per_node
+        anchor = max(1, app // 4)
         aligned = [
             v for v in views
             if len(v.stride) != 1 or v.stride[0] != 1
-            or (v.start_device_id % app) % max(1, min(v.dim[0], app)) == 0
+            or (v.start_device_id % app)
+            % max(1, min(max(v.dim[0], anchor), app)) == 0
         ]
         if aligned:
             views = aligned
@@ -159,9 +183,22 @@ class SearchHelper:
         ops = graph.topo_order()
         return self._cost_of(tuple(ops), {}, {}, res, graph)
 
+    def _guids(self, ops) -> Tuple:
+        ent = self._guid_tuples.get(id(ops))
+        if ent is not None and ent[0] is ops:
+            return ent[1]
+        g = tuple(o.guid for o in ops)
+        if len(self._guid_tuples) > 300_000:
+            # entries pin their tuples (that's what keeps ids stable), so
+            # cap the cache instead of letting a long best-first run grow
+            # it unboundedly
+            self._guid_tuples.clear()
+        self._guid_tuples[id(ops)] = (ops, g)
+        return g
+
     def _memo_key(self, ops, bounds, fixed, res):
         return (
-            tuple(o.guid for o in ops),
+            self._guids(ops),
             tuple(sorted((g, v.hash()) for g, v in bounds.items())),
             tuple(sorted((g, v.hash()) for g, v in fixed.items())),
             res.hash(),
@@ -182,10 +219,22 @@ class SearchHelper:
         # distinct memo state — exponential in chain depth instead of
         # O(n · views²) (reference memoizes by subgraph hash alone,
         # graph.cc dp_state_hash, for the same reason).
-        consumed = {t.guid for o in ops for t in o.inputs}
+        gk = self._guids(ops)
+        sets = self._obs_cache.get(gk)
+        if sets is None:
+            sets = (
+                {t.guid for o in ops for t in o.inputs},  # consumed tensors
+                {o.guid for o in ops},                    # own op guids
+            )
+            if len(self._obs_cache) > 200_000:
+                # same unbounded-growth concern as _guid_tuples: rewrite
+                # candidates mint fresh guids, so entries never re-hit
+                # across a long best-first run
+                self._obs_cache.clear()
+            self._obs_cache[gk] = sets
+        consumed, own = sets
         if any(g not in consumed for g in bounds):
             bounds = {g: v for g, v in bounds.items() if g in consumed}
-        own = {o.guid for o in ops}
         if any(g not in own for g in fixed):
             fixed = {g: v for g, v in fixed.items() if g in own}
         key = self._memo_key(ops, bounds, fixed, res)
@@ -586,6 +635,9 @@ class SearchHelper:
         # connectivity depends only on the op set, not bounds/fixed/res —
         # the DP revisits the same subgraph under thousands of boundary
         # states, so memoize (554k calls / 78s on Inception otherwise)
+        # key built directly (NOT via the _guids identity cache: callers
+        # pass fresh slice tuples, which would always miss and pin dead
+        # entries); _comp_cache dedups by value
         ck = tuple(o.guid for o in ops)
         cached = self._comp_cache.get(ck)
         if cached is not None:

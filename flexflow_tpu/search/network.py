@@ -24,6 +24,12 @@ class TorusTopology:
 
     dims: Tuple[int, ...]  # e.g. (4, 8)
 
+    def __post_init__(self):
+        # memo tables: the search asks for the same <=32x32 chip pairs
+        # hundreds of thousands of times per candidate
+        self._nbr_cache: Dict[int, List[int]] = {}
+        self._path_cache: Dict[Tuple[int, int], List[int]] = {}
+
     @property
     def num_chips(self) -> int:
         n = 1
@@ -45,6 +51,9 @@ class TorusTopology:
         return idx
 
     def neighbors(self, chip: int) -> List[int]:
+        hit = self._nbr_cache.get(chip)
+        if hit is not None:
+            return hit
         cs = list(self.coords(chip))
         out = []
         for axis, d in enumerate(self.dims):
@@ -54,7 +63,9 @@ class TorusTopology:
                 n = list(cs)
                 n[axis] = (n[axis] + delta) % d
                 out.append(self.chip(n))
-        return sorted(set(out))
+        out = sorted(set(out))
+        self._nbr_cache[chip] = out
+        return out
 
     def hop_distance(self, a: int, b: int) -> int:
         """Manhattan distance on the torus (wraparound links)."""
@@ -67,8 +78,14 @@ class TorusTopology:
 
     def shortest_path(self, a: int, b: int) -> List[int]:
         """Dijkstra over unit-cost torus links (reference:
-        WeightedShortestPathRoutingStrategy, simulator.h:172-399)."""
+        WeightedShortestPathRoutingStrategy, simulator.h:172-399).
+        Memoized: only num_chips^2 pairs exist, and one 32-worker
+        Inception DP evaluation asks ~10k times."""
+        hit = self._path_cache.get((a, b))
+        if hit is not None:
+            return hit
         if a == b:
+            self._path_cache[(a, b)] = [a]
             return [a]
         dist = {a: 0}
         prev: Dict[int, int] = {}
@@ -88,7 +105,9 @@ class TorusTopology:
         path = [b]
         while path[-1] != a:
             path.append(prev[path[-1]])
-        return list(reversed(path))
+        out = list(reversed(path))
+        self._path_cache[(a, b)] = out
+        return out
 
 
 @dataclasses.dataclass

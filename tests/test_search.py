@@ -535,6 +535,24 @@ def test_view_canonicalization():
     vs = sh2.valid_views(g2.ops[0], res2)
     assert sorted(v.start_device_id for v in vs) == [0, 4]
 
+    # quarter anchoring: on a 32-worker node a LOW-degree view keeps only
+    # node-quarter starts (without it a degree-2 op gets 16 views and one
+    # Inception DP evaluation takes minutes — profiled dp4 97s -> ~3s;
+    # finer concurrent-tower offsets come from nonsequence machine
+    # splits, whose sub-resources re-anchor). 8-worker sets (above) are
+    # unchanged: there the quarter never exceeds the tile size.
+    m32 = MachineModel(num_nodes=1, workers_per_node=32)
+    sh32 = SearchHelper(CostModel(m32))
+    res32 = MachineResource(num_nodes=1, all_procs_per_node=32,
+                            available_procs_per_node=32)
+    g3 = mlp_graph()
+    op3 = g3.ops[0]
+    for t in op3.outputs:
+        t.dims[0].degree = 2
+    starts32 = sorted(v.start_device_id for v in sh32.valid_views(op3, res32)
+                      if v.stride == (1,))
+    assert starts32 == [0, 8, 16, 24], starts32
+
 
 def test_machine_config_file_topology_end_to_end():
     """VERDICT r2 weak-7: the shipped machine files must drive the
