@@ -69,6 +69,9 @@ class SearchHelper:
         # _cost_of canonicalization sets, rebuilt 124k times per
         # 32-worker Inception DP evaluation otherwise
         self._obs_cache: Dict[Tuple, Tuple[set, set]] = {}
+        # ops-tuple identity -> (local sids, ext index, tensor sid map):
+        # the STRUCTURAL subproblem key (see _local_sids)
+        self._sid_tuples: Dict[int, Tuple] = {}
 
     # -- machine view enumeration (reference: register_all_machine_views +
     #    Op::get_valid_machine_views) -----------------------------------
@@ -196,11 +199,63 @@ class SearchHelper:
         self._guid_tuples[id(ops)] = (ops, g)
         return g
 
+    def _local_sids(self, ops):
+        """STRUCTURAL ids for a subproblem, local to the ops tuple: each
+        op's id folds (op_type, params, input ids, output/weight shape
+        keys incl. parallel degrees), where inputs produced OUTSIDE the
+        subproblem become positionally-indexed placeholders (first-
+        consumption order) instead of upstream provenance. Two
+        subproblems with isomorphic internals and equal boundary shapes
+        therefore key IDENTICALLY even when they come from different
+        candidate graphs (rewrite candidates mint fresh guids for every
+        op — a guid-keyed memo restarts the DP from scratch per
+        candidate; the reference shares across the whole best-first run
+        for the same reason, graph.cc dp_state_hash).
+
+        Returns (sid tuple, external-tensor-guid -> index,
+        tensor-guid -> sid) — the latter two translate bounds/fixed into
+        the structural key space."""
+        ent = self._sid_tuples.get(id(ops))
+        if ent is not None and ent[0] is ops:
+            return ent[1]
+        ext_ix: Dict[int, int] = {}
+        t_sid: Dict[int, Tuple] = {}
+        sids = []
+        for o in ops:
+            ins = []
+            for t in o.inputs:
+                s = t_sid.get(t.guid)
+                if s is None:
+                    k = ext_ix.get(t.guid)
+                    if k is None:
+                        k = len(ext_ix)
+                        ext_ix[t.guid] = k
+                    s = ("x", k, t.shape_key())
+                ins.append(s)
+            h = hash((
+                o.op_type, o.params, tuple(ins),
+                tuple(t.shape_key() for t in o.outputs),
+                tuple(w.shape_key() for w in o.weights),
+            ))
+            sids.append(h)
+            for i, t in enumerate(o.outputs):
+                t_sid[t.guid] = (h, i)
+        out = (tuple(sids), ext_ix, t_sid)
+        if len(self._sid_tuples) > 300_000:
+            self._sid_tuples.clear()
+        self._sid_tuples[id(ops)] = (ops, out)
+        return out
+
     def _memo_key(self, ops, bounds, fixed, res):
+        sids, ext_ix, t_sid = self._local_sids(ops)
+        pos = {o.guid: i for i, o in enumerate(ops)}
         return (
-            self._guids(ops),
-            tuple(sorted((g, v.hash()) for g, v in bounds.items())),
-            tuple(sorted((g, v.hash()) for g, v in fixed.items())),
+            sids,
+            tuple(sorted(
+                (ext_ix.get(g, t_sid.get(g)), v.hash())
+                for g, v in bounds.items()
+            )),
+            tuple(sorted((pos[g], v.hash()) for g, v in fixed.items())),
             res.hash(),
         )
 
