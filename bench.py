@@ -112,7 +112,8 @@ def main():
     # the remote-tunnel round-trip latency. The reference's bench likewise
     # replays a Legion trace per iteration (flexflow_cffi.py:2093-2102).
     scan = ex.build_train_scan()
-    spd = 50  # steps per dispatch
+    smoke = bool(os.environ.get("FF_BENCH_SMOKE"))
+    spd = 2 if smoke else 50  # steps per dispatch
     xs = [jax.numpy.broadcast_to(x, (spd,) + x.shape)]
     ys = jax.numpy.broadcast_to(y, (spd,) + y.shape)
     keys = jax.random.split(key, spd)
@@ -126,7 +127,7 @@ def main():
         state, partials = scan(state, xs, ys, keys)
     sync(state)
 
-    chunks = 3
+    chunks = 1 if smoke else 3
     iters = spd * chunks
     t0 = time.perf_counter()
     for _ in range(chunks):

@@ -12,6 +12,7 @@ end_trace — here one fused jitted step per iteration).
 from __future__ import annotations
 
 import time
+import warnings
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -949,6 +950,8 @@ class FFModel:
                 self.graph, cost_model, res, xfers,
                 device_mem_budget=mem_budget,
                 alpha=cfg.search_alpha, budget=budget,
+                train=self._is_training_compile(), optimizer=self.optimizer,
+                grad_bytes_ratio=self._grad_bytes_ratio(),
             )
         else:
             gsh = GraphSearchHelper(
@@ -961,6 +964,22 @@ class FFModel:
         self.graph = best_graph
         self.searched_views = result.views
         self.searched_cost = result.cost
+        # Pipeline as a SEARCHED dimension (beyond-parity: the reference's
+        # OP_PIPELINE is enum-only, ffconst.h:158): when the best
+        # unpipelined strategy's per-chip TRAINING memory (weights +
+        # grads + optimizer slots + activations) exceeds the HBM budget,
+        # weigh GPipe candidates (bubble fraction + cut-activation
+        # transfers) against the best FITTING unpipelined strategy a
+        # memory-pressured re-search finds, and adopt whichever is
+        # cheaper. Runs before re-indexing/exports because it may replace
+        # the strategy either way.
+        pipe, alt = self._search_pipeline_degree(
+            cost_model, result, ndev, mem_budget, res=res, xfers=xfers
+        )
+        if alt is not None:
+            self.graph, result = alt
+            self.searched_views = result.views
+            self.searched_cost = result.cost
         # re-index pt lookup for the (possibly rewritten) graph
         self._pt_by_guid = {}
         for op in self.graph.ops:
@@ -976,15 +995,6 @@ class FFModel:
             with open(cfg.export_strategy_computation_graph_file, "w") as f:
                 f.write(self.graph.export_dot())
         axis_sizes = strategies.assign_mesh_axes(self.graph, ndev)
-        # Pipeline as a SEARCHED dimension (beyond-parity: the reference's
-        # OP_PIPELINE is enum-only, ffconst.h:158): when the best
-        # unpipelined strategy's per-chip memory exceeds the HBM budget,
-        # evaluate GPipe candidates (bubble fraction + cut-activation
-        # transfers, stage count as the searched degree) and adopt the
-        # cheapest stage count that fits.
-        pipe = self._search_pipeline_degree(
-            cost_model, result, ndev, axis_sizes, mem_budget
-        )
         if pipe > 1:
             # the pipeline candidate is a stage split + data parallelism
             # within each stage; it REPLACES the overflowing strategy's
@@ -994,29 +1004,61 @@ class FFModel:
             self.searched_pipeline_degree = pipe
         return build_mesh(axis_sizes)
 
-    def _search_pipeline_degree(self, cost_model, result, ndev, axis_sizes,
-                                mem_budget) -> int:
+    def _grad_bytes_ratio(self) -> float:
+        """Gradient-buffer width relative to the master weight: 0.5 under
+        the bf16-grad AMP recipe (executor grad_dtype), else 1.0 — the
+        memory search charges `weights * (1 + this + optimizer slots)`."""
+        cfg = self.config
+        use_bf16 = (cfg.allow_mixed_precision if cfg.bf16_grads is None
+                    else cfg.bf16_grads)
+        return 0.5 if use_bf16 else 1.0
+
+    def _is_training_compile(self) -> bool:
+        """Inference compiles allocate no gradients or optimizer slots —
+        charging them (2-4x weight bytes under Adam) would wrongly
+        reject strategies that fit inference HBM comfortably."""
+        return self.comp_mode == CompMode.COMP_MODE_TRAINING
+
+    def _search_pipeline_degree(self, cost_model, result, ndev,
+                                mem_budget, res=None, xfers=None):
         """Propose pipeline parallelism when the searched strategy cannot
-        fit per-chip HBM. Candidate cost for S stages over ndev devices
-        (dp = ndev/S within each stage, M microbatches):
+        fit per-chip HBM under TRAINING memory accounting (weights +
+        gradients + optimizer slots + activation residuals — reference:
+        memory_optimization.h:45-100). Candidate cost for S stages over
+        ndev devices (dp = ndev/S within each stage, M microbatches):
 
             T(S) ~ max_stage_time/dp * (M + S - 1)/M
                    + cut_bytes * 2 / ici_bw / dp
 
         i.e. the GPipe bubble fraction plus fwd+bwd boundary-activation
         transfers; per-chip memory ~ stage weights (replicated in the
-        stage's dp group) + stage activation shards * the in-flight
-        microbatch count. Returns 1 when the unpipelined strategy fits
-        (a test pins that it is NOT chosen then) or no stage count fits."""
-        from ..search.memory_optimization import measure_memory
+        stage's dp group, carrying the grads+slots multiplier) + stage
+        activation shards for ALL M microbatches (the scan-based GPipe
+        backward stashes every microbatch's residuals).
+
+        Returns (degree, alt): degree==1 when the unpipelined strategy
+        already fits (a test pins that pipeline is NOT chosen then);
+        alt!=None is a FITTING unpipelined (graph, result) found by a
+        memory-pressured re-search that beats every pipeline candidate
+        on cost — TP's per-layer collectives against GPipe's bubble is a
+        cost question, not a memory one, so it is decided on cost."""
+        from ..search.memory_optimization import (
+            measure_memory,
+            weight_bytes_multiplier,
+        )
         from ..parallel.pipeline import balanced_linear_partition
 
         cfg = self.config
         if ndev < 2:
-            return 1
-        mem = measure_memory(self.graph, result.views, cost_model).max_bytes
+            return 1, None
+        gratio = self._grad_bytes_ratio()
+        wmul = weight_bytes_multiplier(self.optimizer, gratio)
+        mem = measure_memory(
+            self.graph, result.views, cost_model,
+            train=True, optimizer=self.optimizer, grad_bytes_ratio=gratio,
+        ).max_bytes
         if mem <= mem_budget:
-            return 1
+            return 1, None
         from ..pcg.machine_view import MachineView
 
         machine = cost_model.machine
@@ -1051,19 +1093,52 @@ class FFModel:
                                 for i in range(S - 1))
                 t = (max(stage_t) / dp * (M + S - 1) / M
                      + cut_bytes * 2 / machine.ici_bandwidth / dp)
-                # stage weights replicate within the stage's dp group;
-                # the scan-based GPipe schedule (backward = reversed scan
-                # under jax.grad) stashes ALL M microbatches' residuals —
-                # per chip that is the stage's full batch-shard of
+                # stage weights replicate within the stage's dp group and
+                # carry grads + optimizer slots (wmul); the scan-based
+                # GPipe schedule (backward = reversed scan under
+                # jax.grad) stashes ALL M microbatches' residuals — per
+                # chip that is the stage's full batch-shard of
                 # activations, not just the in-flight window
                 m_per_chip = max(
-                    w + a / dp
+                    w * wmul + a / dp
                     for w, a in zip(stage_w, stage_a)
                 )
                 if m_per_chip <= mem_budget and t < best_t:
                     best_s, best_t = S, t
             S *= 2
-        return best_s
+        if res is not None and xfers is not None \
+                and not cfg.perform_memory_search:
+            # The overflowing strategy was the COST winner; whether or
+            # not any pipeline stage count fit, let the lambda loop look
+            # for a fitting unpipelined strategy (e.g. parameter-parallel
+            # sharding that divides the weight+grad+slot bytes). Adopt it
+            # when it fits and beats the pipeline estimate on simulated
+            # runtime (or when no pipeline fit at all). (Under
+            # --memory-search that loop already ran and failed to fit,
+            # so it is not repeated here.)
+            from ..search.memory_optimization import (
+                graph_optimize_with_memory,
+            )
+
+            budget = cfg.search_budget if cfg.search_budget > 0 else 10
+            g2, r2, mem2, _lam = graph_optimize_with_memory(
+                self.graph, cost_model, res, xfers,
+                device_mem_budget=mem_budget,
+                alpha=cfg.search_alpha, budget=budget,
+                train=True, optimizer=self.optimizer,
+                grad_bytes_ratio=gratio,
+            )
+            if mem2.max_bytes <= mem_budget and r2.cost < best_t:
+                return 1, (g2, r2)
+        if best_s == 1:
+            warnings.warn(
+                f"per-chip training memory "
+                f"{mem / 2**20:.0f} MB exceeds the "
+                f"{mem_budget / 2**20:.0f} MB budget and no pipeline "
+                f"stage count or re-searched strategy fits; keeping the "
+                f"fastest (overflowing) strategy"
+            )
+        return best_s, None
 
     # ------------------------------------------------------------------
     # training loop (reference: flexflow_cffi.py:2058 fit)

@@ -72,6 +72,13 @@ class SearchHelper:
         # ops-tuple identity -> (local sids, ext index, tensor sid map):
         # the STRUCTURAL subproblem key (see _local_sids)
         self._sid_tuples: Dict[int, Tuple] = {}
+        # full structural tuple -> small int id. Interning (instead of
+        # hash()) makes sid equality EXACT: a 64-bit hash collision
+        # between two different subproblems would silently merge their
+        # memo entries and return a wrong cost/strategy with no
+        # detection. Tuples stay shallow (producer sids are the interned
+        # ints, not nested tuples), so lookup cost matches hashing.
+        self._struct_intern: Dict[Tuple, int] = {}
 
     # -- machine view enumeration (reference: register_all_machine_views +
     #    Op::get_valid_machine_views) -----------------------------------
@@ -119,11 +126,14 @@ class SearchHelper:
         # degree is what keeps 32-worker searches tractable. Strided
         # (inter-node) views keep every start.
         #
-        # Starts are additionally anchored to QUARTERS of the node: a
-        # low-degree view at a sub-quarter offset (deg-2 at chips {4,5}
-        # of 32) is cost-equivalent to its quarter-anchored sibling for
-        # everything the leaf cost sees, and concurrent-tower placements
-        # at finer offsets are exactly what the nonsequence machine
+        # Starts are additionally anchored to QUARTERS of the node. This
+        # is an APPROXIMATION, not an equivalence: node_cost's producer->
+        # consumer transfer terms depend on absolute device offsets, so
+        # pruning a sub-quarter start (deg-2 at chips {4,5} of 32) can
+        # exclude a placement strictly closer to an already-placed
+        # producer. It is close in practice because the bandwidth term
+        # dominates and is start-independent, and concurrent-tower
+        # placements at finer offsets are what the nonsequence machine
         # splits enumerate (disjoint sub-resources, each re-anchored).
         # Without this, a degree-2 rewrite on a 32-worker machine gets 16
         # views per op and one Inception DP evaluation takes minutes
@@ -218,6 +228,12 @@ class SearchHelper:
         ent = self._sid_tuples.get(id(ops))
         if ent is not None and ent[0] is ops:
             return ent[1]
+        if len(self._struct_intern) > 1_000_000:
+            # sids index into the intern table: clearing it invalidates
+            # every cached sid and memo entry, so all three reset together
+            self._struct_intern.clear()
+            self._sid_tuples.clear()
+            self._memo.clear()
         ext_ix: Dict[int, int] = {}
         t_sid: Dict[int, Tuple] = {}
         sids = []
@@ -232,11 +248,15 @@ class SearchHelper:
                         ext_ix[t.guid] = k
                     s = ("x", k, t.shape_key())
                 ins.append(s)
-            h = hash((
+            full = (
                 o.op_type, o.params, tuple(ins),
                 tuple(t.shape_key() for t in o.outputs),
                 tuple(w.shape_key() for w in o.weights),
-            ))
+            )
+            h = self._struct_intern.get(full)
+            if h is None:
+                h = len(self._struct_intern)
+                self._struct_intern[full] = h
             sids.append(h)
             for i, t in enumerate(o.outputs):
                 t_sid[t.guid] = (h, i)
@@ -293,10 +313,28 @@ class SearchHelper:
         if any(g not in own for g in fixed):
             fixed = {g: v for g, v in fixed.items() if g in own}
         key = self._memo_key(ops, bounds, fixed, res)
-        if key in self._memo:
-            return self._memo[key]
+        hit = self._memo.get(key)
+        if hit is not None:
+            # The memo is STRUCTURAL — shared across candidate graphs (and
+            # isomorphic towers of one graph) whose ops carry different
+            # guids — so cached views are stored POSITIONALLY (index into
+            # the ops tuple; positions are stable across structurally-
+            # identical subproblems) and remapped to THIS caller's guids
+            # here. Returning the first computer's guid-keyed dict was
+            # round 3's regression: every cross-candidate hit produced a
+            # views map whose keys matched no op in the querying graph,
+            # silently dropping placements (and zeroing boundary
+            # congestion, which reads r.views by the caller's guids).
+            cost, pos_views = hit
+            return GraphCostResult(
+                cost, {ops[i].guid: v for i, v in pos_views}
+            )
         result = self._compute(ops, bounds, fixed, res, graph)
-        self._memo[key] = result
+        pos = {o.guid: i for i, o in enumerate(ops)}
+        self._memo[key] = (
+            result.cost,
+            tuple((pos[g], v) for g, v in result.views.items() if g in pos),
+        )
         return result
 
     def _compute(self, ops, bounds, fixed, res, graph) -> GraphCostResult:

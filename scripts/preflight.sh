@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+# Mechanical end-of-round gate (VERDICT r3 #8): run before EVERY snapshot
+# commit. Round 3 shipped its final two commits without re-running the
+# suite and ended with 3 red tests and an rc=1 driver dryrun; this script
+# makes that class of damage impossible to ship silently.
+#
+#   scripts/preflight.sh           # full: pytest + dryrun(8) + bench smoke
+#   scripts/preflight.sh --fast    # skip the bench smoke
+#
+# Exits non-zero on ANY failure. Paste the tail of its output into the
+# snapshot commit message.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+FAST=${1:-}
+FAIL=0
+
+echo "== preflight: pytest =="
+if python -m pytest tests/ -q -x --timeout=1200 2>/dev/null \
+    || python -m pytest tests/ -q -x; then
+    echo "preflight pytest: OK"
+else
+    echo "preflight pytest: FAILED"
+    FAIL=1
+fi
+
+echo "== preflight: dryrun_multichip(8) =="
+if python - <<'EOF'
+import os
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+try:
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import __graft_entry__ as ge
+ge.dryrun_multichip(8)
+print("preflight dryrun: OK")
+EOF
+then
+    :
+else
+    echo "preflight dryrun: FAILED"
+    FAIL=1
+fi
+
+if [ "$FAST" != "--fast" ]; then
+    echo "== preflight: bench smoke =="
+    # FF_BENCH_SMOKE trims steps so this is a compile+run sanity check,
+    # not a measurement; the driver runs the real bench on silicon.
+    if FF_BENCH_SMOKE=1 python bench.py; then
+        echo "preflight bench: OK"
+    else
+        echo "preflight bench: FAILED"
+        FAIL=1
+    fi
+fi
+
+if [ "$FAIL" -ne 0 ]; then
+    echo "PREFLIGHT: FAILED"
+    exit 1
+fi
+echo "PREFLIGHT: GREEN"
