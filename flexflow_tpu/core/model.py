@@ -1051,11 +1051,13 @@ class FFModel:
         cfg = self.config
         if ndev < 2:
             return 1, None
+        train = self._is_training_compile()
         gratio = self._grad_bytes_ratio()
-        wmul = weight_bytes_multiplier(self.optimizer, gratio)
+        wmul = (weight_bytes_multiplier(self.optimizer, gratio)
+                if train else 1.0)
         mem = measure_memory(
             self.graph, result.views, cost_model,
-            train=True, optimizer=self.optimizer, grad_bytes_ratio=gratio,
+            train=train, optimizer=self.optimizer, grad_bytes_ratio=gratio,
         ).max_bytes
         if mem <= mem_budget:
             return 1, None
@@ -1125,7 +1127,7 @@ class FFModel:
                 self.graph, cost_model, res, xfers,
                 device_mem_budget=mem_budget,
                 alpha=cfg.search_alpha, budget=budget,
-                train=True, optimizer=self.optimizer,
+                train=train, optimizer=self.optimizer,
                 grad_bytes_ratio=gratio,
             )
             if mem2.max_bytes <= mem_budget and r2.cost < best_t:

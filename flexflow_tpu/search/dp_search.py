@@ -79,6 +79,16 @@ class SearchHelper:
         # detection. Tuples stay shallow (producer sids are the interned
         # ints, not nested tuples), so lookup cost matches hashing.
         self._struct_intern: Dict[Tuple, int] = {}
+        # Bumped whenever _struct_intern is cleared. The clear fires
+        # inside _local_sids, which is reached MID-RECURSION from
+        # _cost_of: stack frames above already computed their memo key
+        # with OLD interned sids and store it into the freshly cleared
+        # _memo after returning — and the rebuilt intern table reassigns
+        # the same small ints to DIFFERENT structures, so a later lookup
+        # could silently hit that stale entry (the exact silent-merge
+        # failure interning exists to eliminate). Folding the generation
+        # into every memo key makes pre-clear keys unmatchable.
+        self._intern_gen: int = 0
 
     # -- machine view enumeration (reference: register_all_machine_views +
     #    Op::get_valid_machine_views) -----------------------------------
@@ -234,6 +244,7 @@ class SearchHelper:
             self._struct_intern.clear()
             self._sid_tuples.clear()
             self._memo.clear()
+            self._intern_gen += 1
         ext_ix: Dict[int, int] = {}
         t_sid: Dict[int, Tuple] = {}
         sids = []
@@ -270,6 +281,7 @@ class SearchHelper:
         sids, ext_ix, t_sid = self._local_sids(ops)
         pos = {o.guid: i for i, o in enumerate(ops)}
         return (
+            self._intern_gen,
             sids,
             tuple(sorted(
                 (ext_ix.get(g, t_sid.get(g)), v.hash())

@@ -44,7 +44,11 @@ def weight_bytes_multiplier(
     slots = 0
     if optimizer is not None:
         get = getattr(optimizer, "state_slots_per_weight", None)
-        slots = get() if get is not None else 1
+        # A third-party optimizer without the hook gets the base
+        # Optimizer default (0 slots) rather than a guessed 1 — guessing
+        # over-charges a stateless optimizer a full weight-sized slot
+        # and under-charges an Adam-like one either way.
+        slots = get() if get is not None else 0
     return 1.0 + grad_bytes_ratio + slots
 
 

@@ -16,8 +16,15 @@ FAST=${1:-}
 FAIL=0
 
 echo "== preflight: pytest =="
-if python -m pytest tests/ -q -x --timeout=1200 2>/dev/null \
-    || python -m pytest tests/ -q -x; then
+# Pick the timeout flag by plugin availability up front — retrying on ANY
+# failure would run a genuinely red suite twice and discard the first
+# run's stderr (collection errors, tracebacks).
+if python -c 'import pytest_timeout' 2>/dev/null; then
+    PYTEST_ARGS=(--timeout=1200)
+else
+    PYTEST_ARGS=()
+fi
+if python -m pytest tests/ -q -x ${PYTEST_ARGS[@]+"${PYTEST_ARGS[@]}"}; then
     echo "preflight pytest: OK"
 else
     echo "preflight pytest: FAILED"
