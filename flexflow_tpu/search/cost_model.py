@@ -87,6 +87,64 @@ def op_flops(op: PCGOp) -> float:
     return float(sum(_vol(s) for s in out_shapes))
 
 
+def _pad(v, q: int) -> float:
+    return float(math.ceil(max(1, int(v)) / q) * q)
+
+
+def _shard_shape(t) -> List[int]:
+    """Per-device shard extents: size/degree per dim (replica dims keep
+    their size — every replica computes the full extent)."""
+    return [max(1, d.size // max(1, d.degree)) if not d.is_replica_dim
+            else d.size for d in t.dims]
+
+
+def op_padded_flops(op: PCGOp, parts: int = 1) -> float:
+    """PER-SHARD MXU-effective FLOPs: the systolic array is 128 lanes
+    wide (output channels), 128 deep (contraction), with 8-row sublanes;
+    a matmul whose dims are not tile multiples runs at the PADDED
+    shape's cost (the public scaling-book tile-quantization rule, and
+    what our own silicon measurements show: head_dim-64 attention
+    matmuls cap at ~98 TF/s = half the 197 TF/s peak, BASELINE.md).
+    Padding applies to the SHARD shape, not the logical one — splitting
+    a 128-wide gemm two ways leaves each 64-wide shard paying a full
+    tile, so over-sharding narrow dims correctly stops helping. This is
+    also what makes merge-parallel-ops rewrites pay on TPU: 96- and
+    32-wide gemms each stream a full 128-lane tile, merged they fill
+    one. Ops with no MXU shape return plain per-shard flops."""
+    t = op.op_type
+    if t == OperatorType.OP_LINEAR and op.inputs and op.outputs:
+        si = _shard_shape(op.inputs[0])
+        so = _shard_shape(op.outputs[0])
+        return 2.0 * _pad(_vol(so[:-1]), 8) * _pad(si[-1], 128) * _pad(so[-1], 128)
+    if t == OperatorType.OP_CONV2D and op.inputs and op.outputs:
+        si = _shard_shape(op.inputs[0])   # (N, Cin, H, W) shard
+        so = _shard_shape(op.outputs[0])  # (N, Cout, OH, OW) shard
+        p = op.params
+        contraction = si[1] * p.kernel_h * p.kernel_w // max(1, p.groups)
+        return 2.0 * _pad(so[0] * so[2] * so[3], 8) * _pad(contraction, 128) \
+            * _pad(so[1], 128)
+    if t == OperatorType.OP_BATCHMATMUL and len(op.inputs) == 2:
+        sa = _shard_shape(op.inputs[0])
+        sb = _shard_shape(op.inputs[1])
+        return 2.0 * _pad(_vol(sa[:-1]), 8) * _pad(sa[-1], 128) * _pad(sb[-1], 128)
+    if t == OperatorType.OP_MULTIHEAD_ATTENTION and len(op.inputs) == 3:
+        q, k = op.inputs[0], op.inputs[1]
+        p = op.params
+        bq = _shard_shape(q)[0]
+        sq, eq = q.dims[1].size, q.dims[2].size
+        sk = k.dims[1].size
+        # head-sharded MHA (weight-only degrees) keeps its full-h price —
+        # the DP grants it single-part views, so charging one shard here
+        # would let a TP candidate undercut without paying its devices
+        h, d = p.num_heads, p.qk_head_dim
+        proj = 2.0 * _pad(bq * sq, 8) * _pad(eq, 128) * _pad(h * d, 128) * 3
+        scores = 2.0 * bq * h * _pad(sq, 8) * _pad(d, 128) * _pad(sk, 128)
+        av = 2.0 * bq * h * _pad(sq, 8) * _pad(sk, 128) * _pad(p.v_head_dim, 128)
+        out = 2.0 * _pad(bq * sq, 8) * _pad(h * p.v_head_dim, 128) * _pad(p.embed_dim, 128)
+        return proj + scores + av + out
+    return op_flops(op) / max(1, parts)
+
+
 def op_bytes(op: PCGOp) -> float:
     """HBM traffic of the whole op (inputs + outputs + weights, once)."""
     n = 0
@@ -256,7 +314,15 @@ class CostModel:
         if key in self._cache:
             return self._cache[key]
         parts = max(1, view.num_parts())
-        flops = op_flops(op) / parts
+        # MXU time is paid at the tile-quantized SHARD shape; the padded
+        # count only describes the shard when the tensor degrees actually
+        # match the view's parts (they do for DP-granted views;
+        # unsharded-tensor-on-wide-view callers fall back to plain /parts)
+        out_deg = op.outputs[0].get_total_degree() if op.outputs else 1
+        if out_deg == parts:
+            flops = op_padded_flops(op, parts)
+        else:
+            flops = op_flops(op) / parts
         membytes = op_bytes(op) / parts
         if key not in self.measured and self.measure_fn is not None:
             m_fwd, m_bwd = self.measure_fn(op, view)

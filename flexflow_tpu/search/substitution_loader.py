@@ -20,8 +20,9 @@ import itertools
 import json
 from typing import Dict, Iterator, List, Optional, Tuple
 
-from ..ff_types import OperatorType
+from ..ff_types import ActiMode, OperatorType
 from ..parallel.parallel_ops import (
+    AllToAllParams,
     CombineParams,
     ReductionParams,
     ReplicateParams,
@@ -45,6 +46,7 @@ _TYPE_MAP = {
     "OP_LINEAR": OperatorType.OP_LINEAR,
     "OP_CONV2D": OperatorType.OP_CONV2D,
     "OP_RELU": OperatorType.OP_RELU,
+    "OP_GELU": OperatorType.OP_GELU,
     "OP_SIGMOID": OperatorType.OP_SIGMOID,
     "OP_TANH": OperatorType.OP_TANH,
     "OP_SOFTMAX": OperatorType.OP_SOFTMAX,
@@ -63,6 +65,8 @@ _TYPE_MAP = {
     "OP_POOL2D_AVG": OperatorType.OP_POOL2D,
     "OP_FLAT": OperatorType.OP_FLAT,
     "OP_NOOP": OperatorType.OP_NOOP,
+    "OP_ALLTOALL": OperatorType.OP_ALL_TO_ALL,
+    "OP_ALL_TO_ALL": OperatorType.OP_ALL_TO_ALL,
 }
 
 _PARALLEL_TYPES = {
@@ -70,6 +74,22 @@ _PARALLEL_TYPES = {
     OperatorType.OP_COMBINE,
     OperatorType.OP_REPLICATE,
     OperatorType.OP_REDUCTION,
+    OperatorType.OP_ALL_TO_ALL,
+}
+
+# Ops whose params carry a fusable `activation` field (reference: cuDNN
+# epilogue fusion, conv_2d.cc/linear.cc fused activation). PM_ACTI on a
+# src pattern constrains it; PM_ACTI on a dst op sets it.
+_ACTIVATION_TYPES = {
+    OperatorType.OP_LINEAR,
+    OperatorType.OP_CONV2D,
+}
+# activation-op type -> the ActiMode a fusion rule folds it into
+ACTI_OF_OP = {
+    OperatorType.OP_RELU: ActiMode.AC_MODE_RELU,
+    OperatorType.OP_GELU: ActiMode.AC_MODE_GELU,
+    OperatorType.OP_SIGMOID: ActiMode.AC_MODE_SIGMOID,
+    OperatorType.OP_TANH: ActiMode.AC_MODE_TANH,
 }
 
 
@@ -156,12 +176,14 @@ _PARALLEL_DEGREE_ATTR = {
     OperatorType.OP_COMBINE: "combine_degree",
     OperatorType.OP_REPLICATE: "replicate_degree",
     OperatorType.OP_REDUCTION: "reduction_degree",
+    OperatorType.OP_ALL_TO_ALL: "degree",
 }
 _PARALLEL_DIM_ATTR = {
     OperatorType.OP_REPARTITION: "repartition_dim",
     OperatorType.OP_COMBINE: "combine_dim",
     OperatorType.OP_REPLICATE: "replicate_dim",
     OperatorType.OP_REDUCTION: "reduction_dim",
+    OperatorType.OP_ALL_TO_ALL: "scatter_dim",
 }
 
 
@@ -180,6 +202,13 @@ def _op_matches(op: PCGOp, pat: OpPattern) -> bool:
         dim = pat.params.get("PM_PARALLEL_DIM")
         if dim is not None and getattr(
                 op.params, _PARALLEL_DIM_ATTR[op.op_type]) != dim:
+            return False
+    acti = pat.params.get("PM_ACTI")
+    if acti is not None:
+        # fusion-rule guard: only fuse into an op whose epilogue slot is
+        # free (AC_MODE_NONE) — and never match an op lacking the field
+        cur = getattr(op.params, "activation", None)
+        if cur is None or int(cur) != acti:
             return False
     return True
 
@@ -237,6 +266,12 @@ def _build_parallel_params(op_type: OperatorType, para: Dict[str, int]):
         return ReplicateParams(dim, deg)
     if op_type == OperatorType.OP_REDUCTION:
         return ReductionParams(dim, deg)
+    if op_type == OperatorType.OP_ALL_TO_ALL:
+        return AllToAllParams(
+            scatter_dim=para["PM_SCATTER_DIM"],
+            gather_dim=para["PM_GATHER_DIM"],
+            degree=deg,
+        )
     raise ValueError(op_type)
 
 
@@ -281,6 +316,7 @@ def apply_rule(graph: Graph, rule: Rule) -> Iterator[Graph]:
         # build dst ops in order
         new_ops: List[PCGOp] = []
         used_src: set = set()
+        merge_sizes: List[int] = []  # out_channels of PM_MERGE'd src ops
 
         def params_from_matched(op_type: OperatorType):
             for pi, pat in enumerate(rule.src_ops):
@@ -297,6 +333,7 @@ def apply_rule(graph: Graph, rule: Rule) -> Iterator[Graph]:
                         ins.append(resolve_ext(ref))
                     else:
                         ins.append(new_ops[ref.op_id].outputs[ref.ts_id])
+                fresh_weights = False
                 if dpat.op_type in _PARALLEL_TYPES:
                     params = _build_parallel_params(dpat.op_type, dpat.params)
                     src_params_op = None
@@ -306,17 +343,64 @@ def apply_rule(graph: Graph, rule: Rule) -> Iterator[Graph]:
                     from ..ops.tensor_ops import NoOpParams
 
                     params, src_params_op = NoOpParams(), None
+                elif "PM_MERGE" in dpat.params:
+                    # merge-parallel-ops rewrite (TASO's merge_group_convs /
+                    # merge two matmuls into one — reference:
+                    # substitutions/graph_subst_3_v2.json merge rules):
+                    # N matched src ops of this type sharing one input
+                    # become ONE op with summed out_channels; weights are
+                    # rebuilt fresh at the merged shape (substitutions run
+                    # before weight materialization, as in the reference
+                    # where the PCG is rewritten pre-allocation).
+                    n = dpat.params["PM_MERGE"]
+                    parts = []
+                    for _ in range(n):
+                        p, o = params_from_matched(dpat.op_type)
+                        if p is None:
+                            raise KeyError(f"merge needs {n} {dpat.op_type}")
+                        parts.append((p, o))
+                    base = dataclasses.replace(parts[0][0], out_channels=0)
+                    if any(dataclasses.replace(p, out_channels=0) != base
+                           for p, _ in parts[1:]):
+                        raise ValueError("merge: op params differ beyond "
+                                         "out_channels")
+                    merge_sizes[:] = [p.out_channels for p, _ in parts]
+                    params = dataclasses.replace(
+                        parts[0][0], out_channels=sum(merge_sizes))
+                    src_params_op = parts[0][1]
+                    fresh_weights = True
                 else:
                     params, src_params_op = params_from_matched(dpat.op_type)
                     if params is None:
-                        raise KeyError(f"no source op to inherit {dpat.op_type}")
+                        if dpat.op_type == OperatorType.OP_SPLIT \
+                                and merge_sizes:
+                            # the un-merge tail of a PM_MERGE rule: restore
+                            # the original per-op output channels
+                            from ..ops.tensor_ops import SplitParams
+
+                            params = SplitParams(
+                                sizes=tuple(merge_sizes),
+                                axis=dpat.params.get("PM_AXIS", -1),
+                            )
+                        else:
+                            raise KeyError(
+                                f"no source op to inherit {dpat.op_type}")
+                acti = dpat.params.get("PM_ACTI")
+                if acti is not None and \
+                        dpat.op_type in _ACTIVATION_TYPES:
+                    # epilogue fusion: fold the matched activation op into
+                    # the producer's fused-activation slot
+                    params = dataclasses.replace(
+                        params, activation=ActiMode(acti))
                 nop = PCGOp(dpat.op_type, params, ins)
                 # infer output shape
                 outs = _infer_outputs(nop, src_params_op)
                 for t in outs:
                     t.owner_op = nop
                     nop.outputs.append(t)
-                if src_params_op is not None:
+                if fresh_weights:
+                    _attach_fresh_weights(nop, src_params_op)
+                elif src_params_op is not None:
                     nop.weights = list(src_params_op.weights)
                     nop.weight_names = list(src_params_op.weight_names)
                     nop.weight_tags = list(getattr(src_params_op, "weight_tags", []))
@@ -367,6 +451,33 @@ def apply_rule(graph: Graph, rule: Rule) -> Iterator[Graph]:
             yield g2
 
 
+def _attach_fresh_weights(op: PCGOp, init_src: Optional[PCGOp]) -> None:
+    """Build weights at the op's own (post-rewrite) shape from the
+    registry spec — used by merge rewrites, whose merged kernel has no
+    single source weight to inherit (lowering.py does the same for
+    freshly lowered layers). Initializer kinds carry over from the first
+    merged source op so e.g. a zeros-init bias stays zeros-init."""
+    from ..ops.registry import get_op_def
+
+    d = get_op_def(op.op_type)
+    in_shapes = [t.material_shape() for t in op.inputs]
+    in_dtypes = [t.data_type for t in op.inputs]
+    op.weights, op.weight_names, op.weight_tags = [], [], []
+    op.initializers = {}
+    src_inits = init_src.initializers if init_src is not None else {}
+    for spec in d.weights(op.params, in_shapes, in_dtypes):
+        wpt = ParallelTensor(
+            dims=[ParallelDim(size=s, degree=1) for s in spec.shape],
+            data_type=spec.dtype,
+            owner_op=op,
+            create_gradients=True,
+        )
+        op.weights.append(wpt)
+        op.weight_names.append(spec.name)
+        op.weight_tags.append(spec.parallel_dim_tags)
+        op.initializers[spec.name] = src_inits.get(spec.name, spec.initializer)
+
+
 def _infer_outputs(op: PCGOp, src_op: Optional[PCGOp]) -> List[ParallelTensor]:
     from ..ops.registry import get_op_def, has_op_def
 
@@ -382,6 +493,16 @@ def _infer_outputs(op: PCGOp, src_op: Optional[PCGOp]) -> List[ParallelTensor]:
         elif op.op_type == OperatorType.OP_REDUCTION:
             if dims and dims[0].is_replica_dim:
                 dims = dims[1:]
+        elif op.op_type == OperatorType.OP_ALL_TO_ALL:
+            # one collective replaces a combine(gather_dim)+partition
+            # (scatter_dim) reshard pair: the gathered dim must enter at
+            # exactly `degree`, the scattered dim unsharded and divisible
+            g, s, d = p.gather_dim, p.scatter_dim, p.degree
+            if dims[g].degree != d or dims[s].degree != 1 \
+                    or dims[s].size % d != 0:
+                raise ValueError("all_to_all: dims not resharddable")
+            dims[g].degree = 1
+            dims[s].degree = d
         return [ParallelTensor(dims=dims, data_type=in_t.data_type)]
     d = get_op_def(op.op_type)
     shapes, dtypes = d.infer(

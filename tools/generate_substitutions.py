@@ -48,12 +48,21 @@ def op(type_str, inputs, params=None):
             "para": params or []}
 
 
-def rule(name, src, dst, src_out, dst_out):
+def pm(key, value):
+    return {"_t": "Parameter", "key": key, "value": value}
+
+
+def rule(name, src, dst, src_out=None, dst_out=None, mapped=None):
+    """mapped: [(srcOpId, srcTsId, dstOpId, dstTsId), ...] for rules with
+    several surviving outputs (merge rules); src_out/dst_out is the
+    single-output shorthand."""
+    if mapped is None:
+        mapped = [(src_out[0], src_out[1], dst_out[0], dst_out[1])]
     return {
         "_t": "Rule", "name": name, "srcOp": src, "dstOp": dst,
-        "mappedOutput": [{"_t": "MapOutput", "srcOpId": src_out[0],
-                          "srcTsId": src_out[1], "dstOpId": dst_out[0],
-                          "dstTsId": dst_out[1]}],
+        "mappedOutput": [{"_t": "MapOutput", "srcOpId": so, "srcTsId": st,
+                          "dstOpId": do, "dstTsId": dt}
+                         for (so, st, do, dt) in mapped],
     }
 
 
@@ -137,8 +146,85 @@ def attention_head_partition(d):
     )
 
 
+# ActiMode values (reference: ffconst.h ActiMode / our ff_types.ActiMode)
+AC_NONE = 10
+ACTI_VALUE = {"OP_RELU": 11, "OP_SIGMOID": 12, "OP_TANH": 13, "OP_GELU": 14}
+
+
+def fuse_epilogue(base, act, short):
+    """TASO-class fusion chain: linear/conv + activation -> ONE op with
+    the activation folded into its epilogue (PM_ACTI on the dst op; the
+    reference corpus carries the analogous fuse_conv_relu rules and the
+    C++ ops fuse via cudnnActivationForward). Removes the separate
+    HBM-bound elementwise pass entirely — on TPU the epilogue runs in
+    the matmul's VPU tail, which is why the cost model prices the fused
+    form cheaper and the search adopts it. The PM_ACTI=NONE constraint
+    on the src op keeps the rule from stacking onto an already-fused
+    epilogue."""
+    return rule(
+        f"fuse_{short}",
+        src=[op(base, [t(-1)], [pm("PM_ACTI", AC_NONE)]),
+             op(act, [t(0)])],
+        dst=[op(base, [t(-1)], [pm("PM_ACTI", ACTI_VALUE[act])])],
+        src_out=(1, 0), dst_out=(0, 0),
+    )
+
+
+def merge_parallel(base, short, axis):
+    """TASO merge-parallel-ops: two linears/convs reading the SAME input
+    become one op with summed out_channels + a split (reference corpus:
+    the merge_group_convs / two-matmuls-one-input family). One bigger
+    MXU gemm beats two smaller ones, and the merged op parallelizes as
+    a unit. PM_MERGE=2 triggers the loader's merge path (params equal
+    except out_channels; fresh weights at the merged shape); the split
+    axis is the channel axis (last for linear, 1 for conv NCHW)."""
+    return rule(
+        f"merge_parallel_{short}s",
+        src=[op(base, [t(-1)]), op(base, [t(-1)])],
+        dst=[
+            op(base, [t(-1)], [pm("PM_MERGE", 2)]),
+            op("OP_SPLIT", [t(0)], [pm("PM_AXIS", axis)]),
+        ],
+        mapped=[(0, 0, 1, 0), (1, 0, 1, 1)],
+    )
+
+
+def a2a_reshard(gather_dim, scatter_dim, d):
+    """DCN-aware reshard collapse: combine(gather_dim, d) immediately
+    followed by partition(scatter_dim, d) is a resharding round-trip
+    that moves the WHOLE tensor twice (all-gather + scatter) — as one
+    OP_ALLTOALL each chip exchanges only its 1/d shard pairwise. On a
+    flat machine this halves reshard cost; across a DCN boundary
+    (machine_config_multislice) it is the difference between the full
+    tensor crossing DCN twice and only the cross-slice shard fraction
+    crossing once (network.py all_to_all_cost vs 2x reshard_cost)."""
+    return rule(
+        f"a2a_reshard_d{gather_dim}to{scatter_dim}_{d}",
+        src=[
+            op("OP_COMBINE", [t(-1)], para(gather_dim, d)),
+            op("OP_PARTITION", [t(0)], para(scatter_dim, d)),
+        ],
+        dst=[op("OP_ALLTOALL", [t(-1)], [
+            pm("PM_SCATTER_DIM", scatter_dim),
+            pm("PM_GATHER_DIM", gather_dim),
+            pm("PM_PARALLEL_DEGREE", d),
+        ])],
+        src_out=(1, 0), dst_out=(0, 0),
+    )
+
+
 def main():
     rules = []
+    for base, short in (("OP_LINEAR", "linear"), ("OP_CONV2D", "conv")):
+        for act in ("OP_RELU", "OP_SIGMOID", "OP_TANH"):
+            rules.append(fuse_epilogue(base, act,
+                                       f"{short}_{act[3:].lower()}"))
+    rules.append(fuse_epilogue("OP_LINEAR", "OP_GELU", "linear_gelu"))
+    rules.append(merge_parallel("OP_LINEAR", "linear", -1))
+    rules.append(merge_parallel("OP_CONV2D", "conv", 1))
+    for d in DEGREES:
+        rules.append(a2a_reshard(0, 1, d))
+        rules.append(a2a_reshard(1, 0, d))
     for d in DEGREES:
         rules.append(unary_batch("OP_LINEAR", "linear", d))
         rules.append(unary_batch("OP_SOFTMAX", "softmax", d))
