@@ -23,6 +23,18 @@ from .core.metrics import Metrics, PerfMetrics  # noqa: F401
 from .core.model import FFModel  # noqa: F401
 from .runtime.checkpoint import restore_checkpoint, save_checkpoint  # noqa: F401
 from .runtime.distributed import init_distributed  # noqa: F401
+from .runtime.resilience import (  # noqa: F401
+    CheckpointManager,
+    FaultInjector,
+    InferenceTimeout,
+    NonFiniteGradientsError,
+    PreemptionSignal,
+    RetryPolicy,
+    StepGuardConfig,
+    TrainingPreempted,
+    restore_latest,
+    retry,
+)
 from .runtime.serving import BatchScheduler  # noqa: F401
 from .core.optimizers import AdamOptimizer, Optimizer, SGDOptimizer  # noqa: F401
 from .core.tensor import Layer, Tensor  # noqa: F401

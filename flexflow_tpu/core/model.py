@@ -11,6 +11,7 @@ end_trace — here one fused jitted step per iteration).
 """
 from __future__ import annotations
 
+import dataclasses
 import time
 import warnings
 from typing import Dict, List, Optional, Sequence, Tuple, Union
@@ -1186,6 +1187,16 @@ class FFModel:
         batch_size: Optional[int] = None,
         epochs: Optional[int] = None,
         verbose: bool = True,
+        *,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every_n_steps: Optional[int] = None,
+        keep_last_n: int = 3,
+        resume: bool = True,
+        skip_nonfinite_steps: bool = False,
+        step_guard=None,
+        max_consecutive_skips: int = 10,
+        fault_injector=None,
+        preemption_signal=None,
     ):
         assert self.executor is not None, "call compile() first"
         x, y = _unwrap_loaders(x, y)
@@ -1200,6 +1211,29 @@ class FFModel:
         if n % bs != 0:
             print(f"[flexflow_tpu] warning: dropping {n % bs} tail samples "
                   f"(dataset {n} % batch {bs})")
+        if (checkpoint_dir is not None or skip_nonfinite_steps
+                or step_guard is not None or fault_injector is not None
+                or preemption_signal is not None):
+            # resilient stepwise loop (runtime/resilience.py): periodic
+            # atomic checkpoints + mid-epoch resume, NaN/Inf step guard,
+            # preemption handling, deterministic fault injection
+            return self._fit_resilient(
+                xs, y, bs, ep, verbose,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every_n_steps=checkpoint_every_n_steps,
+                keep_last_n=keep_last_n, resume=resume,
+                skip_nonfinite_steps=skip_nonfinite_steps,
+                step_guard=step_guard,
+                max_consecutive_skips=max_consecutive_skips,
+                fault_injector=fault_injector,
+                preemption_signal=preemption_signal,
+            )
+        # guard residue from a previous resilient fit would change the
+        # step signature; drop it for the fast unguarded paths
+        if self.executor.step_guard is not None:
+            self.executor.set_step_guard(None)
+        if getattr(self.state, "guard", None) is not None:
+            self.state = dataclasses.replace(self.state, guard=None)
         step_fn = self.executor.build_train_step()
         in_pts = self.executor.input_pts
         if self.config.profiling:
@@ -1302,6 +1336,193 @@ class FFModel:
         )
         return self.perf_metrics
 
+    # ------------------------------------------------------------------
+    # resilient training loop (runtime/resilience.py)
+    # ------------------------------------------------------------------
+    def _rng_key_data(self) -> list:
+        """self._rng as a JSON-serializable list (checkpoint cursor)."""
+        try:
+            data = jax.random.key_data(self._rng)
+        except Exception:
+            data = self._rng
+        return np.asarray(data).tolist()
+
+    def _set_rng_from_key_data(self, data) -> None:
+        arr = jnp.asarray(np.asarray(data, np.uint32))
+        try:
+            if jnp.issubdtype(self._rng.dtype, jax.dtypes.prng_key):
+                arr = jax.random.wrap_key_data(arr)
+        except Exception:
+            pass
+        self._rng = arr
+
+    def _save_resilient_ckpt(self, manager, step, epoch, batch_index,
+                             done=False) -> str:
+        """Checkpoint + the data-loader cursor: `batch_index` is the NEXT
+        batch to run in `epoch`, and `rng` the key stream that batch will
+        split from, so a resumed run replays the exact step sequence."""
+        return manager.save(self, step, extra_meta={"train": {
+            "epoch": epoch,
+            "batch_index": batch_index,
+            "rng": self._rng_key_data(),
+            "done": done,
+        }})
+
+    def _fit_resilient(self, xs, y, bs, ep, verbose, *, checkpoint_dir,
+                       checkpoint_every_n_steps, keep_last_n, resume,
+                       skip_nonfinite_steps, step_guard,
+                       max_consecutive_skips, fault_injector,
+                       preemption_signal):
+        from ..runtime import resilience as rz
+
+        guard_cfg = step_guard
+        if guard_cfg is None and skip_nonfinite_steps:
+            guard_cfg = rz.StepGuardConfig(
+                max_consecutive_skips=max_consecutive_skips
+            )
+        self.executor.set_step_guard(guard_cfg)
+        if guard_cfg is not None and getattr(self.state, "guard", None) is None:
+            self.state = dataclasses.replace(
+                self.state, guard=self.executor.init_guard_state()
+            )
+        elif guard_cfg is None and getattr(self.state, "guard", None) is not None:
+            self.state = dataclasses.replace(self.state, guard=None)
+
+        n = xs[0].shape[0]
+        steps_per_epoch = n // bs
+        manager = None
+        if checkpoint_dir is not None:
+            manager = rz.CheckpointManager(
+                checkpoint_dir, keep_last_n=keep_last_n,
+                fault_injector=fault_injector,
+            )
+        every = checkpoint_every_n_steps or steps_per_epoch
+        preempt = preemption_signal or rz.PreemptionSignal()
+
+        step_fn = self.executor.build_train_step()
+        in_pts = self.executor.input_pts
+        label_dt = self.label_tensor.data_type.jnp_dtype
+        if jax.process_count() > 1:
+            self._assert_same_global_batch(xs, y, bs)
+
+        start_epoch, start_batch, global_step = 0, 0, 0
+        if manager is not None and resume:
+            info = manager.restore_latest(self)
+            if info is not None:
+                tm = (info.meta or {}).get("train", {})
+                start_epoch = int(tm.get("epoch", 0))
+                start_batch = int(tm.get("batch_index", 0))
+                if tm.get("rng") is not None:
+                    self._set_rng_from_key_data(tm["rng"])
+                global_step = info.step
+                if start_batch >= steps_per_epoch:
+                    start_epoch += 1
+                    start_batch = 0
+                if verbose:
+                    print(f"[resilience] resumed from step {info.step} "
+                          f"(epoch {start_epoch}, batch {start_batch})")
+
+        self.perf_metrics = PerfMetrics()
+        start = time.time()
+        num_samples = 0
+        epoch, bi = start_epoch, start_batch
+        try:
+            for epoch in range(start_epoch, ep):
+                self.perf_metrics = PerfMetrics()
+                device_partials = []
+                for bi, batch in enumerate(self._batches(list(xs) + [y], bs)):
+                    if epoch == start_epoch and bi < start_batch:
+                        continue
+                    # -- preemption check BETWEEN steps (SIGTERM-style) --
+                    if fault_injector is not None:
+                        plan = fault_injector.fire("preempt", global_step)
+                        if plan is not None:
+                            preempt.trigger(
+                                graceful=plan.get("graceful", True)
+                            )
+                    if preempt.triggered():
+                        raise rz.TrainingPreempted(
+                            f"preempted before step {global_step}",
+                            step=global_step, graceful=preempt.graceful,
+                        )
+                    bx = [
+                        self.executor.shard_batch(
+                            pt, np.asarray(a, pt.data_type.np_dtype)
+                        )
+                        for pt, a in zip(in_pts, batch[:-1])
+                    ]
+                    by = self.executor.put_replicated(
+                        np.asarray(batch[-1]).astype(label_dt)
+                    )
+                    self._rng, sub = jax.random.split(self._rng)
+                    args = [self.state, bx, by,
+                            self.executor.put_replicated(sub)]
+                    if guard_cfg is not None:
+                        poison = 1.0
+                        if fault_injector is not None and \
+                                fault_injector.fire("nan_grads", global_step):
+                            poison = float("nan")
+                        args.append(self.executor.put_replicated(
+                            jnp.asarray(poison, jnp.float32)
+                        ))
+                    self.state, partials = step_fn(*args)
+                    device_partials.append(partials)
+                    num_samples += bs
+                    global_step += 1
+                    if guard_cfg is not None:
+                        # skip monitor: a run stuck on non-finite grads
+                        # must fail loudly, not silently stop learning
+                        skips = int(_fetch_global(
+                            self.state.guard.consecutive_skips
+                        ))
+                        if skips >= guard_cfg.max_consecutive_skips:
+                            raise rz.NonFiniteGradientsError(
+                                f"{skips} consecutive non-finite gradient "
+                                f"steps (step {global_step}); loss_scale="
+                                f"{float(_fetch_global(self.state.guard.loss_scale)):g}"
+                            )
+                    if manager is not None and global_step % every == 0:
+                        self._save_resilient_ckpt(
+                            manager, global_step, epoch, bi + 1
+                        )
+                if device_partials:
+                    folded = jax.tree_util.tree_map(
+                        lambda *vs: sum(
+                            float(np.sum(_fetch_global(v))) for v in vs
+                        ),
+                        *device_partials,
+                    )
+                    last_loss = float(
+                        _fetch_global(device_partials[-1]["loss"]).ravel()[-1]
+                    )
+                    folded.pop("loss", None)
+                    skipped = folded.pop("skipped", 0.0)
+                    folded.pop("grad_norm", None)
+                    self.perf_metrics.update(folded)
+                    if verbose:
+                        extra = (f" skipped_steps={int(skipped)}"
+                                 if skipped else "")
+                        print(f"epoch {epoch}: loss={last_loss:.4f} "
+                              + self.perf_metrics.report() + extra)
+        except rz.TrainingPreempted as e:
+            if manager is not None and e.graceful:
+                # SIGTERM grace period: flush a final checkpoint so the
+                # resumed run continues exactly where this one stopped
+                e.checkpoint_path = self._save_resilient_ckpt(
+                    manager, global_step, epoch, bi
+                )
+            raise
+        jax.block_until_ready(self.state.params)
+        if manager is not None:
+            self._save_resilient_ckpt(manager, global_step, ep, 0, done=True)
+        elapsed = time.time() - start
+        if num_samples:
+            print(
+                f"ELAPSED TIME = {elapsed:.4f}s, "
+                f"THROUGHPUT = {num_samples / elapsed:.2f} samples/s"
+            )
+        return self.perf_metrics
+
     def eval(self, x=None, y=None, batch_size: Optional[int] = None):
         assert self.executor is not None
         x, y = _unwrap_loaders(x, y)
@@ -1399,7 +1620,7 @@ class FFModel:
         net_state.update(getattr(self, "_pending_net_state", None) or {})
         self.state = TrainState(
             params=new_params, opt_state=new_opt, step=self.state.step + 1,
-            net_state=net_state,
+            net_state=net_state, guard=self.state.guard,
         )
         self._pending_grads = None
         self._pending_net_state = None

@@ -51,6 +51,19 @@ _REMAT_OPS = frozenset({OperatorType.OP_MULTIHEAD_ATTENTION})
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
+class GuardState:
+    """Device-resident step-guard counters (runtime/resilience.py
+    StepGuardConfig): dynamic loss scale + skip bookkeeping, advanced
+    inside the jitted train step so guarded training stays one dispatch."""
+
+    loss_scale: jax.Array        # f32 scalar
+    good_steps: jax.Array        # i32: consecutive finite steps (regrowth)
+    consecutive_skips: jax.Array  # i32: fit() hard-fails past the config max
+    total_skips: jax.Array       # i32: run-lifetime skipped steps
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
 class TrainState:
     """All device-resident state of a compiled model."""
 
@@ -61,6 +74,34 @@ class TrainState:
     # keyed op.name -> buffer name -> array
     net_state: Dict[str, Dict[str, jax.Array]] = dataclasses.field(
         default_factory=dict
+    )
+    # step-guard counters; None when the guard is off (the default)
+    guard: Optional[GuardState] = None
+
+
+def global_grad_norm(grads) -> jax.Array:
+    """L2 norm over every gradient leaf, accumulated in f32 (bf16 grads
+    would overflow the squares). NaN/Inf anywhere in any leaf surfaces
+    here as a non-finite norm — one scalar finiteness check covers the
+    whole gradient pytree."""
+    leaves = [g for g in jax.tree_util.tree_leaves(grads) if g is not None]
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    total = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    return jnp.sqrt(total)
+
+
+def _tree_select(pred, new, old):
+    """Leafwise where(pred, new, old) tolerating None leaves (SGD without
+    momentum keeps {"v": None}) — used to carry params/opt state through
+    unchanged on a skipped step."""
+    def sel(n, o):
+        if n is None or o is None:
+            return n if o is None else o
+        return jnp.where(pred, n, o)
+
+    return jax.tree_util.tree_map(
+        sel, new, old, is_leaf=lambda x: x is None
     )
 
 
@@ -130,6 +171,10 @@ class PCGExecutor:
         outs = graph.output_tensors()
         assert outs, "graph has no output tensor"
         self.logits_pt = outs[-1]
+        # NaN/Inf step guard (runtime/resilience.py StepGuardConfig);
+        # None = unguarded step (the default). Changing it invalidates
+        # the cached train step (set_step_guard).
+        self.step_guard = None
         self._train_step = None
         self._train_scan = None
         self._grad_step = None
@@ -383,6 +428,10 @@ class PCGExecutor:
                         arr = np.asarray(arr)
                     wd[name] = jax.device_put(arr, sharding)
                 params[op.name] = wd
+        # PM_MERGE substitutions rebuild weights fresh from initializer
+        # specs — running one after this point would discard trained
+        # values (search/substitution_loader.py asserts on this flag)
+        self.graph.weights_materialized = True
         return params
 
     def init_net_state(self) -> Dict[str, Dict[str, jax.Array]]:
@@ -579,8 +628,30 @@ class PCGExecutor:
             grads,
         )
 
+    def set_step_guard(self, cfg) -> None:
+        """Enable/disable the NaN/Inf step guard (a
+        resilience.StepGuardConfig or None). Invalidates the cached train
+        step when the config actually changes — the guard is traced into
+        the step program."""
+        if cfg != self.step_guard:
+            self.step_guard = cfg
+            self._train_step = None
+            self._train_scan = None
+
+    def init_guard_state(self) -> GuardState:
+        assert self.step_guard is not None, "set_step_guard() first"
+        cfg = self.step_guard
+        return GuardState(
+            loss_scale=jnp.asarray(cfg.init_loss_scale, jnp.float32),
+            good_steps=jnp.asarray(0, jnp.int32),
+            consecutive_skips=jnp.asarray(0, jnp.int32),
+            total_skips=jnp.asarray(0, jnp.int32),
+        )
+
     def _make_step(self):
-        def step(state: TrainState, batch_inputs, labels, rng):
+        guard = self.step_guard
+
+        def step(state: TrainState, batch_inputs, labels, rng, *extra):
             def loss_of(params):
                 aux: list = []
                 net_out: dict = {}
@@ -594,19 +665,85 @@ class PCGExecutor:
                     loss = loss + a
                 for r in self._reg_penalty(params):
                     loss = loss + r
-                return loss, (logits, net_out)
+                if guard is not None:
+                    # dynamic loss scaling: grads come out scaled and are
+                    # unscaled below; the reported loss stays unscaled
+                    return loss * state.guard.loss_scale, (loss, logits, net_out)
+                return loss, (loss, logits, net_out)
 
-            (loss, (logits, net_out)), grads = jax.value_and_grad(
+            (_, (loss, logits, net_out)), grads = jax.value_and_grad(
                 loss_of, has_aux=True
             )(state.params)
             grads = self._cast_grads(grads)
             new_net = dict(state.net_state)
             new_net.update(net_out)
-            new_params, new_opt = self.optimizer.update(
-                state.params, grads, state.opt_state
-            )
-            partials = self.metrics.compute(logits, labels)
-            partials["loss"] = loss
+            if guard is None:
+                new_params, new_opt = self.optimizer.update(
+                    state.params, grads, state.opt_state
+                )
+                new_guard = state.guard
+                partials = self.metrics.compute(logits, labels)
+                partials["loss"] = loss
+            else:
+                # -- NaN/Inf step guard (resilience.StepGuardConfig) ----
+                # fit()'s fault-injection seam: extra[0] is a grad poison
+                # multiplier (1.0 normally, NaN to simulate a bad batch)
+                poison = extra[0] if extra else jnp.asarray(1.0, jnp.float32)
+                inv = (poison / state.guard.loss_scale).astype(jnp.float32)
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype),
+                    grads,
+                )
+                gnorm = global_grad_norm(grads)
+                finite = jnp.isfinite(gnorm)
+                upd_params, upd_opt = self.optimizer.update(
+                    state.params, grads, state.opt_state
+                )
+                # a skipped step carries params AND opt state through
+                # unchanged — momentum/bias-correction must not advance
+                # on a discarded gradient
+                new_params = _tree_select(finite, upd_params, state.params)
+                new_opt = _tree_select(finite, upd_opt, state.opt_state)
+                g = state.guard
+                cap = jnp.asarray(
+                    guard.max_loss_scale
+                    if guard.max_loss_scale is not None
+                    else guard.init_loss_scale,
+                    jnp.float32,
+                )
+                good = jnp.where(finite, g.good_steps + 1, 0)
+                grow = finite & (good >= guard.growth_interval)
+                backed = jnp.maximum(
+                    g.loss_scale * guard.backoff_factor, guard.min_loss_scale
+                )
+                scale = jnp.where(
+                    finite,
+                    jnp.where(
+                        grow,
+                        jnp.minimum(g.loss_scale * guard.growth_factor, cap),
+                        g.loss_scale,
+                    ),
+                    backed,
+                )
+                new_guard = GuardState(
+                    loss_scale=scale,
+                    good_steps=jnp.where(grow, 0, good).astype(jnp.int32),
+                    consecutive_skips=jnp.where(
+                        finite, 0, g.consecutive_skips + 1
+                    ).astype(jnp.int32),
+                    total_skips=(
+                        g.total_skips + (1 - finite.astype(jnp.int32))
+                    ),
+                )
+                # skipped steps contribute nothing to epoch metrics (their
+                # logits/loss are NaN — summing would poison the epoch)
+                partials = self.metrics.compute(logits, labels)
+                partials["loss"] = loss
+                partials = jax.tree_util.tree_map(
+                    lambda v: jnp.where(finite, v, jnp.zeros_like(v)), partials
+                )
+                partials["skipped"] = 1.0 - finite.astype(jnp.float32)
+                partials["grad_norm"] = jnp.where(finite, gnorm, 0.0)
             if self.mesh is not None:
                 # pin metric partials replicated over the FULL mesh: under
                 # multi-host, XLA may otherwise place these tiny outputs on
@@ -616,9 +753,17 @@ class PCGExecutor:
                     k: jax.lax.with_sharding_constraint(v, rep)
                     for k, v in partials.items()
                 }
+                if guard is not None:
+                    # guard counters are fetched per-step by fit's skip
+                    # monitor — same multi-host placement concern
+                    new_guard = jax.tree_util.tree_map(
+                        lambda v: jax.lax.with_sharding_constraint(v, rep),
+                        new_guard,
+                    )
             return (
                 TrainState(params=new_params, opt_state=new_opt,
-                           step=state.step + 1, net_state=new_net),
+                           step=state.step + 1, net_state=new_net,
+                           guard=new_guard),
                 partials,
             )
 
@@ -641,6 +786,11 @@ class PCGExecutor:
         final state and per-step-stacked metric partials."""
         if self._train_scan is not None:
             return self._train_scan
+        assert self.step_guard is None, (
+            "the fused multi-step scan driver does not take the step "
+            "guard's per-step poison/skip monitoring; resilient fit() "
+            "dispatches stepwise (build_train_step)"
+        )
         step = self._make_step()
 
         def multi(state, stacked_inputs, stacked_labels, rngs):

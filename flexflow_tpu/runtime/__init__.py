@@ -1,8 +1,27 @@
 """Runtime services: checkpointing, recompile triggers, profiling,
 strategy IO (TPU-native equivalents of reference src/runtime/ services +
-the checkpoint upgrade SURVEY §5 calls for)."""
-from .checkpoint import restore_checkpoint, save_checkpoint  # noqa: F401
+the checkpoint upgrade SURVEY §5 calls for), and the fault-tolerance
+layer (resilience: preemption-safe checkpointing, step guards,
+retry/backoff, fault injection)."""
+from .checkpoint import (  # noqa: F401
+    load_checkpoint_meta,
+    restore_checkpoint,
+    save_checkpoint,
+)
 from .recompile import RecompileState, recompile_on_condition  # noqa: F401
+from .resilience import (  # noqa: F401
+    CheckpointManager,
+    FaultInjector,
+    InferenceTimeout,
+    NonFiniteGradientsError,
+    PreemptionSignal,
+    ResilienceError,
+    RetryPolicy,
+    StepGuardConfig,
+    TrainingPreempted,
+    restore_latest,
+    retry,
+)
 from .strategy_io import (  # noqa: F401
     apply_imported_strategy,
     export_strategy,

@@ -10,9 +10,11 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 
@@ -22,8 +24,17 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
-def save_checkpoint(model, path: str, *, step: Optional[int] = None) -> str:
-    """Save a model's full training state. `model` is a compiled FFModel."""
+def save_checkpoint(model, path: str, *, step: Optional[int] = None,
+                    extra_meta: Optional[dict] = None,
+                    _pre_rename_hook=None) -> str:
+    """Save a model's full training state. `model` is a compiled FFModel.
+
+    Atomic: the state tree and its meta sidecar are written under tmp
+    names and renamed into place last, so a crash (or an injected IOError
+    — `_pre_rename_hook` is the resilience test seam, called after the
+    tmp write and before the rename) never leaves a partial checkpoint at
+    `path`; the half-written tmp is cleaned up on the way out.
+    `extra_meta` (e.g. fit's data-loader cursor) rides in the sidecar."""
     assert model.state is not None, "model not compiled"
     path = os.path.abspath(path)
     state = {
@@ -35,25 +46,71 @@ def save_checkpoint(model, path: str, *, step: Optional[int] = None) -> str:
         # cross-batch buffers (BN running stats, Cache) are part of the
         # trained state — dropping them silently reverts eval behavior
         state["net_state"] = model.state.net_state
-    _checkpointer().save(path, state, force=True)
+    guard = getattr(model.state, "guard", None)
+    if guard is not None:
+        # loss-scale / skip counters survive restarts, or a resumed run
+        # would re-probe the scale it already backed off
+        state["guard"] = {
+            "loss_scale": np.asarray(guard.loss_scale),
+            "good_steps": np.asarray(guard.good_steps),
+            "consecutive_skips": np.asarray(guard.consecutive_skips),
+            "total_skips": np.asarray(guard.total_skips),
+        }
     # sidecar metadata for topology validation on restore
     meta = {
-        "version": 1,
+        "version": 2,
         "ops": [
             {"name": op.name, "op_type": op.op_type.name}
             for op in model.graph.topo_order()
         ],
     }
-    with open(path + ".meta.json", "w") as f:
-        json.dump(meta, f)
+    if extra_meta:
+        meta.update(extra_meta)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    tmp_meta = tmp + ".meta.json"
+    try:
+        _checkpointer().save(tmp, state, force=True)
+        with open(tmp_meta, "w") as f:
+            json.dump(meta, f)
+        if _pre_rename_hook is not None:
+            _pre_rename_hook()
+        # swap in: unique-per-step manager paths never pre-exist; direct
+        # overwrites move the old version aside so readers never see a
+        # mix of the two
+        old = None
+        if os.path.isdir(path):
+            old = f"{path}.tmp-old-{os.getpid()}"
+            os.rename(path, old)
+        os.rename(tmp, path)
+        os.replace(tmp_meta, path + ".meta.json")
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        if os.path.exists(tmp_meta):
+            try:
+                os.remove(tmp_meta)
+            except OSError:
+                pass
+        raise
     return path
+
+
+def load_checkpoint_meta(path: str) -> Optional[dict]:
+    """The checkpoint's sidecar metadata (topology + any extra_meta the
+    writer attached, e.g. fit's resume cursor), or None when absent."""
+    meta_path = os.path.abspath(path) + ".meta.json"
+    if not os.path.exists(meta_path):
+        return None
+    with open(meta_path) as f:
+        return json.load(f)
 
 
 def restore_checkpoint(model, path: str) -> int:
     """Restore params/opt_state into a compiled FFModel. Returns the step.
     Arrays are device_put with the model's current shardings (so a
     checkpoint taken on one mesh restores onto another)."""
-    from ..parallel.executor import TrainState
+    from ..parallel.executor import GuardState, TrainState
 
     assert model.state is not None, "compile() the model before restoring"
     path = os.path.abspath(path)
@@ -95,8 +152,25 @@ def restore_checkpoint(model, path: str) -> int:
                 else old
                 for name, old in bufs.items()
             }
+    saved_guard = restored.get("guard")
+    guard = getattr(model.state, "guard", None)
+    if saved_guard is not None:
+        guard = GuardState(
+            loss_scale=jnp.asarray(
+                np.asarray(saved_guard["loss_scale"]), jnp.float32
+            ),
+            good_steps=jnp.asarray(
+                np.asarray(saved_guard["good_steps"]), jnp.int32
+            ),
+            consecutive_skips=jnp.asarray(
+                np.asarray(saved_guard["consecutive_skips"]), jnp.int32
+            ),
+            total_skips=jnp.asarray(
+                np.asarray(saved_guard["total_skips"]), jnp.int32
+            ),
+        )
     model.state = TrainState(params=new_params, opt_state=opt_state,
-                             step=step, net_state=net_state)
+                             step=step, net_state=net_state, guard=guard)
     return step
 
 

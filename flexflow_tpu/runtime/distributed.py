@@ -33,14 +33,23 @@ def init_distributed(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     local_device_ids=None,
+    retry_policy=None,
 ) -> tuple:
     """Join (or start) the multi-host runtime. Call before creating any
     FFModel/mesh. Returns (process_id, num_processes, global_devices).
 
     On TPU pods all three args auto-detect (jax reads the TPU metadata);
     on CPU/GPU clusters pass them or export FF_* (SLURM/OpenMPI envs also
-    auto-detect inside jax). Idempotent."""
+    auto-detect inside jax). Idempotent.
+
+    The coordinator connection is retried with exponential backoff
+    (runtime/resilience.py): after a preemption the restarted workers
+    race the coordinator pod coming back — first-connect failures are
+    expected, not fatal. Tune with `retry_policy` or
+    FF_INIT_MAX_ATTEMPTS / FF_INIT_BASE_DELAY_S."""
     import jax
+
+    from .resilience import RetryPolicy, retry
 
     global _initialized
     if _initialized:
@@ -63,7 +72,21 @@ def init_distributed(
         kw["process_id"] = process_id
     if local_device_ids is not None:
         kw["local_device_ids"] = local_device_ids
-    jax.distributed.initialize(**kw)
+    policy = retry_policy or RetryPolicy(
+        max_attempts=int(os.environ.get("FF_INIT_MAX_ATTEMPTS", "4")),
+        base_delay_s=float(os.environ.get("FF_INIT_BASE_DELAY_S", "1.0")),
+        max_delay_s=30.0,
+        # jax surfaces coordinator-unreachable as RuntimeError
+        retry_on=(RuntimeError, OSError, ConnectionError, TimeoutError),
+    )
+    retry(
+        lambda: jax.distributed.initialize(**kw),
+        policy,
+        on_retry=lambda attempt, e, d: print(
+            f"[flexflow_tpu] coordinator connect attempt {attempt + 1} "
+            f"failed ({e}); retrying in {d:.1f}s"
+        ),
+    )
     _initialized = True
     return (jax.process_index(), jax.process_count(), jax.devices())
 

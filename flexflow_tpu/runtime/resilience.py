@@ -1,0 +1,381 @@
+"""Fault-tolerant training/serving runtime.
+
+The reference FlexFlow leans on Legion's task runtime to survive stragglers
+and restarts; this TPU-native rebuild targets preemptible TPU pods where the
+failure modes are different and land on US to handle:
+
+  * **preemption** — the pod manager SIGTERMs the host between steps; the
+    run must resume from the last checkpoint and replay deterministically
+    (Megatron-LM-style periodic checkpoint/resume).
+  * **non-finite steps** — one NaN/Inf batch must not corrupt the params;
+    the step is skipped and the loss scale backed off (the mixed-precision
+    skip-and-rescale recipe), with a hard fail after N consecutive skips.
+  * **transient I/O / RPC failures** — checkpoint writes, coordinator
+    connections and serving requests get exponential-backoff retries.
+
+Everything here is CPU-testable: `FaultInjector` deterministically injects
+NaN gradients, checkpoint-write IOErrors and simulated preemption so tier-1
+exercises every path (tests/test_resilience.py, scripts/chaos_check.sh).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import re
+import shutil
+import signal
+import threading
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+# ----------------------------------------------------------------------
+# typed failures
+# ----------------------------------------------------------------------
+class ResilienceError(RuntimeError):
+    """Base class for runtime fault-tolerance failures."""
+
+
+class InferenceTimeout(ResilienceError, TimeoutError):
+    """A serving request was not answered within its deadline.
+
+    Subclasses TimeoutError so the default RetryPolicy retries it."""
+
+
+class NonFiniteGradientsError(ResilienceError):
+    """The step guard skipped `max_consecutive_skips` steps in a row —
+    the run is diverging (bad data / broken op), not a transient batch."""
+
+
+class TrainingPreempted(ResilienceError):
+    """fit() was interrupted between steps by a preemption signal.
+
+    `graceful` preemptions flushed a final checkpoint (checkpoint_path);
+    hard ones resume from the last periodic checkpoint and replay."""
+
+    def __init__(self, msg: str = "training preempted", *, step: int = 0,
+                 graceful: bool = True):
+        super().__init__(msg)
+        self.step = step
+        self.graceful = graceful
+        self.checkpoint_path: Optional[str] = None
+
+
+# ----------------------------------------------------------------------
+# retry / backoff
+# ----------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with jitter (the standard cloud-client recipe:
+    delay_k = min(max, base * multiplier**k), randomized by +/-jitter so
+    a fleet of preempted workers doesn't thundering-herd the coordinator)."""
+
+    max_attempts: int = 4
+    base_delay_s: float = 0.05
+    max_delay_s: float = 5.0
+    multiplier: float = 2.0
+    jitter: float = 0.25  # fraction of the delay, uniform +/-
+    retry_on: Tuple[type, ...] = (OSError, ConnectionError, TimeoutError)
+
+    def delay(self, attempt: int, rand: Callable[[], float] = random.random) -> float:
+        """Backoff before retry number `attempt` (0-based)."""
+        d = min(self.max_delay_s, self.base_delay_s * self.multiplier ** attempt)
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * rand() - 1.0)
+        return max(0.0, d)
+
+
+def retry(
+    fn: Callable[[], Any],
+    policy: Optional[RetryPolicy] = None,
+    *,
+    on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> Any:
+    """Call `fn()` under `policy`: exceptions in `policy.retry_on` are
+    retried with exponential backoff + jitter, anything else (and the
+    final exhausted attempt) propagates. `on_retry(attempt, exc, delay)`
+    observes each retry; `sleep` is injectable so tests run at full speed."""
+    policy = policy or RetryPolicy()
+    attempts = max(1, policy.max_attempts)
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except policy.retry_on as e:
+            if attempt == attempts - 1:
+                raise
+            d = policy.delay(attempt)
+            if on_retry is not None:
+                on_retry(attempt, e, d)
+            sleep(d)
+
+
+# ----------------------------------------------------------------------
+# step guard config (the executor owns the jitted guard math)
+# ----------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class StepGuardConfig:
+    """NaN/Inf step guard + dynamic loss scale, applied inside the jitted
+    train step (parallel/executor.py): a non-finite global grad norm skips
+    the optimizer update (params/opt state carried through unchanged) and
+    backs the loss scale off; `growth_interval` consecutive good steps grow
+    it back (capped at `max_loss_scale`, default = the initial scale, so
+    plain f32 runs keep scale 1.0 and only recover what backoff lost).
+    fit() hard-fails with NonFiniteGradientsError after
+    `max_consecutive_skips` skipped steps in a row."""
+
+    max_consecutive_skips: int = 10
+    init_loss_scale: float = 1.0
+    backoff_factor: float = 0.5
+    growth_factor: float = 2.0
+    growth_interval: int = 200
+    max_loss_scale: Optional[float] = None  # None -> init_loss_scale
+    min_loss_scale: float = 2.0 ** -16
+
+
+# ----------------------------------------------------------------------
+# preemption
+# ----------------------------------------------------------------------
+class PreemptionSignal:
+    """A between-steps stop flag. Real deployments arm it from SIGTERM
+    (install_sigterm_handler — what a preemptible TPU pod sends with a
+    grace period); the fault-injection harness arms it directly."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self.graceful = True
+        self._prev_handler = None
+
+    def trigger(self, graceful: bool = True) -> None:
+        self.graceful = graceful
+        self._event.set()
+
+    def triggered(self) -> bool:
+        return self._event.is_set()
+
+    def clear(self) -> None:
+        self._event.clear()
+        self.graceful = True
+
+    def install_sigterm_handler(self) -> bool:
+        """Arm on SIGTERM (graceful: the grace period is for the final
+        checkpoint flush). Returns False when not on the main thread,
+        where Python forbids signal handler installation."""
+        try:
+            self._prev_handler = signal.signal(
+                signal.SIGTERM, lambda signum, frame: self.trigger(graceful=True)
+            )
+            return True
+        except ValueError:  # not the main thread
+            return False
+
+    def uninstall(self) -> None:
+        if self._prev_handler is not None:
+            signal.signal(signal.SIGTERM, self._prev_handler)
+            self._prev_handler = None
+
+
+# ----------------------------------------------------------------------
+# fault injection
+# ----------------------------------------------------------------------
+class FaultInjector:
+    """Deterministic fault injection for chaos testing on CPU.
+
+    Sites consumed by the runtime:
+      * ``nan_grads``        — fit() poisons that step's gradients with NaN
+                               (exercises the step guard end-to-end).
+      * ``checkpoint_write`` — raised between the checkpoint's tmp write
+                               and its atomic rename (exercises retry and
+                               the no-partial-checkpoint guarantee).
+      * ``preempt``          — arms the preemption flag between steps;
+                               ``graceful=False`` simulates a hard kill
+                               (no final checkpoint flush).
+      * ``serving_worker``   — raised inside BatchScheduler's worker loop
+                               (exercises the degraded unbatched fallback).
+
+    Each injection fires `times` times, optionally only at `at_step`.
+    `fire(site, step)` consumes one shot and raises `exc` when armed with
+    one, otherwise returns the plan dict (extras like graceful=False ride
+    along) or None when nothing applies."""
+
+    def __init__(self):
+        self._plans: Dict[str, List[dict]] = {}
+        self.fired: Dict[str, int] = {}
+
+    def inject(self, site: str, *, at_step: Optional[int] = None,
+               times: int = 1, exc: Optional[BaseException] = None,
+               **extra) -> "FaultInjector":
+        plan = {"at_step": at_step, "remaining": times, "exc": exc}
+        plan.update(extra)
+        self._plans.setdefault(site, []).append(plan)
+        return self
+
+    def fire(self, site: str, step: Optional[int] = None) -> Optional[dict]:
+        for plan in self._plans.get(site, []):
+            if plan["remaining"] <= 0:
+                continue
+            if plan["at_step"] is not None and step != plan["at_step"]:
+                continue
+            plan["remaining"] -= 1
+            self.fired[site] = self.fired.get(site, 0) + 1
+            if plan["exc"] is not None:
+                raise plan["exc"]
+            return plan
+        return None
+
+    def pending(self, site: str) -> int:
+        return sum(max(0, p["remaining"]) for p in self._plans.get(site, []))
+
+
+# ----------------------------------------------------------------------
+# checkpoint manager
+# ----------------------------------------------------------------------
+_STEP_DIR_RE = re.compile(r"^step_(\d+)$")
+_LATEST_FILE = "LATEST"
+
+
+@dataclasses.dataclass
+class RestoreResult:
+    step: int
+    path: str
+    meta: dict
+
+
+class CheckpointManager:
+    """Preemption-safe periodic checkpointing over runtime/checkpoint.py.
+
+    Layout: ``<dir>/step_<N>/`` (atomic: written to a tmp name and
+    renamed, so a checkpoint directory either exists complete or not at
+    all) + ``step_<N>.meta.json`` sidecar (topology + train cursor) +
+    ``LATEST`` pointer. Retention keeps the newest `keep_last_n`.
+    Writes are retried under `retry_policy`; `fault_injector` (site
+    ``checkpoint_write``) can make any write fail mid-flight for tests."""
+
+    def __init__(self, directory: str, *, keep_last_n: int = 3,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 fault_injector: Optional[FaultInjector] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.directory = os.path.abspath(directory)
+        self.keep_last_n = max(1, keep_last_n)
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.fault_injector = fault_injector
+        self._sleep = sleep
+        os.makedirs(self.directory, exist_ok=True)
+        self.clean_stale_tmp()
+
+    # -- paths ----------------------------------------------------------
+    def step_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:010d}")
+
+    def list_steps(self) -> List[int]:
+        """Complete checkpoints only (tmp names never match step_*)."""
+        steps = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in names:
+            m = _STEP_DIR_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.directory, name)):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        """The LATEST pointer when valid, else the newest step on disk."""
+        steps = self.list_steps()
+        try:
+            with open(os.path.join(self.directory, _LATEST_FILE)) as f:
+                s = int(f.read().strip())
+            if s in steps:
+                return s
+        except (OSError, ValueError):
+            pass
+        return steps[-1] if steps else None
+
+    def clean_stale_tmp(self) -> None:
+        """Drop half-written tmp dirs/files left by a kill mid-save."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            if ".tmp-" in name:
+                p = os.path.join(self.directory, name)
+                shutil.rmtree(p, ignore_errors=True)
+                if os.path.isfile(p):
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+
+    # -- save / restore -------------------------------------------------
+    def save(self, model, step: int, extra_meta: Optional[dict] = None) -> str:
+        """Atomically write `model`'s full training state as step `step`,
+        retrying transient I/O failures, then advance LATEST and GC."""
+        from .checkpoint import save_checkpoint
+
+        path = self.step_path(step)
+        hook = None
+        if self.fault_injector is not None:
+            hook = lambda: self.fault_injector.fire("checkpoint_write", step)  # noqa: E731
+
+        def _write():
+            return save_checkpoint(model, path, step=step,
+                                   extra_meta=extra_meta,
+                                   _pre_rename_hook=hook)
+
+        retry(_write, self.retry_policy, sleep=self._sleep)
+        self._write_latest(step)
+        self._gc()
+        return path
+
+    def restore_latest(self, model) -> Optional[RestoreResult]:
+        """Restore the newest loadable checkpoint (a corrupt newest one —
+        e.g. truncated by a crash landing exactly mid-rename — falls back
+        to the next older). Returns None when the directory has none."""
+        from .checkpoint import load_checkpoint_meta, restore_checkpoint
+
+        latest = self.latest_step()
+        if latest is None:
+            return None
+        candidates = [latest] + [s for s in reversed(self.list_steps())
+                                 if s != latest]
+        for s in candidates:
+            path = self.step_path(s)
+            try:
+                step = restore_checkpoint(model, path)
+                meta = load_checkpoint_meta(path) or {}
+                return RestoreResult(step=step, path=path, meta=meta)
+            except Exception as e:  # corrupt/partial — try the next older
+                warnings.warn(
+                    f"checkpoint {path} failed to restore ({e!r}); "
+                    "falling back to an older checkpoint"
+                )
+        return None
+
+    # -- internals ------------------------------------------------------
+    def _write_latest(self, step: int) -> None:
+        p = os.path.join(self.directory, _LATEST_FILE)
+        tmp = f"{p}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(str(step))
+        os.replace(tmp, p)
+
+    def _gc(self) -> None:
+        steps = self.list_steps()
+        for s in steps[: -self.keep_last_n]:
+            path = self.step_path(s)
+            shutil.rmtree(path, ignore_errors=True)
+            try:
+                os.remove(path + ".meta.json")
+            except OSError:
+                pass
+
+
+def restore_latest(model, directory: str) -> Optional[RestoreResult]:
+    """Restore the newest loadable checkpoint under `directory` into a
+    compiled model. Convenience wrapper over CheckpointManager."""
+    return CheckpointManager(directory).restore_latest(model)

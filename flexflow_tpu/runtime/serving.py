@@ -431,6 +431,7 @@ class InferenceRequest:
         self.inputs = inputs
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
 
 
 class BatchScheduler:
@@ -438,19 +439,35 @@ class BatchScheduler:
     per-request execution, re-thought as a batch queue).
 
     `max_delay_s`: how long to wait to fill a batch before running partial.
-    """
 
-    def __init__(self, model, *, max_delay_s: float = 0.005):
+    Fault tolerance (runtime/resilience.py): `infer` raises a typed
+    InferenceTimeout (retried under `retry_policy`) instead of asserting,
+    and when the worker thread has died — crashed on a batch, or never
+    started — falls back to DEGRADED mode, running the request unbatched
+    on the caller's thread so the service keeps answering (slower, but
+    up) while the operator restarts the scheduler. `fault_injector` site
+    ``serving_worker`` kills the worker deterministically in tests."""
+
+    def __init__(self, model, *, max_delay_s: float = 0.005,
+                 retry_policy=None, fault_injector=None):
         assert model.executor is not None, "compile() the model first"
+        from .resilience import RetryPolicy
+
         self.model = model
         self.batch_size = model.executor.input_pts[0].material_shape()[0]
         self.max_delay_s = max_delay_s
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=2, base_delay_s=0.01, max_delay_s=0.5
+        )
+        self.fault_injector = fault_injector
         self._q: "queue.Queue[InferenceRequest]" = queue.Queue()
         self._fwd = model.executor.build_forward()
         self._stop = threading.Event()
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._started = False
-        self.stats = {"requests": 0, "batches": 0, "padded_slots": 0}
+        self._worker_error: Optional[BaseException] = None
+        self.stats = {"requests": 0, "batches": 0, "padded_slots": 0,
+                      "degraded": 0, "timeouts": 0}
 
     # -- client API ------------------------------------------------------
     def start(self):
@@ -464,6 +481,10 @@ class BatchScheduler:
         if self._started:
             self._worker.join(timeout=5)
 
+    def worker_alive(self) -> bool:
+        return (self._started and self._worker.is_alive()
+                and self._worker_error is None)
+
     def submit(self, inputs: List[np.ndarray]) -> InferenceRequest:
         """Each request carries ONE sample per model input (no batch dim)."""
         req = InferenceRequest([np.asarray(a) for a in inputs])
@@ -471,9 +492,47 @@ class BatchScheduler:
         return req
 
     def infer(self, inputs: List[np.ndarray], timeout: float = 30.0) -> np.ndarray:
-        req = self.submit(inputs)
-        assert req.event.wait(timeout), "inference timed out"
-        return req.result
+        """Blocking single-sample inference. Timeouts raise
+        InferenceTimeout and are retried per `self.retry_policy`; a dead
+        worker degrades to direct unbatched execution instead of hanging
+        every caller until restart."""
+        from .resilience import InferenceTimeout, retry
+
+        def attempt():
+            if not self.worker_alive():
+                return self._infer_direct(inputs)
+            req = self.submit(inputs)
+            if not req.event.wait(timeout):
+                self.stats["timeouts"] += 1
+                if not self.worker_alive():
+                    # died while we waited — the request will never be
+                    # answered from the queue
+                    return self._infer_direct(inputs)
+                raise InferenceTimeout(
+                    f"request {req.id} unanswered after {timeout}s "
+                    f"(queue depth {self._q.qsize()})"
+                )
+            if req.error is not None:
+                # the worker failed ON this batch; answer from the
+                # degraded path rather than bubbling its crash to callers
+                return self._infer_direct(inputs)
+            return req.result
+
+        return retry(attempt, self.retry_policy)
+
+    def _infer_direct(self, inputs: List[np.ndarray]) -> np.ndarray:
+        """DEGRADED mode: run one request on the caller's thread, padded
+        to the compiled batch (same jitted executable, no queue)."""
+        self.stats["degraded"] += 1
+        arrays = [
+            jnp.asarray(np.broadcast_to(
+                np.asarray(a)[None], (self.batch_size,) + np.asarray(a).shape
+            ))
+            for a in inputs
+        ]
+        out = np.asarray(self._fwd(self.model.state.params, arrays,
+                                   self.model.state.net_state))
+        return out[0]
 
     # -- batching loop ---------------------------------------------------
     def _loop(self):
@@ -495,14 +554,27 @@ class BatchScheduler:
                     batch.append(self._q.get(timeout=remaining))
                 except queue.Empty:
                     break
-            pad = self.batch_size - len(batch)
-            arrays = []
-            for i in range(n_inputs):
-                rows = [r.inputs[i] for r in batch]
-                stacked = np.stack(rows + [rows[-1]] * pad, axis=0)
-                arrays.append(jnp.asarray(stacked))
-            out = np.asarray(self._fwd(self.model.state.params, arrays,
-                                       self.model.state.net_state))
+            try:
+                if self.fault_injector is not None:
+                    self.fault_injector.fire("serving_worker",
+                                             self.stats["batches"])
+                pad = self.batch_size - len(batch)
+                arrays = []
+                for i in range(n_inputs):
+                    rows = [r.inputs[i] for r in batch]
+                    stacked = np.stack(rows + [rows[-1]] * pad, axis=0)
+                    arrays.append(jnp.asarray(stacked))
+                out = np.asarray(self._fwd(self.model.state.params, arrays,
+                                           self.model.state.net_state))
+            except BaseException as e:
+                # worker is no longer trustworthy: fail the in-flight
+                # requests (their callers re-run degraded) and exit so
+                # worker_alive() routes future traffic around the queue
+                self._worker_error = e
+                for r in batch:
+                    r.error = e
+                    r.event.set()
+                return
             for j, r in enumerate(batch):
                 r.result = out[j]
                 r.event.set()

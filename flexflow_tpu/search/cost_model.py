@@ -126,13 +126,22 @@ def op_padded_flops(op: PCGOp, parts: int = 1) -> float:
     if t == OperatorType.OP_BATCHMATMUL and len(op.inputs) == 2:
         sa = _shard_shape(op.inputs[0])
         sb = _shard_shape(op.inputs[1])
-        return 2.0 * _pad(_vol(sa[:-1]), 8) * _pad(sa[-1], 128) * _pad(sb[-1], 128)
+        # each batch element is a SEPARATE MXU gemm, so the 8-row sublane
+        # padding applies per batch element (exactly like the MHA branch's
+        # bq*h*_pad(sq,8) below), not once to the flattened batch*rows
+        # product — flattening under-priced small-rows batched matmuls
+        return 2.0 * _vol(sa[:-2]) * _pad(sa[-2], 8) * _pad(sa[-1], 128) \
+            * _pad(sb[-1], 128)
     if t == OperatorType.OP_MULTIHEAD_ATTENTION and len(op.inputs) == 3:
         q, k = op.inputs[0], op.inputs[1]
         p = op.params
         bq = _shard_shape(q)[0]
-        sq, eq = q.dims[1].size, q.dims[2].size
-        sk = k.dims[1].size
+        # seq/embed from the material (non-replica) dims, as op_flops
+        # does — a leading replica dim on q/k would shift raw indices
+        qm = [d.size for d in q.dims if not d.is_replica_dim]
+        km = [d.size for d in k.dims if not d.is_replica_dim]
+        sq, eq = qm[1], qm[2]
+        sk = km[1]
         # head-sharded MHA (weight-only degrees) keeps its full-h price —
         # the DP grants it single-part views, so charging one shard here
         # would let a TP candidate undercut without paying its devices

@@ -10,6 +10,7 @@ lambda until the best strategy fits the per-chip HBM budget
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Dict, Optional, Tuple
 
 from ..pcg.graph import Graph
@@ -47,7 +48,20 @@ def weight_bytes_multiplier(
         # A third-party optimizer without the hook gets the base
         # Optimizer default (0 slots) rather than a guessed 1 — guessing
         # over-charges a stateless optimizer a full weight-sized slot
-        # and under-charges an Adam-like one either way.
+        # and under-charges an Adam-like one either way. The 0 default is
+        # NOT fail-safe for Adam-likes (2 uncounted weight-sized slots =
+        # strategies admitted that OOM at runtime), so make the silent
+        # under-accounting loud.
+        if get is None:
+            warnings.warn(
+                f"optimizer {type(optimizer).__name__!r} does not report "
+                "state_slots_per_weight(); assuming 0 optimizer state "
+                "slots — per-chip HBM may be under-accounted and the "
+                "memory search may admit strategies that OOM. Add a "
+                "state_slots_per_weight() method returning the number of "
+                "weight-sized state buffers (SGD-momentum 1, Adam 2).",
+                stacklevel=2,
+            )
         slots = get() if get is not None else 0
     return 1.0 + grad_bytes_ratio + slots
 

@@ -359,6 +359,27 @@ def apply_rule(graph: Graph, rule: Rule) -> Iterator[Graph]:
                         if p is None:
                             raise KeyError(f"merge needs {n} {dpat.op_type}")
                         parts.append((p, o))
+                    # merged kernels rebuild weights fresh from initializer
+                    # specs: firing on an already-materialized graph would
+                    # silently discard trained values — hard error, not a
+                    # skipped site (see executor.init_params)
+                    if getattr(g2, "weights_materialized", False) or \
+                            getattr(graph, "weights_materialized", False):
+                        raise MergeAfterMaterializationError(
+                            "PM_MERGE rule applied to a graph whose weights "
+                            "were already materialized; merge substitutions "
+                            "must run pre-materialization (before "
+                            "executor.init_params)"
+                        )
+                    # _attach_fresh_weights inherits initializer kinds from
+                    # the FIRST source op only; if the sources disagree
+                    # (e.g. zeros- vs glorot-init bias) the merged init
+                    # would mis-initialize the second slice — reject
+                    if any(_init_kinds(o) != _init_kinds(parts[0][1])
+                           for _, o in parts[1:]):
+                        raise ValueError(
+                            "merge: source ops' initializer kinds differ"
+                        )
                     base = dataclasses.replace(parts[0][0], out_channels=0)
                     if any(dataclasses.replace(p, out_channels=0) != base
                            for p, _ in parts[1:]):
@@ -425,6 +446,8 @@ def apply_rule(graph: Graph, rule: Rule) -> Iterator[Graph]:
                             "divisible, unsharded head-tagged weight dim"
                         )
                 new_ops.append(nop)
+        except MergeAfterMaterializationError:
+            raise  # a caller bug, not an inapplicable site — surface it
         except Exception:
             continue  # rule not applicable at this site
 
@@ -449,6 +472,23 @@ def apply_rule(graph: Graph, rule: Rule) -> Iterator[Graph]:
         g2._producer_cache = None
         if g2.check_correctness():
             yield g2
+
+
+class MergeAfterMaterializationError(AssertionError):
+    """A PM_MERGE substitution fired on a graph whose weights were already
+    materialized (executor.init_params sets graph.weights_materialized) —
+    the merged op's fresh-built weights would discard trained values."""
+
+
+def _init_kinds(op: Optional[PCGOp]) -> dict:
+    """Initializer KIND per weight name (string spec or initializer class
+    name) — merge compatibility is about the kind, not the instance."""
+    if op is None:
+        return {}
+    return {
+        name: (v if isinstance(v, str) else type(v).__name__)
+        for name, v in getattr(op, "initializers", {}).items()
+    }
 
 
 def _attach_fresh_weights(op: PCGOp, init_src: Optional[PCGOp]) -> None:

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Standalone fault-injection suite (ISSUE 1 satellite): runs ALL of
+# tests/test_resilience.py — including the @pytest.mark.slow chaos sweep
+# that tier-1 skips — on the CPU mesh. Use before touching the
+# checkpoint/resume, step-guard, retry or serving-fallback paths:
+#
+#   scripts/chaos_check.sh            # whole resilience suite
+#   scripts/chaos_check.sh -k preempt # just the preemption cases
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
+    -v -p no:cacheprovider "$@"

@@ -44,7 +44,12 @@ try:
 except Exception:
     pass
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax: the --xla_force_host_platform_device_count XLA_FLAGS
+    # exported above provides the 8-device CPU mesh instead
+    pass
 jax.config.update(
     "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
 )

@@ -305,3 +305,47 @@ def test_column_parallel_matmul_rule_beats_programmatic_xfers():
     both = best(generate_all_pcg_xfers(degrees, FFConfig())
                 + rules_to_substitutions(rules))
     assert both < prog * 0.75, (prog, both)
+
+
+def _two_parallel_linears_graph():
+    model = FFModel(FFConfig())
+    x = model.create_tensor((64, 32), DataType.DT_FLOAT)
+    a = model.dense(x, 12)
+    b = model.dense(x, 12)
+    model.add(a, b)
+    graph, _ = layers_to_pcg(model.layers)
+    return graph
+
+
+def test_merge_rule_asserts_pre_materialization():
+    """PM_MERGE rebuilds weights fresh from initializer specs; firing on a
+    materialized graph would silently discard trained values — hard error
+    (ADVICE: merge rules must only run pre-materialization)."""
+    from flexflow_tpu.search.substitution_loader import (
+        MergeAfterMaterializationError,
+        default_rules_path,
+    )
+
+    graph = _two_parallel_linears_graph()
+    rules = load_rule_collection_from_path(default_rules_path())
+    merge = next(r for r in rules if r.name == "merge_parallel_linears")
+    assert list(apply_rule(graph, merge))  # pre-materialization: applies
+    graph.weights_materialized = True  # what executor.init_params sets
+    with pytest.raises(MergeAfterMaterializationError):
+        list(apply_rule(graph, merge))
+
+
+def test_merge_rule_rejects_differing_initializer_kinds():
+    """Merged weights inherit the FIRST source op's initializer kinds; when
+    the sources disagree the merge would mis-initialize the second slice,
+    so the rule must not fire at that site (ADVICE finding)."""
+    from flexflow_tpu.search.substitution_loader import default_rules_path
+
+    graph = _two_parallel_linears_graph()
+    rules = load_rule_collection_from_path(default_rules_path())
+    merge = next(r for r in rules if r.name == "merge_parallel_linears")
+    linears = [o for o in graph.ops if o.op_type == OperatorType.OP_LINEAR]
+    assert len(linears) == 2
+    linears[1].initializers = dict(linears[1].initializers)
+    linears[1].initializers["kernel"] = "zeros"
+    assert list(apply_rule(graph, merge)) == []
