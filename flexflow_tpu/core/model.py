@@ -855,7 +855,13 @@ class FFModel:
         from ..search import CostModel, MachineModel, parse_machine_config
 
         cfg = self.config
-        if cfg.machine_model_file:
+        override = getattr(self, "_machine_override", None)
+        if override is not None:
+            # recompile_for_topology re-targeted a machine description at
+            # the live device count (elastic resume); it wins over the
+            # stale file/config topology
+            machine = override
+        elif cfg.machine_model_file:
             machine = parse_machine_config(cfg.machine_model_file)
         else:
             nodes = (cfg.search_num_nodes if cfg.search_num_nodes > 0
@@ -1019,6 +1025,52 @@ class FFModel:
         charging them (2-4x weight bytes under Adam) would wrongly
         reject strategies that fit inference HBM comfortably."""
         return self.comp_mode == CompMode.COMP_MODE_TRAINING
+
+    def recompile_for_topology(self, num_devices: Optional[int] = None) -> None:
+        """Re-plan the compiled model for the CURRENT device topology
+        (runtime/elastic.py): point the machine description at
+        `num_devices` (default: every live device), then re-run compile()
+        — which re-runs the strategy search / manual lowering for the new
+        machine, rebuilds the mesh + executor and re-initializes state.
+        Weights do NOT carry over; restore from a checkpoint afterwards
+        (restore_elastic / fit(elastic=True))."""
+        assert self.loss_type is not None, (
+            "compile() the model once before recompile_for_topology"
+        )
+        from ..search import for_device_count, parse_machine_config
+
+        n = num_devices if num_devices is not None else len(jax.devices())
+        cfg = self.config
+        # hypothetical-machine overrides would pin the search to the OLD
+        # topology; the whole point here is planning for the live one
+        cfg.search_num_nodes = -1
+        cfg.search_num_workers = -1
+        override = getattr(self, "_machine_override", None)
+        if cfg.machine_model_file:
+            # the file describes the machine we LOST; keep its per-chip and
+            # link constants (the hardware kind didn't change) but re-point
+            # the topology at the surviving device count
+            base = parse_machine_config(cfg.machine_model_file)
+            self._machine_override = for_device_count(n, like=base)
+            cfg.machine_model_file = ""
+        elif override is not None:
+            # a previous elastic recompile already lifted the file into an
+            # override; re-target it again for this topology change
+            self._machine_override = for_device_count(n, like=override)
+        else:
+            from ..search import MachineModel
+
+            m = for_device_count(n, like=MachineModel(
+                num_nodes=cfg.numNodes, workers_per_node=cfg.workersPerNode,
+            ))
+            cfg.numNodes = m.num_nodes
+            cfg.workersPerNode = m.workers_per_node
+        self.compile(
+            optimizer=self.optimizer,
+            loss_type=self.loss_type,
+            metrics=self.metrics_obj.measures if self.metrics_obj else (),
+            comp_mode=self.comp_mode,
+        )
 
     def _search_pipeline_degree(self, cost_model, result, ndev,
                                 mem_budget, res=None, xfers=None):
@@ -1197,6 +1249,8 @@ class FFModel:
         max_consecutive_skips: int = 10,
         fault_injector=None,
         preemption_signal=None,
+        elastic: bool = False,
+        health_monitor=None,
     ):
         assert self.executor is not None, "call compile() first"
         x, y = _unwrap_loaders(x, y)
@@ -1213,10 +1267,14 @@ class FFModel:
                   f"(dataset {n} % batch {bs})")
         if (checkpoint_dir is not None or skip_nonfinite_steps
                 or step_guard is not None or fault_injector is not None
-                or preemption_signal is not None):
+                or preemption_signal is not None or elastic
+                or health_monitor is not None):
             # resilient stepwise loop (runtime/resilience.py): periodic
             # atomic checkpoints + mid-epoch resume, NaN/Inf step guard,
-            # preemption handling, deterministic fault injection
+            # preemption handling, deterministic fault injection; with
+            # elastic/health_monitor, the elastic runtime's topology-
+            # change resume and hang watchdog ride along
+            # (runtime/elastic.py)
             return self._fit_resilient(
                 xs, y, bs, ep, verbose,
                 checkpoint_dir=checkpoint_dir,
@@ -1227,6 +1285,8 @@ class FFModel:
                 max_consecutive_skips=max_consecutive_skips,
                 fault_injector=fault_injector,
                 preemption_signal=preemption_signal,
+                elastic=elastic,
+                health_monitor=health_monitor,
             )
         # guard residue from a previous resilient fit would change the
         # step signature; drop it for the fast unguarded paths
@@ -1372,8 +1432,20 @@ class FFModel:
                        checkpoint_every_n_steps, keep_last_n, resume,
                        skip_nonfinite_steps, step_guard,
                        max_consecutive_skips, fault_injector,
-                       preemption_signal):
+                       preemption_signal, elastic=False,
+                       health_monitor=None):
         from ..runtime import resilience as rz
+
+        if elastic and not self.executor.mesh_is_live():
+            # a host (and its devices) disappeared since compile(): any
+            # dispatch onto the stale mesh would wedge. Re-search the
+            # strategy for the surviving machine and recompile; the
+            # checkpoint restore below reshards the weights onto it.
+            n = len(jax.devices())
+            if verbose:
+                print(f"[elastic] device topology changed; re-searching "
+                      f"strategy for {n} device(s) and recompiling")
+            self.recompile_for_topology(n)
 
         guard_cfg = step_guard
         if guard_cfg is None and skip_nonfinite_steps:
@@ -1398,6 +1470,9 @@ class FFModel:
             )
         every = checkpoint_every_n_steps or steps_per_epoch
         preempt = preemption_signal or rz.PreemptionSignal()
+        mon = health_monitor
+        if mon is not None:
+            mon.start()
 
         step_fn = self.executor.build_train_step()
         in_pts = self.executor.input_pts
@@ -1407,7 +1482,23 @@ class FFModel:
 
         start_epoch, start_batch, global_step = 0, 0, 0
         if manager is not None and resume:
-            info = manager.restore_latest(self)
+            info = manager.restore_latest(self, elastic=elastic)
+            if info is not None and elastic:
+                from ..runtime.elastic import (
+                    topology_fingerprint,
+                    topology_matches,
+                )
+
+                saved_topo = (info.meta or {}).get("topology")
+                live_topo = topology_fingerprint(self.executor.mesh)
+                if not topology_matches(saved_topo, live_topo) and verbose:
+                    print(
+                        f"[elastic] resumed step {info.step} across a "
+                        f"topology change "
+                        f"({(saved_topo or {}).get('num_devices', '?')} -> "
+                        f"{live_topo['num_devices']} devices); strategy "
+                        "re-searched and parameters resharded"
+                    )
             if info is not None:
                 tm = (info.meta or {}).get("train", {})
                 start_epoch = int(tm.get("epoch", 0))
@@ -1445,6 +1536,37 @@ class FFModel:
                             f"preempted before step {global_step}",
                             step=global_step, graceful=preempt.graceful,
                         )
+                    if fault_injector is not None:
+                        plan = fault_injector.fire("host_loss", global_step)
+                        if plan is not None:
+                            # a host dropped out: flush-and-exit (the
+                            # TrainingPreempted handler below writes the
+                            # final checkpoint) so the orchestrator can
+                            # restart elastically on the survivors
+                            raise rz.HostLossError(
+                                f"host lost before step {global_step}",
+                                step=global_step,
+                                graceful=plan.get("graceful", True),
+                                surviving_devices=plan.get(
+                                    "surviving_devices"
+                                ),
+                            )
+                    if mon is not None:
+                        if (fault_injector is not None
+                                and fault_injector.fire("hung_step",
+                                                        global_step)):
+                            # simulated dead collective: blocks until the
+                            # watchdog detects the stall and releases us
+                            mon.simulate_hang()
+                        if mon.hang_detected:
+                            raise rz.CollectiveTimeout(
+                                "health watchdog: "
+                                f"{mon.hang_info.get('kind', 'hang')} "
+                                f"detected before step {global_step} "
+                                f"({mon.hang_info})",
+                                step=global_step, info=mon.hang_info,
+                            )
+                        mon.step_started(global_step)
                     bx = [
                         self.executor.shard_batch(
                             pt, np.asarray(a, pt.data_type.np_dtype)
@@ -1466,6 +1588,12 @@ class FFModel:
                             jnp.asarray(poison, jnp.float32)
                         ))
                     self.state, partials = step_fn(*args)
+                    if mon is not None:
+                        # the watchdog can only observe completion if we
+                        # wait for it — per-step sync is the price of
+                        # hang detection (documented in docs/resilience.md)
+                        jax.block_until_ready(partials["loss"])
+                        mon.step_finished(global_step)
                     device_partials.append(partials)
                     num_samples += bs
                     global_step += 1
@@ -1508,6 +1636,15 @@ class FFModel:
             if manager is not None and e.graceful:
                 # SIGTERM grace period: flush a final checkpoint so the
                 # resumed run continues exactly where this one stopped
+                e.checkpoint_path = self._save_resilient_ckpt(
+                    manager, global_step, epoch, bi
+                )
+            raise
+        except rz.CollectiveTimeout as e:
+            # checkpoint-and-raise: flush the last good state, then exit
+            # through the typed error so the orchestrator restarts
+            # elastically instead of leaving a deadlocked psum spinning
+            if manager is not None:
                 e.checkpoint_path = self._save_resilient_ckpt(
                     manager, global_step, epoch, bi
                 )

@@ -434,7 +434,9 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
     No reference equivalent (SURVEY §5: sequence parallelism absent
     there); the head-scatter recipe follows the public Ulysses pattern
     (PAPERS.md)."""
-    n = lax.axis_size(axis_name)
+    # psum of the literal 1 constant-folds to the axis size on every
+    # jax we support; lax.axis_size only exists on newer releases
+    n = getattr(lax, "axis_size", lambda a: lax.psum(1, a))(axis_name)
     h = q.shape[2]
     assert h % n == 0, f"heads {h} must divide the {axis_name} axis {n}"
     # (b, s/n, h, d) -> (b, s, h/n, d)
@@ -462,7 +464,9 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
     No reference equivalent — this is the TPU build's first-class CP
     (SURVEY §5 gap); the blockwise formulation follows the public
     ring-attention recipe (PAPERS.md)."""
-    n = lax.axis_size(axis_name)
+    # psum of the literal 1 constant-folds to the axis size on every
+    # jax we support; lax.axis_size only exists on newer releases
+    n = getattr(lax, "axis_size", lambda a: lax.psum(1, a))(axis_name)
     idx = lax.axis_index(axis_name)
     b, sq_local, h, d = q.shape
 

@@ -598,6 +598,19 @@ class PCGExecutor:
                 terms.append(0.5 * lam * jnp.sum(wf * wf))
         return terms
 
+    def mesh_is_live(self) -> bool:
+        """Whether every device this executor's mesh spans is still in
+        `jax.devices()`. False after a host loss / device shrink
+        (runtime/elastic.py) — any further dispatch onto the stale mesh
+        would hang or crash, so fit(elastic=True) recompiles the model
+        for the surviving topology (FFModel.recompile_for_topology)
+        before touching device state."""
+        try:
+            live = set(jax.devices())
+        except Exception:
+            return False
+        return all(d in live for d in self.mesh.devices.flat)
+
     def invalidate_step_cache(self, train_only: bool = False) -> None:
         """Drop cached jitted steps so the next build re-traces.
 
@@ -769,9 +782,23 @@ class PCGExecutor:
 
         return step
 
+    def _donate_state(self) -> tuple:
+        """donate_argnums for the train state: donate on accelerators,
+        where in-place buffer reuse halves peak weight/opt-state HBM —
+        but NOT on CPU. On the CPU backend, an executable deserialized
+        from the persistent compilation cache can lose the input/output
+        aliasing metadata for donated buffers (observed on jax 0.4.37:
+        the final state's buffers get reclaimed while still referenced,
+        and live `model.state` arrays read back garbage once a later
+        computation reuses the memory). CPU donation buys nothing —
+        host RAM is not the scarce resource — so the safe choice costs
+        nothing where it applies."""
+        return (0,) if jax.default_backend() != "cpu" else ()
+
     def build_train_step(self) -> Callable:
         if self._train_step is None:
-            self._train_step = jax.jit(self._make_step(), donate_argnums=(0,))
+            self._train_step = jax.jit(self._make_step(),
+                                       donate_argnums=self._donate_state())
         return self._train_step
 
     def build_train_scan(self) -> Callable:
@@ -804,7 +831,8 @@ class PCGExecutor:
             )
             return state, partials
 
-        self._train_scan = jax.jit(multi, donate_argnums=(0,))
+        self._train_scan = jax.jit(multi,
+                                   donate_argnums=self._donate_state())
         return self._train_scan
 
     def build_grad_step(self, seq_length: int = -1) -> Callable:

@@ -9,6 +9,7 @@ different mesh (orbax re-shards on load).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import shutil
 from typing import Optional
@@ -17,11 +18,55 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+logger = logging.getLogger("flexflow_tpu.runtime.checkpoint")
+
 
 def _checkpointer():
     import orbax.checkpoint as ocp
 
     return ocp.PyTreeCheckpointer()
+
+
+def _to_host(tree):
+    """Host-gather every fully-addressable device array to numpy before
+    the write, so the on-disk checkpoint carries no device-sharding
+    dependency — a checkpoint written on an 8-device mesh must stay
+    readable by a 4-device survivor (runtime/elastic.py), and orbax
+    refuses to restore a sharded array whose saved devices are gone.
+    Non-fully-addressable arrays (true multi-host shards) are left to
+    orbax's distributed save path.
+
+    copy=True is load-bearing: on CPU, np.asarray(jax_array) can be a
+    ZERO-COPY view of the device buffer, and the train step's
+    donate_argnums reuses that exact memory on the next step — a
+    checkpoint serialized from the view after training resumes would
+    contain the NEXT step's bytes (observed: mid-run saves corrupted
+    once the jit cache was warm enough for the race to land)."""
+    def conv(x):
+        if isinstance(x, jax.Array) and x.is_fully_addressable:
+            return np.array(x, copy=True)
+        return x
+
+    return jax.tree_util.tree_map(conv, tree)
+
+
+def _restore_to_host(path: str):
+    """Read a checkpoint into host numpy arrays regardless of what
+    sharding it was saved with. Plain restore handles host-gathered
+    (v3+) checkpoints; older sharded ones need explicit numpy
+    restore_args or orbax re-resolves the saved (possibly dead) device
+    set."""
+    import orbax.checkpoint as ocp
+
+    ckptr = _checkpointer()
+    try:
+        return ckptr.restore(path)
+    except Exception:
+        meta = ckptr.metadata(path)
+        restore_args = jax.tree_util.tree_map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta
+        )
+        return ckptr.restore(path, restore_args=restore_args)
 
 
 def save_checkpoint(model, path: str, *, step: Optional[int] = None,
@@ -56,20 +101,30 @@ def save_checkpoint(model, path: str, *, step: Optional[int] = None,
             "consecutive_skips": np.asarray(guard.consecutive_skips),
             "total_skips": np.asarray(guard.total_skips),
         }
-    # sidecar metadata for topology validation on restore
+    # sidecar metadata for topology validation on restore: the live
+    # device topology, plus each op's searched MachineView/degrees so an
+    # elastic restore (runtime/elastic.py) can tell the checkpoint was
+    # planned for a different machine and re-search for the live one
+    from .strategy_io import op_strategy_record
+
+    views = getattr(model, "searched_views", None) or {}
     meta = {
-        "version": 2,
+        "version": 3,
         "ops": [
-            {"name": op.name, "op_type": op.op_type.name}
+            op_strategy_record(op, views.get(op.guid))
             for op in model.graph.topo_order()
         ],
     }
+    if getattr(model, "executor", None) is not None:
+        from .elastic import topology_fingerprint
+
+        meta["topology"] = topology_fingerprint(model.executor.mesh)
     if extra_meta:
         meta.update(extra_meta)
     tmp = f"{path}.tmp-{os.getpid()}"
     tmp_meta = tmp + ".meta.json"
     try:
-        _checkpointer().save(tmp, state, force=True)
+        _checkpointer().save(tmp, _to_host(state), force=True)
         with open(tmp_meta, "w") as f:
             json.dump(meta, f)
         if _pre_rename_hook is not None:
@@ -106,10 +161,39 @@ def load_checkpoint_meta(path: str) -> Optional[dict]:
         return json.load(f)
 
 
-def restore_checkpoint(model, path: str) -> int:
+def _put_resharded(arr: np.ndarray, like) -> "jax.Array":
+    """device_put onto `like`'s sharding, falling back to replicated when
+    the array's shape no longer divides the live mesh axes (an elastic
+    restore can legally land a degree on a mesh it doesn't divide — the
+    data is still correct, just not distributed)."""
+    try:
+        return jax.device_put(arr.astype(like.dtype), like.sharding)
+    except Exception:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sh = like.sharding
+        repl = (NamedSharding(sh.mesh, PartitionSpec())
+                if isinstance(sh, NamedSharding) else None)
+        logger.warning(
+            "restore: array of shape %s does not divide the live mesh; "
+            "replicating instead", tuple(arr.shape)
+        )
+        return jax.device_put(arr.astype(like.dtype), repl)
+
+
+def restore_checkpoint(model, path: str, *,
+                       strict_topology: bool = True) -> int:
     """Restore params/opt_state into a compiled FFModel. Returns the step.
     Arrays are device_put with the model's current shardings (so a
-    checkpoint taken on one mesh restores onto another)."""
+    checkpoint taken on one mesh restores onto another).
+
+    `strict_topology=False` (elastic restore, runtime/elastic.py) drops
+    the exact op-list equality check — a strategy re-searched for a
+    different device count inserts different parallel ops — and matches
+    weights by (op name, weight name) instead, keeping the fresh
+    initialization for anything unmatched. The per-weight outcome lands
+    in ``model._restore_report`` ({"unmatched_model", "unmatched_checkpoint",
+    "replicated"})."""
     from ..parallel.executor import GuardState, TrainState
 
     assert model.state is not None, "compile() the model before restoring"
@@ -121,21 +205,60 @@ def restore_checkpoint(model, path: str) -> int:
         ours = [op.name for op in model.graph.topo_order()]
         theirs = [o["name"] for o in meta["ops"]]
         if ours != theirs:
-            raise ValueError(
-                "checkpoint topology mismatch: "
-                f"checkpoint has {len(theirs)} ops, model has {len(ours)}"
+            if strict_topology:
+                raise ValueError(
+                    "checkpoint topology mismatch: "
+                    f"checkpoint has {len(theirs)} ops, model has "
+                    f"{len(ours)}; pass elastic=True (or use "
+                    "runtime.elastic.restore_elastic) to restore across a "
+                    "re-searched strategy"
+                )
+            logger.info(
+                "elastic restore: checkpoint graph (%d ops) differs from "
+                "the live graph (%d ops); matching weights by name",
+                len(theirs), len(ours),
             )
-    restored = _checkpointer().restore(path)
+    report = {"unmatched_model": [], "unmatched_checkpoint": [],
+              "replicated": []}
+    restored = _restore_to_host(path)
     params = restored["params"]
     # re-shard onto the live mesh
     new_params = {}
     for op_name, wd in model.state.params.items():
         new_params[op_name] = {}
         for w_name, old in wd.items():
-            arr = np.asarray(params[op_name][w_name])
-            new_params[op_name][w_name] = jax.device_put(
-                arr.astype(old.dtype), old.sharding
-            )
+            src = params.get(op_name, {}).get(w_name) \
+                if not strict_topology else params[op_name][w_name]
+            if src is None:
+                report["unmatched_model"].append(f"{op_name}/{w_name}")
+                new_params[op_name][w_name] = old
+                continue
+            arr = np.asarray(src)
+            if tuple(arr.shape) != tuple(old.shape):
+                if strict_topology:
+                    raise ValueError(
+                        f"checkpoint weight {op_name}/{w_name} has shape "
+                        f"{tuple(arr.shape)}, model expects "
+                        f"{tuple(old.shape)}"
+                    )
+                report["unmatched_model"].append(f"{op_name}/{w_name}")
+                new_params[op_name][w_name] = old
+                continue
+            put = _put_resharded(arr, old)
+            if put.sharding != old.sharding:
+                report["replicated"].append(f"{op_name}/{w_name}")
+            new_params[op_name][w_name] = put
+    for op_name in params if isinstance(params, dict) else ():
+        for w_name in params[op_name]:
+            if op_name not in new_params or w_name not in new_params[op_name]:
+                report["unmatched_checkpoint"].append(f"{op_name}/{w_name}")
+    if report["unmatched_model"]:
+        logger.warning(
+            "elastic restore: %d weight(s) missing from the checkpoint "
+            "keep their fresh initialization: %s",
+            len(report["unmatched_model"]),
+            ", ".join(report["unmatched_model"]),
+        )
     opt_state = _merge_restore(model.state.opt_state, restored.get("opt_state"))
     step = int(np.asarray(restored.get("step", 0)))
     saved_net = restored.get("net_state")
@@ -171,6 +294,7 @@ def restore_checkpoint(model, path: str) -> int:
         )
     model.state = TrainState(params=new_params, opt_state=opt_state,
                              step=step, net_state=net_state, guard=guard)
+    model._restore_report = report
     return step
 
 
@@ -198,8 +322,6 @@ def _merge_restore(live, saved):
         else:
             arr = np.asarray(sv)
             out.append(
-                jax.device_put(arr.astype(lv.dtype), lv.sharding)
-                if hasattr(lv, "sharding")
-                else arr
+                _put_resharded(arr, lv) if hasattr(lv, "sharding") else arr
             )
     return jax.tree_util.tree_unflatten(treedef, out)

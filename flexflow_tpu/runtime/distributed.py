@@ -18,14 +18,28 @@ scripts/multinode_run.sh exports):
 """
 from __future__ import annotations
 
+import logging
 import os
 from typing import Optional
+
+logger = logging.getLogger("flexflow_tpu.runtime.distributed")
 
 _initialized = False
 
 
 def is_initialized() -> bool:
-    return _initialized
+    """Whether the multi-host runtime is up — either because WE brought
+    it up (init_distributed) or because the launcher/jax already did
+    (externally-initialized jax.distributed, probed via the live process
+    count, which only exceeds 1 after a successful coordinator join)."""
+    if _initialized:
+        return True
+    try:
+        import jax
+
+        return jax.process_count() > 1
+    except Exception:
+        return False
 
 
 def init_distributed(
@@ -82,22 +96,34 @@ def init_distributed(
     retry(
         lambda: jax.distributed.initialize(**kw),
         policy,
-        on_retry=lambda attempt, e, d: print(
-            f"[flexflow_tpu] coordinator connect attempt {attempt + 1} "
-            f"failed ({e}); retrying in {d:.1f}s"
+        on_retry=lambda attempt, e, d: logger.warning(
+            "coordinator connect attempt %d failed (%s); retrying in %.1fs",
+            attempt + 1, e, d,
         ),
     )
     _initialized = True
+    logger.info("distributed runtime up: process %d of %d, %d devices",
+                jax.process_index(), jax.process_count(),
+                len(jax.devices()))
     return (jax.process_index(), jax.process_count(), jax.devices())
 
 
 def shutdown() -> None:
+    """Tear down the multi-host runtime. Safe to call repeatedly (and
+    when init_distributed never ran): the flag drops first and an
+    already-shut-down jax runtime is a logged no-op, not a crash."""
     import jax
 
     global _initialized
-    if _initialized:
+    was = _initialized
+    _initialized = False
+    if not was:
+        return
+    try:
         jax.distributed.shutdown()
-        _initialized = False
+    except RuntimeError as e:
+        # double shutdown / runtime already gone — idempotent by contract
+        logger.debug("jax.distributed.shutdown: %s (ignored)", e)
 
 
 def process_index() -> int:

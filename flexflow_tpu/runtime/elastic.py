@@ -1,0 +1,464 @@
+"""Elastic runtime: survive topology changes, not just process restarts.
+
+PR 1's resilience layer (runtime/resilience.py) lets a run survive faults
+on the SAME machine; this layer handles the machine itself changing. The
+framework's core premise (FlexFlow MLSys'19 / Unity OSDI'22) is that the
+best parallelization strategy is a function of the machine — so when a
+host of a TPU pod is lost (or capacity grows back), the right move is to
+re-run the strategy search for the surviving device set, re-compile, and
+reshard the last checkpoint onto the new mesh, not to wait for the
+identical slice to return.
+
+Three pieces:
+
+* **Topology fingerprinting + elastic resume** — `save_checkpoint`
+  records the mesh/device topology and per-op MachineViews in the
+  sidecar (runtime/checkpoint.py meta version 3). `restore_elastic`
+  builds a fresh model for the LIVE topology (compile() re-runs the
+  strategy search for it), restores the checkpoint with name-based
+  weight matching, and validates the re-searched views against the live
+  device count. `FFModel.fit(..., elastic=True)` wires the same path
+  into the training loop's resume.
+
+* **Health watchdog** — `HealthMonitor` heartbeats in the background (a
+  lightweight collective, or a file transport on shared storage) and
+  watches per-step progress; a step that outlives `timeout_s` is a hung
+  collective (deadlocked psum after a silent host loss, a wedged
+  straggler) and escalates hang -> CollectiveTimeout -> fit's
+  checkpoint-and-raise, so the orchestrator restarts elastically instead
+  of burning TPU-hours in a deadlock.
+
+* **Fault simulation** — `shrunk_devices` shrinks what `jax.devices()`
+  reports so host-loss -> re-search -> reshard runs entirely on the CPU
+  mesh (tests/test_elastic.py; FaultInjector sites ``hung_step`` and
+  ``host_loss`` live in runtime/resilience.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .resilience import CheckpointManager, CollectiveTimeout  # noqa: F401
+from .resilience import RestoreResult
+
+logger = logging.getLogger("flexflow_tpu.runtime.elastic")
+
+
+class ElasticRestoreError(RuntimeError):
+    """restore_elastic could not produce a usable model (no checkpoint,
+    or the re-searched strategy is invalid for the live topology)."""
+
+
+# ----------------------------------------------------------------------
+# topology fingerprinting
+# ----------------------------------------------------------------------
+def topology_fingerprint(mesh=None) -> dict:
+    """A JSON-serializable description of the device topology a model is
+    compiled against (the checkpoint sidecar's ``topology`` entry). With
+    a mesh, describes THAT mesh (what the executable actually spans);
+    without, the process-visible device set."""
+    import jax
+
+    if mesh is not None:
+        devs = list(mesh.devices.flat)
+        axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    else:
+        devs = jax.devices()
+        axes = {}
+    try:
+        nproc = jax.process_count()
+    except Exception:
+        nproc = 1
+    return {
+        "num_devices": len(devs),
+        "num_processes": nproc,
+        "platform": devs[0].platform if devs else "unknown",
+        "device_kinds": sorted({
+            str(getattr(d, "device_kind", "unknown")) for d in devs
+        }),
+        "mesh_axes": axes,
+    }
+
+
+def topology_matches(saved: Optional[dict], live: Optional[dict]) -> bool:
+    """Whether a checkpoint's recorded topology still describes the live
+    machine (device count / process count / platform — mesh axis layout
+    may legally differ between equally-sized searches)."""
+    if not saved or not live:
+        return True  # old sidecars carry no fingerprint: assume unchanged
+    return all(
+        saved.get(k) == live.get(k)
+        for k in ("num_devices", "num_processes", "platform")
+    )
+
+
+def validate_machine_views(views: Dict, num_devices: int) -> List[str]:
+    """Check every searched MachineView addresses only live devices.
+    Returns a list of violation strings (empty = valid)."""
+    bad = []
+    for guid, view in (views or {}).items():
+        if view is None:
+            continue
+        last = view.start_device_id + sum(
+            (d - 1) * s for d, s in zip(view.dim, view.stride)
+        )
+        if view.start_device_id < 0 or last >= num_devices:
+            bad.append(
+                f"op {guid}: view {view!r} addresses device {last} of "
+                f"{num_devices}"
+            )
+    return bad
+
+
+# ----------------------------------------------------------------------
+# elastic resume
+# ----------------------------------------------------------------------
+def restore_elastic(model_fn: Callable[[], "FFModel"], ckpt_dir: str,
+                    *, verbose: bool = True) -> Tuple["FFModel", RestoreResult]:
+    """Resume a checkpointed run on the CURRENT device topology, whatever
+    it is. `model_fn` rebuilds + compiles the model (compile() runs the
+    strategy search against the live device set, so the plan is already
+    re-searched for whatever machine survived); the newest checkpoint
+    under `ckpt_dir` is then restored with name-based weight matching and
+    each array is host-gathered and re-device_put onto the new mesh.
+
+    Returns (model, RestoreResult); `RestoreResult.meta["train"]` carries
+    the data-loader cursor, so a follow-up `fit(checkpoint_dir=ckpt_dir,
+    elastic=True)` continues exactly where the old topology stopped.
+    Raises ElasticRestoreError when no checkpoint restores or the
+    re-searched strategy addresses devices that don't exist."""
+    model = model_fn()
+    assert getattr(model, "executor", None) is not None, (
+        "model_fn must return a compiled FFModel (call compile() inside it)"
+    )
+    if not model.executor.mesh_is_live():
+        # model_fn compiled against a stale cached topology (e.g. it was
+        # closured over a pre-shrink mesh) — re-plan for the live one
+        model.recompile_for_topology()
+    import jax
+
+    ndev = len(jax.devices())
+    bad = validate_machine_views(getattr(model, "searched_views", None) or {},
+                                 ndev)
+    if bad:
+        # the views address dead devices but the parallel STRUCTURE may
+        # still fit the survivors — try a view-only re-assignment
+        # (search/dp_search.py research_views) before giving up
+        from ..search import for_device_count, research_views
+        from ..search.cost_model import CostModel
+
+        cost_model = model._build_cost_model()
+        cost_model = CostModel(
+            for_device_count(ndev, like=cost_model.machine),
+            bf16=model.config.allow_mixed_precision,
+        )
+        result = research_views(model.graph, cost_model)
+        if result.cost != float("inf") and not validate_machine_views(
+            result.views, ndev
+        ):
+            logger.info(
+                "[elastic] reassigned %d machine view(s) for the live "
+                "%d-device topology (cost %.3g)",
+                len(result.views), ndev, result.cost,
+            )
+            model.searched_views = result.views
+            bad = []
+    if bad:
+        raise ElasticRestoreError(
+            "re-searched strategy is invalid for the live topology: "
+            + "; ".join(bad)
+        )
+    info = CheckpointManager(ckpt_dir).restore_latest(model, elastic=True)
+    if info is None:
+        raise ElasticRestoreError(
+            f"no restorable checkpoint under {ckpt_dir!r}"
+        )
+    saved_topo = (info.meta or {}).get("topology")
+    live_topo = topology_fingerprint(model.executor.mesh)
+    if not topology_matches(saved_topo, live_topo) and verbose:
+        logger.warning(
+            "[elastic] topology changed: checkpoint step %d was written on "
+            "%s device(s), resuming on %s — strategy re-searched and "
+            "parameters resharded",
+            info.step,
+            (saved_topo or {}).get("num_devices", "?"),
+            live_topo["num_devices"],
+        )
+    report = getattr(model, "_restore_report", None)
+    if report and report["unmatched_model"] and verbose:
+        logger.warning("[elastic] unmatched weights kept fresh init: %s",
+                       ", ".join(report["unmatched_model"]))
+    return model, info
+
+
+# ----------------------------------------------------------------------
+# health watchdog
+# ----------------------------------------------------------------------
+def allreduce_heartbeat() -> Callable[[], Optional[list]]:
+    """A lightweight collective heartbeat: sums a tiny array across the
+    local device set (and, multi-host, rendezvouses all processes). If
+    the interconnect or a peer host is wedged, this call hangs — which
+    the HealthMonitor's staleness check then detects."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda x: x.sum())
+
+    def beat() -> Optional[list]:
+        n = len(jax.devices())
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("ff_elastic_heartbeat")
+        got = float(fn(jnp.ones((n,), jnp.float32)))
+        return None if got == float(n) else [f"allreduce={got}!={n}"]
+
+    return beat
+
+
+class FileHeartbeat:
+    """File-transport heartbeat for CPU tests and clusters with shared
+    storage: each host touches ``<dir>/<host_id>.hb``; a peer whose file
+    goes stale (or an expected peer that never appeared) is a straggler.
+    Usable directly as a HealthMonitor ``heartbeat_fn`` — calling it
+    beats and returns the stale-peer list."""
+
+    def __init__(self, directory: str, host_id: str, *,
+                 stale_after_s: float = 30.0,
+                 expected_peers: Optional[List[str]] = None):
+        self.directory = os.path.abspath(directory)
+        self.host_id = host_id
+        self.stale_after_s = stale_after_s
+        self.expected_peers = list(expected_peers or [])
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _path(self, host_id: str) -> str:
+        return os.path.join(self.directory, f"{host_id}.hb")
+
+    def beat(self) -> None:
+        p = self._path(self.host_id)
+        tmp = f"{p}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(str(time.time()))
+        os.replace(tmp, p)
+
+    def stale_peers(self) -> List[str]:
+        now = time.time()
+        stale = []
+        seen = set()
+        for name in os.listdir(self.directory):
+            if not name.endswith(".hb"):
+                continue
+            host = name[:-3]
+            seen.add(host)
+            if host == self.host_id:
+                continue
+            try:
+                age = now - os.path.getmtime(self._path(host))
+            except OSError:
+                continue  # racing a peer's atomic replace
+            if age > self.stale_after_s:
+                stale.append(host)
+        stale.extend(p for p in self.expected_peers
+                     if p not in seen and p != self.host_id)
+        return sorted(stale)
+
+    def __call__(self) -> List[str]:
+        self.beat()
+        return self.stale_peers()
+
+
+class HealthMonitor:
+    """Watchdog for hung collectives and straggler hosts.
+
+    Two signals, each checked by a poll thread:
+
+    * **step progress** — fit() brackets every step with
+      `step_started`/`step_finished` (and blocks on the step's result so
+      completion is observable). A step still in flight after
+      `timeout_s` is a hung collective.
+    * **heartbeat** — `heartbeat_fn` (e.g. `allreduce_heartbeat()` or a
+      `FileHeartbeat`) runs every `heartbeat_interval_s` in its own
+      thread. A truthy return value names straggler peers; an exception,
+      or the beat itself hanging past `timeout_s`, is equally fatal.
+
+    Detection sets `hang_detected`/`hang_info`, calls `on_hang(info)`,
+    and releases any simulated hang. fit() then escalates through
+    checkpoint-and-raise (CollectiveTimeout). A REAL hung XLA collective
+    cannot be unwound in-process — set `exit_on_hang=True` in production
+    so the watchdog force-exits (os._exit(75)) after `on_hang` and the
+    orchestrator restarts the run elastically; tests leave it False and
+    use the FaultInjector's ``hung_step`` site, whose simulated hang IS
+    interruptible."""
+
+    def __init__(self, *, timeout_s: float = 60.0,
+                 poll_interval_s: Optional[float] = None,
+                 heartbeat_fn: Optional[Callable[[], Optional[list]]] = None,
+                 heartbeat_interval_s: float = 5.0,
+                 on_hang: Optional[Callable[[dict], None]] = None,
+                 exit_on_hang: bool = False,
+                 compile_grace_s: Optional[float] = None):
+        self.timeout_s = timeout_s
+        # until the FIRST step completes, the step is probably inside
+        # XLA compilation — which takes minutes at production scale, not
+        # timeout_s — so the hung-step check gets extra slack; a timeout
+        # tuned to steady-state steps would false-positive every cold
+        # start (default: generous but bounded)
+        self.compile_grace_s = (compile_grace_s if compile_grace_s is not None
+                                else max(300.0, 10.0 * timeout_s))
+        self.poll_interval_s = poll_interval_s or max(0.01, timeout_s / 4.0)
+        self.heartbeat_fn = heartbeat_fn
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.on_hang = on_hang
+        self.exit_on_hang = exit_on_hang
+        self.hang_detected = False
+        self.hang_info: dict = {}
+        self._stop = threading.Event()
+        self._hang_release = threading.Event()
+        self._lock = threading.Lock()
+        self._in_step = False
+        self._steps_done = 0
+        self._step = -1
+        self._last_progress = time.monotonic()
+        self._last_beat_ok = time.monotonic()
+        self._threads: List[threading.Thread] = []
+        self._started = False
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "HealthMonitor":
+        if self._started:
+            return self
+        self._started = True
+        self._last_progress = time.monotonic()
+        self._last_beat_ok = time.monotonic()
+        watcher = threading.Thread(target=self._watch_loop, daemon=True,
+                                   name="ff-health-watchdog")
+        self._threads.append(watcher)
+        watcher.start()
+        if self.heartbeat_fn is not None:
+            hb = threading.Thread(target=self._heartbeat_loop, daemon=True,
+                                  name="ff-health-heartbeat")
+            self._threads.append(hb)
+            hb.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._hang_release.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads = []
+        self._started = False
+
+    @property
+    def running(self) -> bool:
+        return self._started and not self._stop.is_set()
+
+    # -- training-loop hooks --------------------------------------------
+    def step_started(self, step: int) -> None:
+        with self._lock:
+            self._in_step = True
+            self._step = step
+            self._last_progress = time.monotonic()
+
+    def step_finished(self, step: int) -> None:
+        with self._lock:
+            self._in_step = False
+            self._steps_done += 1
+            self._last_progress = time.monotonic()
+
+    def simulate_hang(self) -> None:
+        """FaultInjector seam (site ``hung_step``): behave like a step
+        blocked in a dead collective — progress stops until the watchdog
+        notices and releases us (bounded so a broken watchdog can't
+        deadlock the test suite)."""
+        with self._lock:
+            self._in_step = True
+            self._last_progress = time.monotonic()
+        self._hang_release.wait(timeout=self.timeout_s * 20.0 + 5.0)
+        with self._lock:
+            self._in_step = False
+
+    # -- internals -------------------------------------------------------
+    def _escalate(self, kind: str, detail: dict) -> None:
+        with self._lock:
+            if self.hang_detected:
+                return
+            self.hang_detected = True
+            self.hang_info = {"kind": kind, "step": self._step,
+                              "timeout_s": self.timeout_s, **detail}
+        logger.error("health watchdog: %s detected (%s)", kind,
+                     self.hang_info)
+        if self.on_hang is not None:
+            try:
+                self.on_hang(dict(self.hang_info))
+            except Exception:
+                logger.exception("on_hang callback failed")
+        self._hang_release.set()
+        if self.exit_on_hang:
+            # a wedged collective cannot be interrupted in-process; exit
+            # so the orchestrator restarts elastically. 75 = EX_TEMPFAIL.
+            logger.critical("health watchdog: force-exiting hung process")
+            os._exit(75)
+
+    def _watch_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            now = time.monotonic()
+            with self._lock:
+                in_step = self._in_step
+                step_age = now - self._last_progress
+                beat_age = now - self._last_beat_ok
+                step_timeout = (self.timeout_s if self._steps_done
+                                else self.timeout_s + self.compile_grace_s)
+            if in_step and step_age > step_timeout:
+                self._escalate("hung_step", {"stalled_for_s": step_age})
+                return
+            if self.heartbeat_fn is not None and beat_age > max(
+                self.timeout_s, 2.0 * self.heartbeat_interval_s
+            ):
+                self._escalate("heartbeat_stalled",
+                               {"stalled_for_s": beat_age})
+                return
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                bad = self.heartbeat_fn()
+            except Exception as e:
+                self._escalate("heartbeat_error", {"error": repr(e)})
+                return
+            if bad:
+                self._escalate("straggler", {"peers": list(bad)})
+                return
+            with self._lock:
+                self._last_beat_ok = time.monotonic()
+            self._stop.wait(self.heartbeat_interval_s)
+
+
+# ----------------------------------------------------------------------
+# fault simulation (CPU-testable topology changes)
+# ----------------------------------------------------------------------
+@contextlib.contextmanager
+def shrunk_devices(n: int):
+    """Make `jax.devices()` / `jax.local_device_count()` report only the
+    first `n` devices — the CPU-mesh stand-in for a host dropping out of
+    the pod (XLA cannot actually remove devices from a live process).
+    Models compiled inside the context plan, search and build meshes for
+    the shrunk machine; `PCGExecutor.mesh_is_live()` turns False for
+    models compiled before it. Test/simulation use only."""
+    import jax
+
+    real_devices = jax.devices
+    real_local_count = jax.local_device_count
+    devs = real_devices()[:n]
+    jax.devices = lambda *a, **k: list(devs)
+    jax.local_device_count = lambda *a, **k: len(devs)
+    try:
+        yield list(devs)
+    finally:
+        jax.devices = real_devices
+        jax.local_device_count = real_local_count

@@ -64,6 +64,38 @@ class TrainingPreempted(ResilienceError):
         self.checkpoint_path: Optional[str] = None
 
 
+class HostLossError(TrainingPreempted):
+    """A host (and its devices) dropped out of the topology between steps.
+
+    Subclasses TrainingPreempted so the fit() grace-period machinery
+    flushes a final checkpoint; the orchestrator then restarts the run
+    elastically (runtime/elastic.py restore_elastic) on the surviving
+    device set instead of waiting for the identical slice to return."""
+
+    def __init__(self, msg: str = "host lost", *, step: int = 0,
+                 graceful: bool = True,
+                 surviving_devices: Optional[int] = None):
+        super().__init__(msg, step=step, graceful=graceful)
+        self.surviving_devices = surviving_devices
+
+
+class CollectiveTimeout(ResilienceError, TimeoutError):
+    """The health watchdog (runtime/elastic.py HealthMonitor) declared a
+    step hung — a collective that never completes (deadlocked psum after
+    a host loss, a wedged straggler) — or a straggler host stopped
+    heartbeating. fit() escalates through checkpoint-and-raise: the last
+    good state is flushed (checkpoint_path) and the process exits so the
+    orchestrator can restart elastically instead of burning TPU-hours in
+    a deadlock."""
+
+    def __init__(self, msg: str = "collective timeout", *, step: int = 0,
+                 info: Optional[dict] = None):
+        super().__init__(msg)
+        self.step = step
+        self.info = info or {}
+        self.checkpoint_path: Optional[str] = None
+
+
 # ----------------------------------------------------------------------
 # retry / backoff
 # ----------------------------------------------------------------------
@@ -195,6 +227,15 @@ class FaultInjector:
                                (no final checkpoint flush).
       * ``serving_worker``   — raised inside BatchScheduler's worker loop
                                (exercises the degraded unbatched fallback).
+      * ``hung_step``        — fit() simulates a step blocked in a dead
+                               collective; the HealthMonitor watchdog
+                               (runtime/elastic.py) must detect it and
+                               escalate CollectiveTimeout.
+      * ``host_loss``        — fit() raises HostLossError between steps
+                               (``surviving_devices=N`` rides along for
+                               the elastic-restart test to rebuild on);
+                               pair with elastic.shrunk_devices(N) to
+                               shrink what jax.devices() reports.
 
     Each injection fires `times` times, optionally only at `at_step`.
     `fire(site, step)` consumes one shot and raises `exc` when armed with
@@ -332,10 +373,17 @@ class CheckpointManager:
         self._gc()
         return path
 
-    def restore_latest(self, model) -> Optional[RestoreResult]:
+    def restore_latest(self, model,
+                       elastic: bool = False) -> Optional[RestoreResult]:
         """Restore the newest loadable checkpoint (a corrupt newest one —
         e.g. truncated by a crash landing exactly mid-rename — falls back
-        to the next older). Returns None when the directory has none."""
+        to the next older). Returns None when the directory has none.
+
+        `elastic=True` relaxes the checkpoint-vs-model graph check to
+        name-based weight matching (runtime/checkpoint.py), so a
+        checkpoint written on a different device topology — whose
+        re-searched PCG carries different parallel ops — still restores
+        onto the live mesh (runtime/elastic.py)."""
         from .checkpoint import load_checkpoint_meta, restore_checkpoint
 
         latest = self.latest_step()
@@ -346,7 +394,8 @@ class CheckpointManager:
         for s in candidates:
             path = self.step_path(s)
             try:
-                step = restore_checkpoint(model, path)
+                step = restore_checkpoint(model, path,
+                                          strict_topology=not elastic)
                 meta = load_checkpoint_meta(path) or {}
                 return RestoreResult(step=step, path=path, meta=meta)
             except Exception as e:  # corrupt/partial — try the next older
@@ -375,7 +424,8 @@ class CheckpointManager:
                 pass
 
 
-def restore_latest(model, directory: str) -> Optional[RestoreResult]:
+def restore_latest(model, directory: str,
+                   elastic: bool = False) -> Optional[RestoreResult]:
     """Restore the newest loadable checkpoint under `directory` into a
     compiled model. Convenience wrapper over CheckpointManager."""
-    return CheckpointManager(directory).restore_latest(model)
+    return CheckpointManager(directory).restore_latest(model, elastic=elastic)

@@ -445,11 +445,17 @@ class BatchScheduler:
     and when the worker thread has died — crashed on a batch, or never
     started — falls back to DEGRADED mode, running the request unbatched
     on the caller's thread so the service keeps answering (slower, but
-    up) while the operator restarts the scheduler. `fault_injector` site
-    ``serving_worker`` kills the worker deterministically in tests."""
+    up). A crashed worker is auto-restarted up to `max_worker_restarts`
+    times with exponential backoff (`restart_backoff_s` base); once the
+    budget is spent the scheduler stays degraded until the operator
+    intervenes. Restart counts surface in `stats["worker_restarts"]`.
+    `fault_injector` site ``serving_worker`` kills the worker
+    deterministically in tests."""
 
     def __init__(self, model, *, max_delay_s: float = 0.005,
-                 retry_policy=None, fault_injector=None):
+                 retry_policy=None, fault_injector=None,
+                 max_worker_restarts: int = 3,
+                 restart_backoff_s: float = 0.25):
         assert model.executor is not None, "compile() the model first"
         from .resilience import RetryPolicy
 
@@ -460,14 +466,18 @@ class BatchScheduler:
             max_attempts=2, base_delay_s=0.01, max_delay_s=0.5
         )
         self.fault_injector = fault_injector
+        self.max_worker_restarts = max(0, max_worker_restarts)
+        self.restart_backoff_s = restart_backoff_s
         self._q: "queue.Queue[InferenceRequest]" = queue.Queue()
         self._fwd = model.executor.build_forward()
         self._stop = threading.Event()
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._started = False
         self._worker_error: Optional[BaseException] = None
+        self._restart_lock = threading.Lock()
+        self._next_restart_t = 0.0
         self.stats = {"requests": 0, "batches": 0, "padded_slots": 0,
-                      "degraded": 0, "timeouts": 0}
+                      "degraded": 0, "timeouts": 0, "worker_restarts": 0}
 
     # -- client API ------------------------------------------------------
     def start(self):
@@ -485,6 +495,29 @@ class BatchScheduler:
         return (self._started and self._worker.is_alive()
                 and self._worker_error is None)
 
+    def _maybe_restart_worker(self) -> bool:
+        """Bounded auto-restart after a worker crash: spawn a fresh worker
+        thread once the backoff window has elapsed, at most
+        `max_worker_restarts` times. Returns True when a live worker is
+        available (already alive, or just restarted); False keeps the
+        caller on the degraded path."""
+        if self.worker_alive():
+            return True
+        if not self._started or self._stop.is_set():
+            return False
+        with self._restart_lock:
+            if self.worker_alive():  # another caller beat us to it
+                return True
+            if self.stats["worker_restarts"] >= self.max_worker_restarts:
+                return False  # budget spent: stay degraded
+            if time.monotonic() < self._next_restart_t:
+                return False  # still backing off: degraded for now
+            self.stats["worker_restarts"] += 1
+            self._worker_error = None
+            self._worker = threading.Thread(target=self._loop, daemon=True)
+            self._worker.start()
+            return True
+
     def submit(self, inputs: List[np.ndarray]) -> InferenceRequest:
         """Each request carries ONE sample per model input (no batch dim)."""
         req = InferenceRequest([np.asarray(a) for a in inputs])
@@ -499,7 +532,7 @@ class BatchScheduler:
         from .resilience import InferenceTimeout, retry
 
         def attempt():
-            if not self.worker_alive():
+            if not self._maybe_restart_worker():
                 return self._infer_direct(inputs)
             req = self.submit(inputs)
             if not req.event.wait(timeout):
@@ -570,7 +603,12 @@ class BatchScheduler:
                 # worker is no longer trustworthy: fail the in-flight
                 # requests (their callers re-run degraded) and exit so
                 # worker_alive() routes future traffic around the queue
+                # until _maybe_restart_worker's backoff window opens
                 self._worker_error = e
+                self._next_restart_t = time.monotonic() + (
+                    self.restart_backoff_s
+                    * (2.0 ** self.stats["worker_restarts"])
+                )
                 for r in batch:
                     r.error = e
                     r.event.set()

@@ -5,57 +5,197 @@ TPU-native equivalent of the reference's --export-strategy /
 serializes per-op ParallelConfigs to a protobuf). Ours is JSON: per-op
 machine view + per-tensor degrees, enough to re-apply a strategy without
 re-searching.
+
+Imports are validated (schema version, record shape, degree-vs-device
+feasibility) and fail with a typed StrategyImportError instead of a bare
+KeyError deep in the apply loop; the same per-op record format rides in
+checkpoint sidecars (runtime/checkpoint.py) so an elastic restore can see
+what strategy the checkpoint was trained under.
 """
 from __future__ import annotations
 
 import json
-from typing import Dict
+import logging
+from typing import Dict, List, Optional
 
 from ..pcg.graph import Graph
 from ..pcg.machine_view import MachineView
+
+logger = logging.getLogger("flexflow_tpu.runtime.strategy_io")
+
+# Bump when the on-disk record shape changes. Files declaring a NEWER
+# version than we know are rejected (we can't guess fields we've never
+# seen); older versions we still read.
+SCHEMA_VERSION = 1
+
+
+class StrategyImportError(ValueError):
+    """A strategy file failed schema/feasibility validation on import."""
+
+
+def op_strategy_record(op, view: Optional[MachineView]) -> dict:
+    """The per-op strategy record (shared by export_strategy and the
+    checkpoint sidecar's topology fingerprint)."""
+    return {
+        "name": op.name,
+        "op_type": op.op_type.name,
+        "layer_guid": op.layer_guid,
+        "machine_view": (
+            {
+                "start_device_id": view.start_device_id,
+                "dim": list(view.dim),
+                "stride": list(view.stride),
+            }
+            if view is not None
+            else None
+        ),
+        "output_degrees": [
+            [d.degree for d in t.dims] for t in op.outputs
+        ],
+        "weight_degrees": [
+            [d.degree for d in t.dims] for t in op.weights
+        ],
+    }
 
 
 def export_strategy(graph: Graph, result, path: str) -> None:
     ops = []
     for op in graph.topo_order():
         view = result.views.get(op.guid) if result is not None else None
-        ops.append(
-            {
-                "name": op.name,
-                "op_type": op.op_type.name,
-                "layer_guid": op.layer_guid,
-                "machine_view": (
-                    {
-                        "start_device_id": view.start_device_id,
-                        "dim": list(view.dim),
-                        "stride": list(view.stride),
-                    }
-                    if view is not None
-                    else None
-                ),
-                "output_degrees": [
-                    [d.degree for d in t.dims] for t in op.outputs
-                ],
-                "weight_degrees": [
-                    [d.degree for d in t.dims] for t in op.weights
-                ],
-            }
-        )
-    blob = {"version": 1, "cost": getattr(result, "cost", None), "ops": ops}
+        ops.append(op_strategy_record(op, view))
+    blob = {
+        "version": SCHEMA_VERSION,
+        "cost": getattr(result, "cost", None),
+        "ops": ops,
+    }
     with open(path, "w") as f:
         json.dump(blob, f, indent=1)
 
 
+def _validate_record(rec, idx: int) -> None:
+    if not isinstance(rec, dict):
+        raise StrategyImportError(f"ops[{idx}] is not an object: {rec!r}")
+    name = rec.get("name")
+    if not isinstance(name, str) or not name:
+        raise StrategyImportError(f"ops[{idx}] has no 'name' string")
+    mv = rec.get("machine_view")
+    if mv is not None:
+        if not isinstance(mv, dict) or not all(
+            k in mv for k in ("start_device_id", "dim", "stride")
+        ):
+            raise StrategyImportError(
+                f"op {name!r}: machine_view must carry "
+                "start_device_id/dim/stride"
+            )
+        if len(mv["dim"]) != len(mv["stride"]):
+            raise StrategyImportError(
+                f"op {name!r}: machine_view dim/stride length mismatch"
+            )
+    for key in ("output_degrees", "weight_degrees"):
+        degs = rec.get(key, [])
+        if not isinstance(degs, list) or not all(
+            isinstance(t, list) and all(
+                isinstance(d, int) and d >= 1 for d in t
+            )
+            for t in degs
+        ):
+            raise StrategyImportError(
+                f"op {name!r}: {key} must be lists of positive ints"
+            )
+
+
 def import_strategy(path: str) -> Dict[str, dict]:
-    """Returns op name -> strategy record."""
-    with open(path) as f:
-        blob = json.load(f)
-    return {rec["name"]: rec for rec in blob["ops"]}
+    """Load and validate a strategy file. Returns op name -> record.
+
+    Raises StrategyImportError on malformed JSON, an unknown (newer)
+    schema version, or records missing/mistyping required fields —
+    instead of dying later with a bare KeyError mid-apply."""
+    try:
+        with open(path) as f:
+            blob = json.load(f)
+    except json.JSONDecodeError as e:
+        raise StrategyImportError(f"{path}: not valid JSON ({e})") from e
+    if not isinstance(blob, dict) or "ops" not in blob:
+        raise StrategyImportError(f"{path}: missing top-level 'ops' list")
+    version = blob.get("version")
+    if not isinstance(version, int):
+        raise StrategyImportError(f"{path}: missing integer 'version'")
+    if version > SCHEMA_VERSION:
+        raise StrategyImportError(
+            f"{path}: schema version {version} is newer than the supported "
+            f"{SCHEMA_VERSION} — produced by a newer build?"
+        )
+    if not isinstance(blob["ops"], list):
+        raise StrategyImportError(f"{path}: 'ops' is not a list")
+    out: Dict[str, dict] = {}
+    for i, rec in enumerate(blob["ops"]):
+        _validate_record(rec, i)
+        if rec["name"] in out:
+            logger.warning("strategy %s: duplicate op record %r (last wins)",
+                           path, rec["name"])
+        out[rec["name"]] = rec
+    return out
 
 
-def apply_imported_strategy(graph: Graph, strategy: Dict[str, dict]) -> None:
+def _check_feasible(rec: dict, num_devices: int) -> None:
+    """A record is only applicable when its degrees/view fit the live
+    machine: every tensor's degree product must divide the device count,
+    and the machine view must address existing devices."""
+    name = rec["name"]
+    for key in ("output_degrees", "weight_degrees"):
+        for degs in rec.get(key, []):
+            prod = 1
+            for d in degs:
+                prod *= d
+            if prod > 1 and (prod > num_devices or num_devices % prod != 0):
+                raise StrategyImportError(
+                    f"op {name!r}: {key} product {prod} does not divide the "
+                    f"{num_devices} available devices — the strategy was "
+                    "searched for a different machine (re-search or import "
+                    "a matching file)"
+                )
+    mv = rec.get("machine_view")
+    if mv:
+        last = mv["start_device_id"] + sum(
+            (d - 1) * s for d, s in zip(mv["dim"], mv["stride"])
+        )
+        if last >= num_devices:
+            raise StrategyImportError(
+                f"op {name!r}: machine_view addresses device {last} but only "
+                f"{num_devices} devices are available"
+            )
+
+
+def apply_imported_strategy(
+    graph: Graph,
+    strategy: Dict[str, dict],
+    num_devices: Optional[int] = None,
+) -> List[str]:
     """Re-apply degrees/views from an imported strategy to a freshly lowered
-    PCG (ops matched by name, like the reference's config-file import)."""
+    PCG (ops matched by name, like the reference's config-file import).
+
+    When `num_devices` is given, each record is validated against the live
+    machine (degree products must divide it, views must address existing
+    devices) before anything is mutated. Returns the list of strategy
+    record names that matched NO op in the graph (also logged), so a
+    renamed/partial import is visible instead of silently ignored."""
+    graph_names = {op.name for op in graph.ops}
+    unmatched = [name for name in strategy if name not in graph_names]
+    if unmatched:
+        logger.warning(
+            "imported strategy: %d record(s) match no op in the graph and "
+            "were skipped: %s", len(unmatched), ", ".join(sorted(unmatched))
+        )
+    uncovered = sorted(graph_names - set(strategy))
+    if uncovered:
+        logger.info(
+            "imported strategy: %d graph op(s) have no record and keep "
+            "their current degrees: %s", len(uncovered), ", ".join(uncovered)
+        )
+    if num_devices is not None:
+        for name, rec in strategy.items():
+            if name in graph_names:
+                _check_feasible(rec, num_devices)
     for op in graph.ops:
         rec = strategy.get(op.name)
         if rec is None:
@@ -73,3 +213,4 @@ def apply_imported_strategy(graph: Graph, strategy: Dict[str, dict]) -> None:
         for w, degs in zip(op.weights, rec.get("weight_degrees", [])):
             for d, deg in zip(w.dims, degs):
                 d.degree = deg
+    return unmatched

@@ -2,8 +2,13 @@
 engine, MCMC fallback (TPU-native equivalents of reference
 src/runtime/{simulator,graph,substitution,model-mcmc}.cc)."""
 from .cost_model import CostMetrics, CostModel  # noqa: F401
-from .dp_search import GraphCostResult, SearchHelper  # noqa: F401
-from .machine_model import MachineModel, TPUChipSpec, parse_machine_config  # noqa: F401
+from .dp_search import GraphCostResult, SearchHelper, research_views  # noqa: F401
+from .machine_model import (  # noqa: F401
+    MachineModel,
+    TPUChipSpec,
+    for_device_count,
+    parse_machine_config,
+)
 from .mcmc import MCMCSearch, simulate_runtime  # noqa: F401
 from .substitution import (  # noqa: F401
     GraphSearchHelper,

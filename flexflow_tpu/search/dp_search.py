@@ -773,3 +773,22 @@ class SearchHelper:
         out = list(groups.values())
         self._comp_cache[ck] = out
         return out
+
+
+def research_views(graph: Graph, cost_model: CostModel) -> GraphCostResult:
+    """Re-run ONLY the DP machine-view assignment over an already-lowered
+    PCG for `cost_model`'s machine — the elastic re-search entry
+    (runtime/elastic.py): after a topology change, the graph's parallel
+    STRUCTURE (degrees, parallel ops) may still be legal on the surviving
+    machine even though every MachineView now addresses devices that are
+    gone; this reassigns views for the live device set without paying for
+    a full substitution search. Returns GraphCostResult.infinity() (cost
+    = inf, no views) when no valid assignment exists — i.e. the structure
+    itself no longer fits and a full re-compile must re-search it."""
+    machine = cost_model.machine
+    res = MachineResource(
+        num_nodes=machine.num_nodes,
+        all_procs_per_node=machine.workers_per_node,
+        available_procs_per_node=machine.workers_per_node,
+    )
+    return SearchHelper(cost_model).graph_cost(graph, res)

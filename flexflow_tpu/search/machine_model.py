@@ -146,6 +146,32 @@ class MachineModel:
         return max(t_flops, t_mem)
 
 
+def for_device_count(n: int, like: Optional[MachineModel] = None) -> MachineModel:
+    """Re-target a machine model at `n` live devices (the elastic
+    re-search entry, runtime/elastic.py): keep `like`'s per-chip and
+    link constants — those describe the hardware, which didn't change —
+    but re-factor the topology so nodes × workers covers exactly the
+    surviving device count. Prefers keeping `like`'s workers_per_node
+    when it still divides n (a whole host dropped); otherwise falls back
+    to the largest divisor of n that fits (the pod lost part of a host,
+    or n is not a multiple of the old host size)."""
+    base = like if like is not None else MachineModel()
+    n = max(1, int(n))
+    wpn = base.workers_per_node
+    if wpn > n or n % wpn != 0:
+        wpn = max(d for d in range(1, min(wpn, n) + 1) if n % d == 0)
+    kwargs = {"num_nodes": n // wpn, "workers_per_node": wpn}
+    if getattr(base, "topology", None) is not None \
+            and wpn != base.workers_per_node:
+        # a torus of the OLD slice shape can't describe the shrunk slice;
+        # degrade to a 1-D ring of the surviving chips (replace() re-runs
+        # __post_init__, which asserts topology matches workers_per_node)
+        from .network import TorusTopology
+
+        kwargs["topology"] = TorusTopology(dims=(wpn,))
+    return dataclasses.replace(base, **kwargs)
+
+
 def parse_machine_config(path: str) -> MachineModel:
     """Parse a key = value machine description file (same shape as the
     reference's machine_config_example; accepts both GPU-era and TPU-era

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Standalone elastic-runtime sweep (ISSUE 2 satellite): runs ALL of
+# tests/test_elastic.py — including the @pytest.mark.slow 8->4->2 shrink
+# chain and the hung-collective -> elastic-restart story that tier-1
+# skips — on CPU meshes of several sizes. The in-test shrink path
+# (elastic.shrunk_devices) exercises 8->4->2 inside one process; the
+# outer loop additionally varies the PROCESS-level device count so the
+# fingerprint/re-search code sees genuinely different live topologies,
+# not just monkeypatched ones. Use before touching the elastic resume,
+# watchdog, or checkpoint-resharding paths:
+#
+#   scripts/elastic_check.sh                 # full sweep (8, 4, 2-device meshes)
+#   FF_ELASTIC_DEVICES=8 scripts/elastic_check.sh -k watchdog
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+devices="${FF_ELASTIC_DEVICES:-8 4 2}"
+for n in $devices; do
+    echo "=== elastic sweep: ${n}-device CPU mesh ==="
+    # jax_num_cpu_devices needs jax >= 0.4.34; the XLA flag covers older
+    env JAX_PLATFORMS=cpu \
+        JAX_NUM_CPU_DEVICES="$n" \
+        XLA_FLAGS="--xla_force_host_platform_device_count=$n" \
+        python -m pytest tests/test_elastic.py -v -p no:cacheprovider "$@"
+done

@@ -22,7 +22,8 @@ if os.environ.get("JAX_PLATFORMS", "axon") == "axon":
 if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8"
+        + " --xla_force_host_platform_device_count="
+        + os.environ.get("JAX_NUM_CPU_DEVICES", "8")
     ).strip()
 
 # Persistent XLA compilation cache: the suite's wall clock is dominated by
@@ -44,8 +45,12 @@ try:
 except Exception:
     pass
 jax.config.update("jax_platforms", "cpu")
+# JAX_NUM_CPU_DEVICES overrides the 8-device default so sweeps can vary
+# the PROCESS-level topology (scripts/elastic_check.sh runs the elastic
+# suite on 8/4/2-device meshes; device-count-specific tests skip)
+_NDEV = int(os.environ.get("JAX_NUM_CPU_DEVICES", "8"))
 try:
-    jax.config.update("jax_num_cpu_devices", 8)
+    jax.config.update("jax_num_cpu_devices", _NDEV)
 except AttributeError:
     # older jax: the --xla_force_host_platform_device_count XLA_FLAGS
     # exported above provides the 8-device CPU mesh instead

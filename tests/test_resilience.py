@@ -54,8 +54,13 @@ def dataset(n=64, seed=0):
 
 
 def params_of(m):
+    # copy=True is load-bearing: on CPU np.asarray(jax_array) can be a
+    # zero-copy VIEW of the device buffer, and the train step's
+    # donate_argnums reuses that memory on the next fit — a view snapshot
+    # silently morphs into later-step values (flaked whenever a warm jit
+    # cache made training fast enough for the race to land)
     return {
-        name: {k: np.asarray(v) for k, v in wd.items()}
+        name: {k: np.array(v, copy=True) for k, v in wd.items()}
         for name, wd in m.state.params.items()
     }
 
@@ -392,7 +397,9 @@ def test_serving_worker_death_falls_back_unbatched():
     m = small_model()
     fi = FaultInjector()
     fi.inject("serving_worker", exc=RuntimeError("worker crash"), times=1)
-    sched = BatchScheduler(m, fault_injector=fi).start()
+    # max_worker_restarts=0: the operator opted out of auto-restart, so a
+    # dead worker degrades traffic permanently (the pre-restart contract)
+    sched = BatchScheduler(m, fault_injector=fi, max_worker_restarts=0).start()
     try:
         # first request crashes the worker; the caller still gets an
         # answer from the degraded path, and so does all later traffic
@@ -401,6 +408,54 @@ def test_serving_worker_death_falls_back_unbatched():
         assert out1.shape == (3,) and out2.shape == (3,)
         assert not sched.worker_alive()
         assert sched.stats["degraded"] >= 2
+        assert sched.stats["worker_restarts"] == 0
+    finally:
+        sched.stop()
+
+
+def test_serving_worker_auto_restarts_after_crash():
+    import time as _time
+
+    from flexflow_tpu.runtime.serving import BatchScheduler
+
+    m = small_model()
+    fi = FaultInjector()
+    fi.inject("serving_worker", exc=RuntimeError("worker crash"), times=1)
+    sched = BatchScheduler(m, fault_injector=fi, max_worker_restarts=3,
+                           restart_backoff_s=0.01).start()
+    try:
+        out1 = sched.infer([np.zeros(4, np.float32)], timeout=5.0)
+        assert out1.shape == (3,)  # crash answered via degraded path
+        _time.sleep(0.05)  # let the backoff window open
+        out2 = sched.infer([np.ones(4, np.float32)], timeout=5.0)
+        assert out2.shape == (3,)
+        assert sched.stats["worker_restarts"] == 1
+        assert sched.worker_alive()  # restarted worker handles traffic
+    finally:
+        sched.stop()
+
+
+def test_serving_worker_restart_budget_then_stays_degraded():
+    import time as _time
+
+    from flexflow_tpu.runtime.serving import BatchScheduler
+
+    m = small_model()
+    fi = FaultInjector()
+    # every revived worker dies again on its first batch
+    fi.inject("serving_worker", exc=RuntimeError("worker crash"), times=50)
+    sched = BatchScheduler(m, fault_injector=fi, max_worker_restarts=2,
+                           restart_backoff_s=0.0).start()
+    try:
+        for i in range(6):
+            out = sched.infer([np.zeros(4, np.float32)], timeout=5.0)
+            assert out.shape == (3,)
+            _time.sleep(0.02)
+        # budget spent: exactly max_worker_restarts revivals, then the
+        # scheduler stays degraded (but keeps answering) forever
+        assert sched.stats["worker_restarts"] == 2
+        assert not sched.worker_alive()
+        assert sched.stats["degraded"] >= 1
     finally:
         sched.stop()
 
@@ -493,6 +548,50 @@ def test_init_distributed_exhausted_retries_raise(monkeypatch):
             ),
         )
     assert not distributed.is_initialized()
+
+
+def test_is_initialized_probes_externally_initialized_runtime(monkeypatch):
+    """A launcher (or user code) that called jax.distributed.initialize
+    directly never set our module flag — is_initialized() must still see
+    the live multi-process runtime via the process-count probe."""
+    import jax
+
+    from flexflow_tpu.runtime import distributed
+
+    assert not distributed._initialized
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    assert distributed.is_initialized()
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    assert not distributed.is_initialized()
+
+
+def test_shutdown_idempotent(monkeypatch):
+    import jax
+
+    from flexflow_tpu.runtime import distributed
+
+    calls = []
+
+    def fake_shutdown():
+        if calls:
+            raise RuntimeError("distributed runtime already shut down")
+        calls.append(1)
+
+    monkeypatch.setattr(jax.distributed, "shutdown", fake_shutdown)
+    # never initialized: a no-op, not a crash
+    distributed.shutdown()
+    assert calls == []
+    # initialized once: tears down exactly once, repeat calls are no-ops
+    distributed._initialized = True
+    distributed.shutdown()
+    distributed.shutdown()
+    distributed.shutdown()
+    assert calls == [1]
+    assert not distributed._initialized
+    # even a racing double-teardown under the flag is swallowed
+    distributed._initialized = True
+    distributed.shutdown()  # fake now raises RuntimeError — absorbed
+    assert not distributed._initialized
 
 
 # ----------------------------------------------------------------------

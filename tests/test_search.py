@@ -393,6 +393,89 @@ def test_strategy_export_import_roundtrip(tmp_path, machine):
     )
 
 
+def test_import_strategy_validates_schema(tmp_path):
+    import json
+
+    from flexflow_tpu.runtime.strategy_io import (
+        SCHEMA_VERSION,
+        StrategyImportError,
+        import_strategy,
+    )
+
+    def write(name, blob, raw=None):
+        p = str(tmp_path / name)
+        with open(p, "w") as f:
+            f.write(raw if raw is not None else json.dumps(blob))
+        return p
+
+    with pytest.raises(StrategyImportError, match="not valid JSON"):
+        import_strategy(write("garbage.json", None, raw="{not json"))
+    with pytest.raises(StrategyImportError, match="missing top-level"):
+        import_strategy(write("noops.json", {"version": 1}))
+    with pytest.raises(StrategyImportError, match="missing integer"):
+        import_strategy(write("nover.json", {"ops": []}))
+    with pytest.raises(StrategyImportError, match="newer than the supported"):
+        import_strategy(write("future.json",
+                              {"version": SCHEMA_VERSION + 1, "ops": []}))
+    with pytest.raises(StrategyImportError, match="has no 'name'"):
+        import_strategy(write("noname.json",
+                              {"version": 1, "ops": [{"op_type": "OP_LINEAR"}]}))
+    with pytest.raises(StrategyImportError, match="positive ints"):
+        import_strategy(write("baddeg.json", {
+            "version": 1,
+            "ops": [{"name": "a", "output_degrees": [["two"]]}],
+        }))
+    with pytest.raises(StrategyImportError, match="dim/stride length"):
+        import_strategy(write("badmv.json", {
+            "version": 1,
+            "ops": [{"name": "a", "machine_view":
+                     {"start_device_id": 0, "dim": [2], "stride": [1, 1]}}],
+        }))
+    # a well-formed older-or-current file loads
+    ok = import_strategy(write("ok.json", {
+        "version": 1,
+        "ops": [{"name": "a", "output_degrees": [[2, 1]],
+                 "machine_view": {"start_device_id": 0, "dim": [2],
+                                  "stride": [1]}}],
+    }))
+    assert set(ok) == {"a"}
+
+
+def test_apply_imported_strategy_reports_unmatched_and_checks_devices():
+    from flexflow_tpu.runtime.strategy_io import (
+        StrategyImportError,
+        apply_imported_strategy,
+    )
+
+    g = mlp_graph(batch=64, din=16, dh=32, dout=8)
+    names = [op.name for op in g.topo_order()]
+    rec = {"name": names[0], "output_degrees": [], "weight_degrees": []}
+    ghost = {"name": "op_that_never_existed", "output_degrees": [],
+             "weight_degrees": []}
+    unmatched = apply_imported_strategy(
+        g, {rec["name"]: rec, ghost["name"]: ghost}
+    )
+    assert unmatched == ["op_that_never_existed"]
+
+    # a degree product that does not divide the live device count is
+    # rejected BEFORE any op is mutated
+    bad = {"name": names[0], "output_degrees": [[8, 1]],
+           "weight_degrees": []}
+    with pytest.raises(StrategyImportError, match="does not divide"):
+        apply_imported_strategy(g, {bad["name"]: bad}, num_devices=4)
+    # ...as is a machine view addressing devices beyond the machine
+    bad_mv = {"name": names[0], "output_degrees": [], "weight_degrees": [],
+              "machine_view": {"start_device_id": 2, "dim": [4],
+                               "stride": [1]}}
+    with pytest.raises(StrategyImportError, match="addresses device"):
+        apply_imported_strategy(g, {bad_mv["name"]: bad_mv}, num_devices=4)
+    # degrees that DO fit apply cleanly under the same validation
+    good = {"name": names[0], "output_degrees": [[4, 1]],
+            "weight_degrees": []}
+    assert apply_imported_strategy(g, {good["name"]: good},
+                                   num_devices=4) == []
+
+
 # -- topology-aware network model (reference: src/runtime/network.cc) -------
 
 def test_torus_topology_routing():
