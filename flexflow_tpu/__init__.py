@@ -36,6 +36,18 @@ from .runtime.resilience import (  # noqa: F401
     retry,
 )
 from .runtime.serving import BatchScheduler  # noqa: F401
+from .runtime.verify import (  # noqa: F401
+    CanaryConfig,
+    CanaryMismatchError,
+    CheckpointCorruptionError,
+    InvariantViolationError,
+    NotCompiledError,
+    ServingConfigError,
+    StrategyDivergenceError,
+    VerificationError,
+    verify_checkpoint,
+    verify_strategy,
+)
 from .core.optimizers import AdamOptimizer, Optimizer, SGDOptimizer  # noqa: F401
 from .core.tensor import Layer, Tensor  # noqa: F401
 from .ff_types import (  # noqa: F401

@@ -994,6 +994,20 @@ class FFModel:
                 self._pt_by_guid[t.guid] = t
         for t in self.graph.input_tensors():
             self._pt_by_guid[t.guid] = t
+        # strategy-validator hook (search/__init__.py): structural vetting
+        # of the final search result — machine views addressing only live
+        # devices, degree products within the device count — so an insane
+        # strategy is flagged here, not discovered as wrong numbers later
+        from ..search import run_strategy_validators
+
+        problems = run_strategy_validators(self.graph, self.searched_views,
+                                           ndev)
+        if problems:
+            warnings.warn(
+                "searched strategy failed structural validation "
+                "(falling through to lowering, which demotes infeasible "
+                "degrees to replicated): " + "; ".join(problems[:5])
+            )
         if cfg.export_strategy_file:
             from ..runtime.strategy_io import export_strategy
 
@@ -1251,8 +1265,13 @@ class FFModel:
         preemption_signal=None,
         elastic: bool = False,
         health_monitor=None,
+        verify_strategy=None,
+        canary=None,
     ):
-        assert self.executor is not None, "call compile() first"
+        if self.executor is None:
+            from ..runtime.verify import NotCompiledError
+
+            raise NotCompiledError("fit: call compile() first")
         x, y = _unwrap_loaders(x, y)
         xs = x if isinstance(x, (list, tuple)) else [x]
         bs = batch_size or self.config.batch_size
@@ -1265,10 +1284,30 @@ class FFModel:
         if n % bs != 0:
             print(f"[flexflow_tpu] warning: dropping {n % bs} tail samples "
                   f"(dataset {n} % batch {bs})")
+        if verify_strategy:
+            # differential preflight (runtime/verify.py): K steps of the
+            # searched strategy vs a serial single-device reference from
+            # identical params/RNG; divergence raises
+            # StrategyDivergenceError naming the first diverging op
+            # BEFORE any real training budget is spent on a broken plan
+            from ..runtime import verify as _vfy
+
+            if verify_strategy not in (True, "preflight"):
+                raise ValueError(
+                    "fit(verify_strategy=...) accepts 'preflight' "
+                    f"(got {verify_strategy!r})"
+                )
+            verdict = _vfy.verify_strategy(
+                self, (xs, y), steps=2, batch_size=bs,
+                raise_on_divergence=True,
+            )
+            if verbose:
+                print("[verify] preflight: "
+                      + verdict.summary().split("\n")[0])
         if (checkpoint_dir is not None or skip_nonfinite_steps
                 or step_guard is not None or fault_injector is not None
                 or preemption_signal is not None or elastic
-                or health_monitor is not None):
+                or health_monitor is not None or canary is not None):
             # resilient stepwise loop (runtime/resilience.py): periodic
             # atomic checkpoints + mid-epoch resume, NaN/Inf step guard,
             # preemption handling, deterministic fault injection; with
@@ -1287,6 +1326,7 @@ class FFModel:
                 preemption_signal=preemption_signal,
                 elastic=elastic,
                 health_monitor=health_monitor,
+                canary=canary,
             )
         # guard residue from a previous resilient fit would change the
         # step signature; drop it for the fast unguarded paths
@@ -1428,13 +1468,96 @@ class FFModel:
             "done": done,
         }})
 
+    def _canary_check(self, vfy, canary, prev_state, args, step_fn,
+                      partials, fault_injector, manager, global_step,
+                      epoch, bi, pnorm_fn, prev_pnorm, prev_loss):
+        """SDC/determinism canary + per-step invariants
+        (runtime/verify.py CanaryConfig). At the canary cadence the step
+        is re-executed on the SAME cached inputs from the SAME pre-step
+        state (args[0] still references it) and the two results compared;
+        per-step invariants bound param-norm drift and loss deltas. Any
+        violation reverts to the pre-step state, flushes it as a
+        checkpoint (the state AFTER the step is untrusted) and raises —
+        the same checkpoint-and-raise escalation the watchdog uses.
+        Returns the updated (prev_pnorm, prev_loss) trackers."""
+        def escalate(exc):
+            self.state = prev_state
+            if manager is not None:
+                exc.checkpoint_path = self._save_resilient_ckpt(
+                    manager, global_step, epoch, bi
+                )
+            raise exc
+
+        if canary.every_n_steps > 0 \
+                and global_step % canary.every_n_steps == 0:
+            if fault_injector is not None:
+                # SDC simulation: flip one bit in one weight of the FIRST
+                # execution's result, as a faulty core would have
+                # (target=None keeps disk-targeted plans for
+                # CheckpointManager.save)
+                plan = fault_injector.fire("bitflip", global_step,
+                                           target=None)
+                if plan is not None:
+                    flipped, _name = vfy.bitflip_params(
+                        self.state.params, op=plan.get("op"),
+                        weight=plan.get("weight"),
+                        bit=plan.get("bit", 6),
+                        index=plan.get("index", 3),
+                    )
+                    self.state = dataclasses.replace(
+                        self.state, params=flipped
+                    )
+            state2, partials2 = step_fn(*args)
+            bad = vfy.compare_step_results(
+                {"params": self.state.params, "loss": partials["loss"]},
+                {"params": state2.params, "loss": partials2["loss"]},
+                mode=canary.mode, rtol=canary.rtol, atol=canary.atol,
+            )
+            if bad:
+                escalate(vfy.CanaryMismatchError(
+                    f"step {global_step}: canary re-execution disagrees "
+                    f"({canary.mode} mode) — non-deterministic step or "
+                    "silent data corruption: " + "; ".join(bad),
+                    step=global_step, mismatches=bad,
+                ))
+        if pnorm_fn is not None:
+            loss = float(_fetch_global(partials["loss"]).ravel()[-1])
+            if not np.isfinite(loss) and self.executor.step_guard is None:
+                escalate(vfy.InvariantViolationError(
+                    f"step {global_step}: non-finite loss {loss} (enable "
+                    "skip_nonfinite_steps for skip-and-rescale instead)",
+                    step=global_step, invariant="finite_loss",
+                ))
+            if (canary.max_loss_delta is not None and prev_loss is not None
+                    and abs(loss - prev_loss) > canary.max_loss_delta):
+                escalate(vfy.InvariantViolationError(
+                    f"step {global_step}: loss moved "
+                    f"{abs(loss - prev_loss):.3g} in one step "
+                    f"(bound {canary.max_loss_delta:g})",
+                    step=global_step, invariant="loss_delta",
+                ))
+            pn = float(np.asarray(pnorm_fn(self.state.params)))
+            if not np.isfinite(pn) or (
+                prev_pnorm is not None and prev_pnorm > 0
+                and pn > prev_pnorm * canary.max_param_norm_ratio
+            ):
+                escalate(vfy.InvariantViolationError(
+                    f"step {global_step}: global param norm {pn:.3g} "
+                    f"drifted past {canary.max_param_norm_ratio:g}x the "
+                    f"previous step's ({prev_pnorm})",
+                    step=global_step, invariant="param_norm_drift",
+                ))
+            return pn, loss
+        return prev_pnorm, prev_loss
+
     def _fit_resilient(self, xs, y, bs, ep, verbose, *, checkpoint_dir,
                        checkpoint_every_n_steps, keep_last_n, resume,
                        skip_nonfinite_steps, step_guard,
                        max_consecutive_skips, fault_injector,
                        preemption_signal, elastic=False,
-                       health_monitor=None):
+                       health_monitor=None, canary=None):
         from ..runtime import resilience as rz
+        from ..runtime import verify as vfy
 
         if elastic and not self.executor.mesh_is_live():
             # a host (and its devices) disappeared since compile(): any
@@ -1474,11 +1597,21 @@ class FFModel:
         if mon is not None:
             mon.start()
 
-        step_fn = self.executor.build_train_step()
+        # the canary re-executes steps from the pre-step state, which
+        # donation would have reclaimed on accelerators — use the
+        # undonated step variant when it is armed
+        step_fn = self.executor.build_train_step(donate=(canary is None))
         in_pts = self.executor.input_pts
         label_dt = self.label_tensor.data_type.jnp_dtype
         if jax.process_count() > 1:
             self._assert_same_global_batch(xs, y, bs)
+        pnorm_fn = None
+        prev_pnorm = None
+        prev_loss = None
+        if canary is not None and canary.check_invariants:
+            from ..parallel.executor import global_grad_norm
+
+            pnorm_fn = jax.jit(global_grad_norm)
 
         start_epoch, start_batch, global_step = 0, 0, 0
         if manager is not None and resume:
@@ -1587,6 +1720,7 @@ class FFModel:
                         args.append(self.executor.put_replicated(
                             jnp.asarray(poison, jnp.float32)
                         ))
+                    prev_state = self.state if canary is not None else None
                     self.state, partials = step_fn(*args)
                     if mon is not None:
                         # the watchdog can only observe completion if we
@@ -1594,6 +1728,13 @@ class FFModel:
                         # hang detection (documented in docs/resilience.md)
                         jax.block_until_ready(partials["loss"])
                         mon.step_finished(global_step)
+                    if canary is not None:
+                        prev_pnorm, prev_loss = self._canary_check(
+                            vfy, canary, prev_state, args, step_fn,
+                            partials, fault_injector, manager,
+                            global_step, epoch, bi, pnorm_fn,
+                            prev_pnorm, prev_loss,
+                        )
                     device_partials.append(partials)
                     num_samples += bs
                     global_step += 1
@@ -1661,7 +1802,10 @@ class FFModel:
         return self.perf_metrics
 
     def eval(self, x=None, y=None, batch_size: Optional[int] = None):
-        assert self.executor is not None
+        if self.executor is None:
+            from ..runtime.verify import NotCompiledError
+
+            raise NotCompiledError("eval: call compile() first")
         x, y = _unwrap_loaders(x, y)
         xs = x if isinstance(x, (list, tuple)) else [x]
         bs = batch_size or self.config.batch_size

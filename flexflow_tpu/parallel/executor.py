@@ -176,6 +176,7 @@ class PCGExecutor:
         # the cached train step (set_step_guard).
         self.step_guard = None
         self._train_step = None
+        self._train_step_nodonate = None
         self._train_scan = None
         self._grad_step = None
         self._eval_step = None
@@ -620,6 +621,7 @@ class PCGExecutor:
         `train_only` keeps the eval/forward traces, which don't see the
         optimizer's hyperparameters."""
         self._train_step = None
+        self._train_step_nodonate = None
         self._train_scan = None
         self._grad_step = None
         for k in list(self._seq_len_cache):
@@ -649,6 +651,7 @@ class PCGExecutor:
         if cfg != self.step_guard:
             self.step_guard = cfg
             self._train_step = None
+            self._train_step_nodonate = None
             self._train_scan = None
 
     def init_guard_state(self) -> GuardState:
@@ -795,7 +798,16 @@ class PCGExecutor:
         nothing where it applies."""
         return (0,) if jax.default_backend() != "cpu" else ()
 
-    def build_train_step(self) -> Callable:
+    def build_train_step(self, donate: bool = True) -> Callable:
+        """donate=False builds a variant that never donates the input
+        state, whatever the backend — required by the SDC/determinism
+        canary (runtime/verify.py), which re-executes a step from the
+        pre-step state: donation would have already reclaimed those
+        buffers on accelerators."""
+        if not donate:
+            if self._train_step_nodonate is None:
+                self._train_step_nodonate = jax.jit(self._make_step())
+            return self._train_step_nodonate
         if self._train_step is None:
             self._train_step = jax.jit(self._make_step(),
                                        donate_argnums=self._donate_state())

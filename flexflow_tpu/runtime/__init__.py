@@ -27,3 +27,15 @@ from .strategy_io import (  # noqa: F401
     export_strategy,
     import_strategy,
 )
+from .verify import (  # noqa: F401
+    CanaryConfig,
+    CanaryMismatchError,
+    CheckpointCorruptionError,
+    InvariantViolationError,
+    NotCompiledError,
+    ServingConfigError,
+    StrategyDivergenceError,
+    VerificationError,
+    verify_checkpoint,
+    verify_strategy,
+)

@@ -61,7 +61,14 @@ def _restore_to_host(path: str):
     ckptr = _checkpointer()
     try:
         return ckptr.restore(path)
-    except Exception:
+    except (ValueError, TypeError, KeyError, OSError, RuntimeError) as e:
+        # the expected failure: a pre-v3 checkpoint whose saved sharding
+        # names dead devices; anything else (corrupt store) fails the
+        # numpy retry below too, and louder
+        logger.warning(
+            "checkpoint %s: plain restore failed (%r); retrying with "
+            "explicit host-numpy restore_args", path, e,
+        )
         meta = ckptr.metadata(path)
         restore_args = jax.tree_util.tree_map(
             lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta
@@ -80,7 +87,13 @@ def save_checkpoint(model, path: str, *, step: Optional[int] = None,
     tmp write and before the rename) never leaves a partial checkpoint at
     `path`; the half-written tmp is cleaned up on the way out.
     `extra_meta` (e.g. fit's data-loader cursor) rides in the sidecar."""
-    assert model.state is not None, "model not compiled"
+    from .verify import NotCompiledError, tensor_checksums
+
+    if model.state is None:
+        raise NotCompiledError(
+            "save_checkpoint: model has no training state — call "
+            "compile() (and restore/fit) before saving"
+        )
     path = os.path.abspath(path)
     state = {
         "params": model.state.params,
@@ -121,10 +134,21 @@ def save_checkpoint(model, path: str, *, step: Optional[int] = None,
         meta["topology"] = topology_fingerprint(model.executor.mesh)
     if extra_meta:
         meta.update(extra_meta)
+    host_state = _to_host(state)
+    # per-tensor content checksums (runtime/verify.py): restore and the
+    # offline audit re-hash the bytes, so on-disk corruption — bitrot, a
+    # truncated object, a flipped bit — is caught by name instead of
+    # silently training on garbage weights
+    from .verify import CHECKSUM_ALGO
+
+    meta["integrity"] = {
+        "algo": CHECKSUM_ALGO,
+        "tensors": tensor_checksums(host_state),
+    }
     tmp = f"{path}.tmp-{os.getpid()}"
     tmp_meta = tmp + ".meta.json"
     try:
-        _checkpointer().save(tmp, _to_host(state), force=True)
+        _checkpointer().save(tmp, host_state, force=True)
         with open(tmp_meta, "w") as f:
             json.dump(meta, f)
         if _pre_rename_hook is not None:
@@ -168,15 +192,18 @@ def _put_resharded(arr: np.ndarray, like) -> "jax.Array":
     data is still correct, just not distributed)."""
     try:
         return jax.device_put(arr.astype(like.dtype), like.sharding)
-    except Exception:
+    except (ValueError, TypeError) as e:
+        # jax raises ValueError when the shape doesn't divide the mesh
+        # axes (TypeError on some older sharding paths); anything else is
+        # a real bug and must propagate
         from jax.sharding import NamedSharding, PartitionSpec
 
         sh = like.sharding
         repl = (NamedSharding(sh.mesh, PartitionSpec())
                 if isinstance(sh, NamedSharding) else None)
         logger.warning(
-            "restore: array of shape %s does not divide the live mesh; "
-            "replicating instead", tuple(arr.shape)
+            "restore: array of shape %s does not divide the live mesh "
+            "(%s); replicating instead", tuple(arr.shape), e,
         )
         return jax.device_put(arr.astype(like.dtype), repl)
 
@@ -195,10 +222,15 @@ def restore_checkpoint(model, path: str, *,
     in ``model._restore_report`` ({"unmatched_model", "unmatched_checkpoint",
     "replicated"})."""
     from ..parallel.executor import GuardState, TrainState
+    from .verify import NotCompiledError, verify_checksums
 
-    assert model.state is not None, "compile() the model before restoring"
+    if model.state is None:
+        raise NotCompiledError(
+            "restore_checkpoint: compile() the model before restoring"
+        )
     path = os.path.abspath(path)
     meta_path = path + ".meta.json"
+    meta = None
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
@@ -221,6 +253,12 @@ def restore_checkpoint(model, path: str, *,
     report = {"unmatched_model": [], "unmatched_checkpoint": [],
               "replicated": []}
     restored = _restore_to_host(path)
+    if meta is not None and meta.get("integrity"):
+        # bytes-level integrity gate (runtime/verify.py): a corrupt
+        # tensor raises CheckpointCorruptionError naming it, which
+        # CheckpointManager.restore_latest treats like any other
+        # unloadable checkpoint — fall back to the previous intact one
+        verify_checksums(restored, meta["integrity"], path=path)
     params = restored["params"]
     # re-shard onto the live mesh
     new_params = {}
@@ -313,8 +351,15 @@ def _merge_restore(live, saved):
     )
     try:
         flat_saved = treedef.flatten_up_to(saved)
-    except Exception:
-        return live  # structure changed (different optimizer) — keep fresh
+    except (ValueError, TypeError, KeyError) as e:
+        # structure changed (different optimizer) — keep the fresh state,
+        # but say so: a silently-reset momentum surprises a resumed run
+        logger.warning(
+            "restore: optimizer state structure does not match the "
+            "checkpoint's (%r); keeping freshly-initialized optimizer "
+            "state", e,
+        )
+        return live
     out = []
     for lv, sv in zip(flat_live, flat_saved):
         if lv is None or sv is None:

@@ -71,7 +71,11 @@ def topology_fingerprint(mesh=None) -> dict:
         axes = {}
     try:
         nproc = jax.process_count()
-    except Exception:
+    except RuntimeError as e:  # backend not initialized yet
+        logger.warning(
+            "topology_fingerprint: jax.process_count() unavailable (%r); "
+            "recording num_processes=1", e,
+        )
         nproc = 1
     return {
         "num_devices": len(devs),

@@ -236,11 +236,21 @@ class FaultInjector:
                                the elastic-restart test to rebuild on);
                                pair with elastic.shrunk_devices(N) to
                                shrink what jax.devices() reports.
+      * ``bitflip``          — silent-data-corruption simulation
+                               (runtime/verify.py): the canary's consumer
+                               flips one bit of one live weight tensor
+                               after a step executes (default), or with
+                               ``target="disk"`` CheckpointManager.save
+                               corrupts the just-written checkpoint so
+                               the restore-time checksum path fires.
 
     Each injection fires `times` times, optionally only at `at_step`.
     `fire(site, step)` consumes one shot and raises `exc` when armed with
     one, otherwise returns the plan dict (extras like graceful=False ride
-    along) or None when nothing applies."""
+    along) or None when nothing applies. `fire(..., key=value)` keyword
+    filters restrict matching to plans whose extras carry those exact
+    values (how the two ``bitflip`` consumers avoid stealing each
+    other's plans)."""
 
     def __init__(self):
         self._plans: Dict[str, List[dict]] = {}
@@ -254,11 +264,14 @@ class FaultInjector:
         self._plans.setdefault(site, []).append(plan)
         return self
 
-    def fire(self, site: str, step: Optional[int] = None) -> Optional[dict]:
+    def fire(self, site: str, step: Optional[int] = None,
+             **match) -> Optional[dict]:
         for plan in self._plans.get(site, []):
             if plan["remaining"] <= 0:
                 continue
             if plan["at_step"] is not None and step != plan["at_step"]:
+                continue
+            if any(plan.get(k) != v for k, v in match.items()):
                 continue
             plan["remaining"] -= 1
             self.fired[site] = self.fired.get(site, 0) + 1
@@ -369,6 +382,18 @@ class CheckpointManager:
                                    _pre_rename_hook=hook)
 
         retry(_write, self.retry_policy, sleep=self._sleep)
+        if self.fault_injector is not None:
+            # SDC-on-disk simulation (runtime/verify.py): corrupt the
+            # checkpoint AFTER its checksums were recorded, so the
+            # restore-time integrity gate has something real to catch
+            plan = self.fault_injector.fire("bitflip", step, target="disk")
+            if plan is not None:
+                from .verify import corrupt_checkpoint_tensor
+
+                corrupt_checkpoint_tensor(
+                    path, tensor=plan.get("tensor"),
+                    bit=plan.get("bit", 6), index=plan.get("index", 3),
+                )
         self._write_latest(step)
         self._gc()
         return path

@@ -19,6 +19,8 @@ from typing import Dict, List, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from .verify import NotCompiledError, ServingConfigError
+
 
 def greedy_generate(
     model,
@@ -42,14 +44,16 @@ def greedy_generate(
     its serving story is the Triton prototype's single forward — so this
     is a capability upgrade on the serving side.
     """
-    assert model.executor is not None, "compile() the model first"
+    if model.executor is None:
+        raise NotCompiledError("compile() the model first")
     fwd = model.executor.build_forward()
     enc_t, dec_t = model._fit_input_tensors[:2]
     bs, dec_len = dec_t.dims[0], dec_t.dims[1]
-    assert tuple(encoder_ids.shape) == tuple(enc_t.dims), (
-        f"encoder_ids shape {tuple(encoder_ids.shape)} != compiled input "
-        f"shape {tuple(enc_t.dims)}"
-    )
+    if tuple(encoder_ids.shape) != tuple(enc_t.dims):
+        raise ServingConfigError(
+            f"encoder_ids shape {tuple(encoder_ids.shape)} != compiled input "
+            f"shape {tuple(enc_t.dims)}"
+        )
     want = dec_len - 1 if max_new_tokens is None else max_new_tokens
     steps = min(want, dec_len - 1)
     enc = np.asarray(encoder_ids, enc_t.data_type.np_dtype)
@@ -106,18 +110,21 @@ def incremental_seq2seq_generate(
     one decoder position through the liveness-analyzed decoder subgraph
     (parallel/decode.py). Works on imported HF graphs (mt5) where
     attention is primitive batch_matmul/softmax ops."""
-    assert model.executor is not None, "compile() the model first"
-    assert len(model._fit_input_tensors) >= 2, (
-        "incremental_seq2seq_generate needs an encoder-decoder model "
-        "(two graph inputs); use incremental_generate for decoder-only"
-    )
+    if model.executor is None:
+        raise NotCompiledError("compile() the model first")
+    if len(model._fit_input_tensors) < 2:
+        raise ServingConfigError(
+            "incremental_seq2seq_generate needs an encoder-decoder model "
+            "(two graph inputs); use incremental_generate for decoder-only"
+        )
     ex = model.executor
     enc_t, dec_t = model._fit_input_tensors[:2]
     bs, dec_len = dec_t.dims[0], dec_t.dims[1]
-    assert tuple(encoder_ids.shape) == tuple(enc_t.dims), (
-        f"encoder_ids shape {tuple(encoder_ids.shape)} != compiled input "
-        f"shape {tuple(enc_t.dims)}"
-    )
+    if tuple(encoder_ids.shape) != tuple(enc_t.dims):
+        raise ServingConfigError(
+            f"encoder_ids shape {tuple(encoder_ids.shape)} != compiled input "
+            f"shape {tuple(enc_t.dims)}"
+        )
     want = dec_len - 1 if max_new_tokens is None else max_new_tokens
     steps = min(want, dec_len - 1)
     if steps <= 0:
@@ -173,14 +180,16 @@ def incremental_generate(
     build_decode's convention, the last); assume_causal vouches for
     primitive-op attention whose causality can't be proven from baked
     constants (parallel/decode.py)."""
-    assert model.executor is not None, "compile() the model first"
+    if model.executor is None:
+        raise NotCompiledError("compile() the model first")
     prompt_ids = np.asarray(prompt_ids)
     bs, plen = prompt_ids.shape
     if max_new_tokens <= 0:
         return prompt_ids.copy()
     total = plen + max_new_tokens
     cap = max_len or total
-    assert cap >= total, f"max_len {cap} < prompt+new {total}"
+    if cap < total:
+        raise ServingConfigError(f"max_len {cap} < prompt+new {total}")
     init_caches, step = model.executor.build_decode(
         bs, cap, decode_input=decode_input, assume_causal=assume_causal
     )
@@ -246,7 +255,8 @@ def incremental_beam_generate(
     cross-attention K/V are computed once at its init."""
     import jax
 
-    assert model.executor is not None, "compile() the model first"
+    if model.executor is None:
+        raise NotCompiledError("compile() the model first")
     prompt_ids = np.asarray(prompt_ids)
     plen = prompt_ids.shape[1]
     if max_new_tokens <= 0:
@@ -254,7 +264,8 @@ def incremental_beam_generate(
     in_t = model._fit_input_tensors[-1]
     total = plen + max_new_tokens
     cap = max_len or total
-    assert cap >= total, f"max_len {cap} < prompt+new {total}"
+    if cap < total:
+        raise ServingConfigError(f"max_len {cap} < prompt+new {total}")
     init_caches, step = model.executor.build_decode(
         num_beams, cap, assume_causal=assume_causal
     )
@@ -263,7 +274,11 @@ def incremental_beam_generate(
     if encoder_ids is not None:
         enc_t = model._fit_input_tensors[0]
         enc_rows = np.asarray(encoder_ids, enc_t.data_type.np_dtype)
-        assert enc_rows.shape[0] == prompt_ids.shape[0]
+        if enc_rows.shape[0] != prompt_ids.shape[0]:
+            raise ServingConfigError(
+                f"encoder_ids rows {enc_rows.shape[0]} != prompt rows "
+                f"{prompt_ids.shape[0]}"
+            )
 
     outs = []
     for i, row in enumerate(prompt_ids.astype(id_dt)):
@@ -373,18 +388,21 @@ def beam_generate(
     runs the beams of ONE sample as a batch-shaped forward, so the
     compiled batch size must be >= num_beams; samples decode sequentially.
     num_beams=1 degenerates to greedy."""
-    assert model.executor is not None, "compile() the model first"
+    if model.executor is None:
+        raise NotCompiledError("compile() the model first")
     fwd = model.executor.build_forward()
     enc_t, dec_t = model._fit_input_tensors[:2]
     bs, dec_len = dec_t.dims[0], dec_t.dims[1]
-    assert num_beams <= bs, (
-        f"num_beams {num_beams} > compiled batch {bs}; recompile with a "
-        "larger batch"
-    )
-    assert tuple(encoder_ids.shape[1:]) == tuple(enc_t.dims[1:]), (
-        f"encoder_ids row shape {tuple(encoder_ids.shape[1:])} != compiled "
-        f"{tuple(enc_t.dims[1:])}"
-    )
+    if num_beams > bs:
+        raise ServingConfigError(
+            f"num_beams {num_beams} > compiled batch {bs}; recompile with a "
+            "larger batch"
+        )
+    if tuple(encoder_ids.shape[1:]) != tuple(enc_t.dims[1:]):
+        raise ServingConfigError(
+            f"encoder_ids row shape {tuple(encoder_ids.shape[1:])} != "
+            f"compiled {tuple(enc_t.dims[1:])}"
+        )
     want = dec_len - 1 if max_new_tokens is None else max_new_tokens
     steps = min(want, dec_len - 1)
     n_rows = encoder_ids.shape[0]
@@ -456,7 +474,8 @@ class BatchScheduler:
                  retry_policy=None, fault_injector=None,
                  max_worker_restarts: int = 3,
                  restart_backoff_s: float = 0.25):
-        assert model.executor is not None, "compile() the model first"
+        if model.executor is None:
+            raise NotCompiledError("compile() the model first")
         from .resilience import RetryPolicy
 
         self.model = model

@@ -1,0 +1,814 @@
+"""Numerical-trust layer: strategy-equivalence verification, checkpoint
+integrity checksums, and an online SDC/determinism canary.
+
+The framework's core premise (FlexFlow MLSys'19 / Unity OSDI'22) is that an
+auto-searched PCG strategy — substitutions plus Repartition / Combine /
+Replicate / Reduction ops and MachineViews — is *semantically equivalent*
+to the serial program. PR 1-2 made runs survive crashes and topology
+changes; nothing made the surviving run *trustworthy*: a wrong sharding
+rule, a dropped activation in a substitution, or a flipped bit from a
+faulty core ("Cores that don't count", HotOS'21; MegaScale, NSDI'24)
+silently degrades convergence instead of failing. Three defenses:
+
+* **Differential strategy verifier** — `verify_strategy(model, data,
+  steps=K)` runs K train steps of the searched strategy AND a fully-serial
+  single-device reference built from the same layer list, from identical
+  parameters and RNG, and compares loss, global grad norm and final params
+  under per-dtype tolerances. On divergence it bisects over the PCG's
+  matched op prefix (executing both forwards and probing intermediate
+  outputs) to name the first diverging op. Exposed as
+  `fit(verify_strategy="preflight")` and standalone.
+
+* **Checkpoint integrity** — `save_checkpoint` writes per-tensor crc32 +
+  dtype/shape checksums into the meta sidecar; `restore_checkpoint`
+  verifies them and raises a typed `CheckpointCorruptionError` naming the
+  corrupt tensor, which makes `CheckpointManager.restore_latest` fall back
+  to the previous intact checkpoint. `verify_checkpoint(path)` is the
+  offline audit (`python -m flexflow_tpu.runtime.verify <path>`).
+
+* **SDC/determinism canary** — `CanaryConfig(every_n_steps, mode)` makes
+  the resilient fit loop periodically re-execute the step function on the
+  cached inputs from the same pre-step state and compare the two results
+  bitwise (``"determinism"``) or within tolerance (``"sdc"``), plus cheap
+  per-step invariants (param-norm drift, loss-delta bounds, finite loss).
+  Violations escalate through the existing checkpoint-and-raise machinery
+  (`CanaryMismatchError` / `InvariantViolationError`). The FaultInjector
+  site ``bitflip`` corrupts one weight tensor (live state, or the
+  just-written checkpoint with ``target="disk"``) so both detection paths
+  are exercised on CPU in CI (tests/test_verify.py,
+  scripts/verify_check.sh).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .resilience import ResilienceError
+
+logger = logging.getLogger("flexflow_tpu.runtime.verify")
+
+
+# ----------------------------------------------------------------------
+# typed errors
+# ----------------------------------------------------------------------
+class NotCompiledError(RuntimeError):
+    """An API that needs a compiled model (executor + state) was called
+    before `FFModel.compile()` — replaces bare asserts that vanish under
+    ``python -O`` and gave no hint of the fix."""
+
+
+class ServingConfigError(ValueError):
+    """A serving request does not fit the compiled model: wrong input
+    shape, batch/beam count over the compiled capacity, or a generation
+    length over the decode cap."""
+
+
+class VerificationError(RuntimeError):
+    """Base class for numerical-trust failures."""
+
+
+class StrategyDivergenceError(VerificationError):
+    """The searched strategy's execution diverged from the serial
+    reference beyond tolerance. `diverging_op` names the first PCG op
+    whose forward output differs (None when only the backward/optimizer
+    step diverges); `verdict` carries the full comparison report."""
+
+    def __init__(self, msg: str, *, diverging_op: Optional[str] = None,
+                 verdict: Optional["StrategyVerdict"] = None):
+        super().__init__(msg)
+        self.diverging_op = diverging_op
+        self.verdict = verdict
+
+
+class CheckpointCorruptionError(VerificationError):
+    """A restored tensor's bytes do not match the checksum recorded at
+    save time — on-disk corruption (bad storage, truncation, bitrot).
+    `tensors` names every mismatching tensor path."""
+
+    def __init__(self, msg: str, *, path: str = "",
+                 tensors: Optional[List[str]] = None):
+        super().__init__(msg)
+        self.path = path
+        self.tensors = list(tensors or [])
+
+
+class CanaryMismatchError(VerificationError, ResilienceError):
+    """The SDC/determinism canary re-executed a step on identical inputs
+    and state and got a different answer — non-deterministic execution or
+    silent data corruption from a faulty core. fit() reverts to the
+    pre-step state, flushes a checkpoint (checkpoint_path) and raises."""
+
+    def __init__(self, msg: str, *, step: int = 0,
+                 mismatches: Optional[List[str]] = None):
+        super().__init__(msg)
+        self.step = step
+        self.mismatches = list(mismatches or [])
+        self.checkpoint_path: Optional[str] = None
+
+
+class InvariantViolationError(VerificationError, ResilienceError):
+    """A cheap per-step training invariant failed (param-norm drift over
+    the configured ratio, loss delta over the bound, non-finite loss).
+    Same checkpoint-and-raise escalation as the canary."""
+
+    def __init__(self, msg: str, *, step: int = 0, invariant: str = ""):
+        super().__init__(msg)
+        self.step = step
+        self.invariant = invariant
+        self.checkpoint_path: Optional[str] = None
+
+
+# ----------------------------------------------------------------------
+# per-dtype tolerances
+# ----------------------------------------------------------------------
+# (rtol, atol) for comparing two executions of the "same" math whose
+# reduction/summation orders legally differ (a sharded matmul's partial
+# sums vs the serial one's single accumulation).
+DTYPE_TOLERANCES: Dict[str, Tuple[float, float]] = {
+    "float64": (1e-12, 1e-12),
+    "float32": (2e-4, 1e-5),
+    "bfloat16": (5e-2, 5e-2),
+    "float16": (5e-3, 5e-3),
+}
+_DEFAULT_TOL = (2e-4, 1e-5)
+
+
+def tolerance_for(dtype, rtol: Optional[float] = None,
+                  atol: Optional[float] = None) -> Tuple[float, float]:
+    """The (rtol, atol) pair for `dtype`, with explicit overrides
+    winning over the per-dtype table."""
+    base = DTYPE_TOLERANCES.get(np.dtype(dtype).name if dtype is not None
+                                else "float32", _DEFAULT_TOL)
+    return (base[0] if rtol is None else rtol,
+            base[1] if atol is None else atol)
+
+
+# ----------------------------------------------------------------------
+# checkpoint integrity checksums
+# ----------------------------------------------------------------------
+CHECKSUM_ALGO = "crc32"
+
+
+def _flat_path(path) -> str:
+    """A stable human-readable key for a pytree leaf path:
+    ``params/dense_1/kernel``, ``opt_state/1/m/...``."""
+    import jax.tree_util as jtu
+
+    parts = []
+    for k in path:
+        if isinstance(k, jtu.DictKey):
+            parts.append(str(k.key))
+        elif isinstance(k, jtu.SequenceKey):
+            parts.append(str(k.idx))
+        elif isinstance(k, jtu.GetAttrKey):
+            parts.append(str(k.name))
+        else:  # FlattenedIndexKey and friends
+            parts.append(str(getattr(k, "key", k)))
+    return "/".join(parts)
+
+
+def _array_crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def tensor_checksums(tree) -> Dict[str, dict]:
+    """Per-tensor content checksums for a host-side state tree:
+    ``{flat_path: {"crc32": int, "dtype": str, "shape": [..]}}``. Only
+    array leaves are recorded (None optimizer slots, plain ints skip)."""
+    import jax.tree_util as jtu
+
+    flat, _ = jtu.tree_flatten_with_path(tree, is_leaf=lambda x: x is None)
+    out: Dict[str, dict] = {}
+    for path, leaf in flat:
+        if leaf is None:
+            continue
+        arr = np.asarray(leaf)
+        out[_flat_path(path)] = {
+            "crc32": _array_crc(arr),
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+        }
+    return out
+
+
+def verify_checksums(tree, integrity: dict, *, path: str = "") -> None:
+    """Check a restored host tree against the sidecar's ``integrity``
+    record. Raises CheckpointCorruptionError naming every tensor whose
+    bytes/dtype/shape differ from what was written, or that went missing
+    entirely."""
+    recorded = integrity.get("tensors", {})
+    live = tensor_checksums(tree)
+    bad: List[str] = []
+    for name, rec in recorded.items():
+        got = live.get(name)
+        if got is None:
+            bad.append(f"{name} (missing from checkpoint)")
+        elif (got["crc32"] != rec["crc32"] or got["dtype"] != rec["dtype"]
+              or list(got["shape"]) != list(rec["shape"])):
+            bad.append(name)
+    if bad:
+        raise CheckpointCorruptionError(
+            f"checkpoint {path or '<tree>'} failed integrity verification: "
+            f"{len(bad)} corrupt tensor(s): " + ", ".join(sorted(bad)),
+            path=path, tensors=sorted(bad),
+        )
+
+
+def verify_checkpoint(path: str) -> dict:
+    """Offline integrity audit of one checkpoint directory. Returns
+    ``{"ok", "path", "checked", "corrupt", "has_integrity"}``; checkpoints
+    from before the integrity sidecar report ``has_integrity=False`` and
+    ok=True (nothing to verify against). Runnable standalone:
+    ``python -m flexflow_tpu.runtime.verify <path>``."""
+    import os
+
+    from .checkpoint import _restore_to_host, load_checkpoint_meta
+
+    path = os.path.abspath(path)
+    meta = load_checkpoint_meta(path) or {}
+    integrity = meta.get("integrity")
+    report = {"ok": True, "path": path, "checked": 0, "corrupt": [],
+              "has_integrity": integrity is not None}
+    if integrity is None:
+        return report
+    tree = _restore_to_host(path)
+    report["checked"] = len(integrity.get("tensors", {}))
+    try:
+        verify_checksums(tree, integrity, path=path)
+    except CheckpointCorruptionError as e:
+        report["ok"] = False
+        report["corrupt"] = e.tensors
+    return report
+
+
+# ----------------------------------------------------------------------
+# bit flips (SDC simulation)
+# ----------------------------------------------------------------------
+def bitflip_array(arr, *, bit: int = 6, index: int = 3) -> np.ndarray:
+    """A host copy of `arr` with one bit flipped in its raw byte stream —
+    the CPU-testable stand-in for a faulty core's silent corruption. The
+    default (bit 6 of byte 3) lands in a float32 element's exponent, so
+    SDC-mode tolerance checks catch it too, not just bitwise ones."""
+    a = np.array(arr, copy=True)
+    if a.nbytes == 0:
+        return a
+    flat = a.reshape(-1).view(np.uint8)
+    flat[index % flat.size] ^= np.uint8(1 << (bit % 8))
+    return a
+
+
+def bitflip_params(params, *, op: Optional[str] = None,
+                   weight: Optional[str] = None, bit: int = 6,
+                   index: int = 3):
+    """Corrupt ONE weight tensor in a params tree (the FaultInjector
+    ``bitflip`` site's live-state consumer). Returns (new_params,
+    "op/weight"). Targets the named op/weight, defaulting to the first in
+    sorted order. Device arrays are re-put with their original sharding."""
+    import jax
+
+    op_names = sorted(params)
+    if not op_names:
+        raise ValueError("bitflip_params: empty params tree")
+    opn = op if op is not None else op_names[0]
+    wd = params[opn]
+    wn = weight if weight is not None else sorted(wd)[0]
+    old = wd[wn]
+    flipped = bitflip_array(np.asarray(old), bit=bit, index=index)
+    if isinstance(old, jax.Array):
+        flipped = jax.device_put(flipped, old.sharding)
+    new_params = dict(params)
+    new_params[opn] = dict(wd)
+    new_params[opn][wn] = flipped
+    return new_params, f"{opn}/{wn}"
+
+
+def corrupt_checkpoint_tensor(path: str, *, tensor: Optional[str] = None,
+                              bit: int = 6, index: int = 3) -> str:
+    """Flip one bit of one stored tensor in an on-disk checkpoint WITHOUT
+    touching its integrity sidecar — the disk-corruption half of the
+    ``bitflip`` fault site (``target="disk"``). Re-serializes the loaded
+    tree so the corruption lives at the array level regardless of the
+    storage format's own framing/compression. Returns the corrupted
+    tensor's params path."""
+    import jax.tree_util as jtu
+
+    from .checkpoint import _checkpointer, _restore_to_host
+
+    tree = _restore_to_host(path)
+    params = tree.get("params") if isinstance(tree, dict) else None
+    if not params:
+        raise ValueError(f"checkpoint {path} has no params tree to corrupt")
+    if tensor is None:
+        flat, _ = jtu.tree_flatten_with_path(params)
+        target_path, leaf = flat[0]
+        name = _flat_path(target_path)
+    else:
+        name = tensor
+        node: Any = params
+        for part in name.split("/"):
+            node = node[part]
+        leaf = node
+    flipped = bitflip_array(np.asarray(leaf), bit=bit, index=index)
+    node = params
+    parts = name.split("/")
+    for part in parts[:-1]:
+        node = node[part]
+    node[parts[-1]] = flipped
+    _checkpointer().save(path, tree, force=True)
+    return "params/" + name
+
+
+# ----------------------------------------------------------------------
+# SDC / determinism canary
+# ----------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class CanaryConfig:
+    """Online execution-integrity canary for the resilient fit loop.
+
+    Every `every_n_steps` optimizer steps, the step function is re-executed
+    on the SAME cached inputs from the SAME pre-step state and the two
+    results compared:
+
+    * ``mode="determinism"`` — bitwise equality. Any difference means the
+      step program is non-deterministic (or a core corrupted one run).
+    * ``mode="sdc"`` — per-dtype tolerance comparison (`rtol`/`atol`
+      override the table). Catches large corruptions while tolerating
+      benign non-determinism (e.g. non-deterministic scatter orders).
+
+    `check_invariants` additionally enables cheap per-step sanity bounds:
+    a non-finite loss, a global param norm growing more than
+    `max_param_norm_ratio`x in one step, or (when set) a loss delta over
+    `max_loss_delta`, each raise InvariantViolationError through
+    checkpoint-and-raise. Overhead: the canary step costs one extra
+    dispatch per cadence; invariants cost one tiny norm dispatch + a
+    scalar fetch per step (the resilient loop already syncs per step)."""
+
+    every_n_steps: int = 100
+    mode: str = "determinism"  # or "sdc"
+    rtol: Optional[float] = None
+    atol: Optional[float] = None
+    check_invariants: bool = True
+    max_param_norm_ratio: float = 50.0
+    max_loss_delta: Optional[float] = None
+
+    def __post_init__(self):
+        if self.mode not in ("determinism", "sdc"):
+            raise ValueError(
+                f"CanaryConfig.mode must be 'determinism' or 'sdc', "
+                f"got {self.mode!r}"
+            )
+
+
+def compare_step_results(a, b, *, mode: str = "determinism",
+                         rtol: Optional[float] = None,
+                         atol: Optional[float] = None,
+                         max_report: int = 5) -> List[str]:
+    """Compare two executions' result trees (params and/or metric
+    partials). Returns mismatch descriptions (empty = consistent).
+    Determinism mode compares raw bytes; sdc mode uses per-dtype
+    tolerances."""
+    import jax.tree_util as jtu
+
+    fa, _ = jtu.tree_flatten_with_path(a, is_leaf=lambda x: x is None)
+    fb, _ = jtu.tree_flatten_with_path(b, is_leaf=lambda x: x is None)
+    bad: List[str] = []
+    for (pa, la), (pb, lb) in zip(fa, fb):
+        if la is None or lb is None:
+            continue
+        xa, xb = np.asarray(la), np.asarray(lb)
+        name = _flat_path(pa)
+        if mode == "determinism":
+            if xa.tobytes() != xb.tobytes():
+                diff = _max_abs_diff(xa, xb)
+                bad.append(f"{name} (bitwise, max|Δ|={diff:.3g})")
+        else:
+            r, t = tolerance_for(xa.dtype, rtol, atol)
+            if not np.allclose(xa.astype(np.float64), xb.astype(np.float64),
+                               rtol=r, atol=t, equal_nan=True):
+                bad.append(f"{name} (max|Δ|={_max_abs_diff(xa, xb):.3g} "
+                           f"> rtol={r:g}/atol={t:g})")
+        if len(bad) >= max_report:
+            bad.append("...")
+            break
+    return bad
+
+
+def _max_abs_diff(a: np.ndarray, b: np.ndarray) -> float:
+    try:
+        d = np.abs(a.astype(np.float64) - b.astype(np.float64))
+        return float(np.nanmax(d)) if d.size else 0.0
+    except (TypeError, ValueError):
+        return float("nan")
+
+
+# ----------------------------------------------------------------------
+# differential strategy verifier
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class StrategyVerdict:
+    """Result of a differential strategy verification run."""
+
+    ok: bool
+    steps: int
+    loss_diffs: List[float] = dataclasses.field(default_factory=list)
+    grad_norm_diff: float = 0.0
+    max_param_diff: float = 0.0
+    param_mismatches: List[str] = dataclasses.field(default_factory=list)
+    unmatched_weights: List[str] = dataclasses.field(default_factory=list)
+    diverging_op: Optional[str] = None
+    validator_problems: List[str] = dataclasses.field(default_factory=list)
+    rtol: float = 0.0
+    atol: float = 0.0
+
+    def summary(self) -> str:
+        head = ("strategy VERIFIED" if self.ok
+                else "strategy DIVERGED from serial reference")
+        lines = [
+            f"{head}: {self.steps} step(s), "
+            f"max loss diff {max(self.loss_diffs) if self.loss_diffs else 0.0:.3g}, "
+            f"grad-norm diff {self.grad_norm_diff:.3g}, "
+            f"max param diff {self.max_param_diff:.3g} "
+            f"(rtol={self.rtol:g}, atol={self.atol:g})"
+        ]
+        if self.diverging_op:
+            lines.append(f"first diverging op: {self.diverging_op}")
+        if self.param_mismatches:
+            lines.append("param mismatches: "
+                         + ", ".join(self.param_mismatches[:5]))
+        if self.unmatched_weights:
+            lines.append(f"{len(self.unmatched_weights)} weight(s) had no "
+                         "name match between the searched and serial graphs "
+                         "(substitution renamed/merged them) and were "
+                         "excluded: "
+                         + ", ".join(self.unmatched_weights[:5]))
+        if self.validator_problems:
+            lines.append("structural validator: "
+                         + "; ".join(self.validator_problems[:5]))
+        return "\n".join(lines)
+
+
+def build_reference_executor(model):
+    """A fully-serial single-device executor for `model`'s layer list —
+    the ground truth the searched strategy is checked against. Re-lowers
+    the layers to a fresh PCG (no search, no parallel ops, degree 1
+    everywhere) exactly as compile() does before the strategy rewrite, so
+    op names line up with the searched graph's by construction."""
+    from ..parallel.executor import PCGExecutor
+    from ..parallel.mesh import build_mesh
+    from ..pcg.lowering import layers_to_pcg
+
+    if getattr(model, "executor", None) is None:
+        raise NotCompiledError("verify_strategy: compile() the model first")
+    graph, _ = layers_to_pcg(model.layers)
+    if model.config.perform_fusion:
+        from ..pcg.fusion import apply_fusion
+
+        graph = apply_fusion(graph)
+    mesh = build_mesh({"data": 1})
+    inputs = graph.input_tensors()
+    ordered = [inputs[i] for i in model._input_positions]
+    constants = {
+        inputs[i].guid: (inputs[i], v)
+        for i, v in model._constant_positions.items()
+    }
+    return PCGExecutor(
+        graph, mesh, model.optimizer, model.loss_type, model.metrics_obj,
+        compute_dtype=model.executor.compute_dtype,
+        grad_dtype=model.executor.grad_dtype,
+        seed=model.config.seed,
+        input_order=ordered,
+        constants=constants,
+    )
+
+
+def _host_params(params) -> Dict[str, Dict[str, np.ndarray]]:
+    import jax
+
+    return {
+        opn: {wn: np.asarray(jax.device_get(w)) for wn, w in wd.items()}
+        for opn, wd in params.items()
+    }
+
+
+def _copy_named_state(ex, params_host, net_host):
+    """Build a TrainState for executor `ex` whose weights/buffers are
+    name-matched copies of the given host trees (fresh optimizer state).
+    Returns (state, unmatched) — unmatched weights keep their fresh init
+    and are excluded from the comparison."""
+    import jax
+
+    from ..parallel.executor import TrainState
+
+    params = ex.init_params()
+    unmatched: List[str] = []
+    for opn, wd in params.items():
+        for wn, like in wd.items():
+            src = params_host.get(opn, {}).get(wn)
+            if src is None or tuple(src.shape) != tuple(like.shape):
+                unmatched.append(f"{opn}/{wn}")
+                continue
+            wd[wn] = jax.device_put(src.astype(like.dtype), like.sharding)
+    net = ex.init_net_state()
+    for opn, bufs in net.items():
+        for bn, like in bufs.items():
+            src = (net_host or {}).get(opn, {}).get(bn)
+            if src is not None and tuple(np.shape(src)) == tuple(like.shape):
+                bufs[bn] = jax.device_put(
+                    np.asarray(src).astype(like.dtype), like.sharding
+                )
+    return TrainState(params=params, opt_state=ex.optimizer.init_state(params),
+                      net_state=net), unmatched
+
+
+def _guard_free_step(ex):
+    """An UNDONATED, guard-free jitted train step for an executor —
+    verification must not consume the live state's buffers and must not
+    require guard extras in the signature."""
+    import jax
+
+    saved = ex.step_guard
+    ex.step_guard = None
+    try:
+        fn = ex._make_step()
+    finally:
+        ex.step_guard = saved
+    return jax.jit(fn)
+
+
+def _matched_compare_params(a_host, b_host, skip, rtol, atol):
+    """Name-matched param comparison. Returns (max_diff, mismatches)."""
+    worst = 0.0
+    bad: List[str] = []
+    for opn, wd in a_host.items():
+        for wn, va in wd.items():
+            key = f"{opn}/{wn}"
+            if key in skip:
+                continue
+            vb = b_host.get(opn, {}).get(wn)
+            if vb is None or tuple(vb.shape) != tuple(va.shape):
+                continue
+            d = _max_abs_diff(va, vb)
+            worst = max(worst, d) if np.isfinite(d) else float("inf")
+            if not np.allclose(va.astype(np.float64), vb.astype(np.float64),
+                               rtol=rtol, atol=atol, equal_nan=True):
+                bad.append(f"{key} (max|Δ|={d:.3g})")
+    return worst, bad
+
+
+def find_first_divergence(model, ref_ex, strat_state, ref_state, batch,
+                          *, rtol: float, atol: float) -> Optional[str]:
+    """Name the first PCG op whose forward output diverges between the
+    searched strategy and the serial reference, by bisecting over the
+    matched op prefix (both full forwards execute once; the bisection
+    probes cached intermediate outputs, so localization costs O(log n)
+    array comparisons, not n). None when every matched forward output
+    agrees — the divergence is then in the backward/optimizer step."""
+    ex = model.executor
+    if ex.pipeline_plan is not None:
+        return None  # stage internals live per-device; no op-level probe
+    bx = [ex.shard_batch(pt, np.asarray(a, pt.data_type.np_dtype))
+          for pt, a in zip(ex.input_pts, batch[:-1])]
+    bref = [ref_ex.shard_batch(pt, np.asarray(a, pt.data_type.np_dtype))
+            for pt, a in zip(ref_ex.input_pts, batch[:-1])]
+    # training=False: localization must not depend on dropout RNG streams,
+    # whose per-op fold-in indices differ when a substitution changed the
+    # compute-op count
+    vals_s = ex.apply(strat_state.params, ex._input_vals(bx),
+                      training=False, rng=None,
+                      net_state=strat_state.net_state)
+    vals_r = ref_ex.apply(ref_state.params, ref_ex._input_vals(bref),
+                          training=False, rng=None,
+                          net_state=ref_state.net_state)
+    ref_by_name = {}
+    for op in ref_ex.topo:
+        if not op.is_parallel_op and op.outputs:
+            ref_by_name[op.name] = op
+    matched = []
+    for op in ex.topo:
+        if op.is_parallel_op or not op.outputs:
+            continue
+        rop = ref_by_name.get(op.name)
+        if rop is None:
+            continue
+        if (tuple(op.outputs[0].material_shape())
+                != tuple(rop.outputs[0].material_shape())):
+            continue
+        matched.append((op, rop))
+    if not matched:
+        return None
+
+    def diverges(i: int) -> bool:
+        op, rop = matched[i]
+        a = np.asarray(vals_s[op.outputs[0].guid], np.float64)
+        b = np.asarray(vals_r[rop.outputs[0].guid], np.float64)
+        return not np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=True)
+
+    lo, hi = 0, len(matched) - 1
+    if not diverges(hi):
+        return None
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if diverges(mid):
+            hi = mid
+        else:
+            lo = mid + 1
+    op = matched[lo][0]
+    return f"{op.name} ({op.op_type.name})"
+
+
+def verify_strategy(model, data, *, steps: int = 2,
+                    batch_size: Optional[int] = None,
+                    rtol: Optional[float] = None,
+                    atol: Optional[float] = None,
+                    localize: bool = True,
+                    raise_on_divergence: bool = False,
+                    verbose: bool = False) -> StrategyVerdict:
+    """Differential verification of a compiled model's parallelization
+    strategy: run `steps` train steps of the searched/lowered strategy AND
+    a serial single-device reference from identical parameters, buffers
+    and RNG, and compare per-step loss, first-step global grad norm, and
+    final parameters under per-dtype tolerances (the model's compute
+    dtype picks the row; `rtol`/`atol` override).
+
+    `data` is ``(x, y)`` with x an array or list of arrays, exactly as
+    `fit` takes them. The model's live state is NOT advanced or mutated.
+    On divergence, `localize=True` bisects the PCG's matched op prefix to
+    name the first diverging op. `raise_on_divergence` turns a failed
+    verdict into StrategyDivergenceError — what
+    ``fit(verify_strategy="preflight")`` uses."""
+    import jax
+
+    if getattr(model, "executor", None) is None or model.state is None:
+        raise NotCompiledError("verify_strategy: compile() the model first")
+    ex = model.executor
+    x, y = data
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    bs = batch_size or model.config.batch_size
+    n = xs[0].shape[0]
+    if n < bs:
+        raise ValueError(
+            f"verify_strategy: dataset has {n} samples < batch_size {bs}"
+        )
+    # tolerance keyed by the model's COMPUTE dtype: mixed-precision math
+    # legitimately reorders bf16 roundoff across shardings
+    base = DTYPE_TOLERANCES["bfloat16" if ex.compute_dtype is not None
+                            else "float32"]
+    r = base[0] if rtol is None else rtol
+    t = base[1] if atol is None else atol
+
+    problems: List[str] = []
+    views = getattr(model, "searched_views", None)
+    if views:
+        from ..search import run_strategy_validators
+
+        problems = run_strategy_validators(
+            model.graph, views, model.executor.mesh.size
+        )
+
+    ref_ex = build_reference_executor(model)
+    params_host = _host_params(model.state.params)
+    net_host = {
+        opn: {bn: np.asarray(jax.device_get(b)) for bn, b in bufs.items()}
+        for opn, bufs in (model.state.net_state or {}).items()
+    }
+    from ..parallel.executor import TrainState, global_grad_norm
+
+    strat_state = TrainState(
+        params=model.state.params,
+        opt_state=ex.optimizer.init_state(model.state.params),
+        net_state=model.state.net_state,
+    )
+    ref_state, unmatched = _copy_named_state(ref_ex, params_host, net_host)
+    skip = set(unmatched)
+
+    # snapshots for divergence localization: the forward probe must run
+    # from IDENTICAL params (the pre-step states) — after K steps both
+    # sides have trained through different gradients, and every op
+    # downstream of a weight would look "diverged"
+    init_strat_state, init_ref_state = strat_state, ref_state
+    strat_step = _guard_free_step(ex)
+    ref_step = _guard_free_step(ref_ex)
+    label_dt = model.label_tensor.data_type.np_dtype
+
+    def batches():
+        nb = n // bs
+        for i in range(nb):
+            yield [a[i * bs:(i + 1) * bs] for a in list(xs) + [y]]
+
+    verdict = StrategyVerdict(ok=True, steps=0, rtol=r, atol=t,
+                              unmatched_weights=unmatched,
+                              validator_problems=problems)
+    key = jax.random.PRNGKey(model.config.seed + 7919)
+    first_batch = None
+    gnorm_diff = 0.0
+    it = batches()
+    for k in range(steps):
+        try:
+            batch = next(it)
+        except StopIteration:
+            it = batches()
+            batch = next(it)
+        if first_batch is None:
+            first_batch = batch
+        bx_s = [ex.shard_batch(pt, np.asarray(a, pt.data_type.np_dtype))
+                for pt, a in zip(ex.input_pts, batch[:-1])]
+        bx_r = [ref_ex.shard_batch(pt, np.asarray(a, pt.data_type.np_dtype))
+                for pt, a in zip(ref_ex.input_pts, batch[:-1])]
+        by_s = ex.put_replicated(np.asarray(batch[-1]).astype(label_dt))
+        by_r = ref_ex.put_replicated(np.asarray(batch[-1]).astype(label_dt))
+        key, sub = jax.random.split(key)
+        if k == 0:
+            # first-step global grad norms (one extra dispatch per side)
+            gs = ex.build_grad_step()
+            gr = ref_ex.build_grad_step()
+            g_s, _ = gs(strat_state.params, bx_s, by_s,
+                        strat_state.net_state)
+            g_r, _ = gr(ref_state.params, bx_r, by_r, ref_state.net_state)
+            n_s = float(np.asarray(global_grad_norm(g_s)))
+            n_r = float(np.asarray(global_grad_norm(g_r)))
+            gnorm_diff = abs(n_s - n_r)
+            if not np.isclose(n_s, n_r, rtol=r, atol=max(t, r * abs(n_r))):
+                verdict.ok = False
+        strat_state, p_s = strat_step(strat_state, bx_s, by_s,
+                                      ex.put_replicated(sub))
+        ref_state, p_r = ref_step(ref_state, bx_r, by_r,
+                                  ref_ex.put_replicated(sub))
+        loss_s = float(np.asarray(jax.device_get(p_s["loss"])))
+        loss_r = float(np.asarray(jax.device_get(p_r["loss"])))
+        verdict.loss_diffs.append(abs(loss_s - loss_r))
+        verdict.steps = k + 1
+        if not np.isclose(loss_s, loss_r, rtol=r,
+                          atol=max(t, r * abs(loss_r))):
+            verdict.ok = False
+    verdict.grad_norm_diff = gnorm_diff
+    a_host = _host_params(strat_state.params)
+    b_host = _host_params(ref_state.params)
+    verdict.max_param_diff, verdict.param_mismatches = \
+        _matched_compare_params(a_host, b_host, skip, r, t)
+    if verdict.param_mismatches:
+        verdict.ok = False
+    if not verdict.ok and localize and first_batch is not None:
+        verdict.diverging_op = find_first_divergence(
+            model, ref_ex, init_strat_state, init_ref_state, first_batch,
+            rtol=r, atol=t,
+        )
+    if verbose:
+        print("[verify] " + verdict.summary().replace("\n", "\n[verify] "))
+    if raise_on_divergence and not verdict.ok:
+        raise StrategyDivergenceError(
+            "searched strategy is NOT equivalent to the serial reference:\n"
+            + verdict.summary(),
+            diverging_op=verdict.diverging_op, verdict=verdict,
+        )
+    return verdict
+
+
+# ----------------------------------------------------------------------
+# structural strategy validator (registered with the search hook)
+# ----------------------------------------------------------------------
+def validate_searched_strategy(graph, views, num_devices: int) -> List[str]:
+    """Structural checks on a searched strategy: every MachineView must
+    address only live devices, and no tensor's total parallel degree may
+    exceed the device count. Registered as a default strategy validator
+    (search.register_strategy_validator) so compile() flags an insane
+    search result before it is lowered."""
+    from .elastic import validate_machine_views
+
+    problems = list(validate_machine_views(views or {}, num_devices))
+    for op in getattr(graph, "ops", []) or []:
+        for tensor in op.outputs:
+            degree = 1
+            for d in getattr(tensor, "dims", ()):
+                degree *= max(1, int(getattr(d, "degree", 1)))
+            if degree > num_devices:
+                problems.append(
+                    f"op {op.name}: output degree product {degree} exceeds "
+                    f"{num_devices} device(s)"
+                )
+    return problems
+
+
+def _main(argv: List[str]) -> int:
+    import json as _json
+
+    if not argv:
+        print("usage: python -m flexflow_tpu.runtime.verify "
+              "<checkpoint-path> [...]")
+        return 2
+    rc = 0
+    for p in argv:
+        rep = verify_checkpoint(p)
+        print(_json.dumps(rep, indent=2))
+        if not rep["ok"]:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_main(sys.argv[1:]))

@@ -15,3 +15,37 @@ from .substitution import (  # noqa: F401
     Substitution,
     generate_all_pcg_xfers,
 )
+
+# ----------------------------------------------------------------------
+# strategy-validator hook (runtime/verify.py registers the default)
+# ----------------------------------------------------------------------
+# Validators run over every search result before it is lowered: each is
+# called as fn(graph, views, num_devices) and returns a list of
+# human-readable violation strings (empty = fine). FFModel.compile()
+# warns on violations; the differential verifier
+# (runtime.verify.verify_strategy) folds them into its verdict.
+_STRATEGY_VALIDATORS: list = []
+
+
+def register_strategy_validator(fn):
+    """Register `fn(graph, views, num_devices) -> list[str]` to vet every
+    searched strategy. Returns `fn` so it works as a decorator."""
+    _STRATEGY_VALIDATORS.append(fn)
+    return fn
+
+
+def run_strategy_validators(graph, views, num_devices: int) -> list:
+    """Run every registered validator; concatenated violation strings."""
+    problems: list = []
+    for fn in list(_STRATEGY_VALIDATORS):
+        problems.extend(fn(graph, views, num_devices) or [])
+    return problems
+
+
+def _default_structural_validator(graph, views, num_devices):
+    from ..runtime.verify import validate_searched_strategy
+
+    return validate_searched_strategy(graph, views, num_devices)
+
+
+register_strategy_validator(_default_structural_validator)

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Numerical-trust sweep (ISSUE 3): the strategy-equivalence verifier over
+# searched model-zoo graphs on CPU meshes, plus the checkpoint-integrity
+# and SDC-canary fault-injection stories — including the
+# @pytest.mark.slow zoo sweep that tier-1 skips. The outer loop varies
+# the process-level device count so the differential verifier checks
+# searched strategies against genuinely different meshes, not just the
+# default 8-device one. Use before touching the search, the lowering,
+# the parallel ops, or the checkpoint/canary paths:
+#
+#   scripts/verify_check.sh                  # full sweep (8, 4-device meshes)
+#   FF_VERIFY_DEVICES=8 scripts/verify_check.sh -k strategy
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+devices="${FF_VERIFY_DEVICES:-8 4}"
+for n in $devices; do
+    echo "=== verify sweep: ${n}-device CPU mesh ==="
+    env JAX_PLATFORMS=cpu \
+        JAX_NUM_CPU_DEVICES="$n" \
+        XLA_FLAGS="--xla_force_host_platform_device_count=$n" \
+        python -m pytest tests/test_verify.py -v -p no:cacheprovider "$@"
+done

@@ -1,0 +1,573 @@
+"""Numerical-trust layer tests (runtime/verify.py): differential
+strategy-equivalence verification, checkpoint integrity checksums, the
+SDC/determinism canary, per-step invariants, and the typed-error /
+narrowed-except satellites.
+
+Everything runs on the 8-device CPU mesh; the broader strategy sweep is
+@pytest.mark.slow and runs standalone via scripts/verify_check.sh."""
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexflow_tpu import (
+    ActiMode,
+    CanaryConfig,
+    CanaryMismatchError,
+    CheckpointCorruptionError,
+    CheckpointManager,
+    DataType,
+    FFConfig,
+    FFModel,
+    FaultInjector,
+    InvariantViolationError,
+    LossType,
+    MetricsType,
+    NotCompiledError,
+    SGDOptimizer,
+    ServingConfigError,
+    StrategyDivergenceError,
+    verify_checkpoint,
+    verify_strategy,
+)
+from flexflow_tpu.runtime import verify as vfy
+from flexflow_tpu.runtime.checkpoint import (
+    _put_resharded,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+
+def small_model(hidden=16, layers=2, batch=8, search_budget=None,
+                features=4, classes=3):
+    cfg = FFConfig()
+    cfg.batch_size = batch
+    if search_budget is not None:
+        cfg.search_budget = search_budget
+    m = FFModel(cfg)
+    x = m.create_tensor((batch, features), DataType.DT_FLOAT)
+    t = x
+    for _ in range(layers - 1):
+        t = m.dense(t, hidden, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, classes)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.1),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    return m
+
+
+def dataset(n=64, seed=0, features=4, classes=3):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, features).astype(np.float32)
+    y = rng.randint(0, classes, (n, 1)).astype(np.int32)
+    return x, y
+
+
+def params_of(m):
+    return {
+        name: {k: np.array(v, copy=True) for k, v in wd.items()}
+        for name, wd in m.state.params.items()
+    }
+
+
+# ----------------------------------------------------------------------
+# checksum primitives
+# ----------------------------------------------------------------------
+def test_tensor_checksums_stable_and_sensitive():
+    tree = {"params": {"d": {"kernel": np.arange(12, dtype=np.float32)
+                             .reshape(3, 4)}},
+            "step": np.asarray(3)}
+    a = vfy.tensor_checksums(tree)
+    b = vfy.tensor_checksums(tree)
+    assert a == b
+    assert "params/d/kernel" in a
+    assert a["params/d/kernel"]["dtype"] == "float32"
+    assert a["params/d/kernel"]["shape"] == [3, 4]
+    tree["params"]["d"]["kernel"][0, 0] += 1
+    assert vfy.tensor_checksums(tree)["params/d/kernel"]["crc32"] \
+        != a["params/d/kernel"]["crc32"]
+    # None leaves (empty SGD momentum slots) are skipped, not hashed
+    assert "opt" not in vfy.tensor_checksums({"opt": None})
+
+
+def test_verify_checksums_names_the_corrupt_tensor():
+    tree = {"params": {"d": {"kernel": np.ones(4, np.float32),
+                             "bias": np.zeros(2, np.float32)}}}
+    integrity = {"algo": "crc32", "tensors": vfy.tensor_checksums(tree)}
+    vfy.verify_checksums(tree, integrity)  # intact: no raise
+    tree["params"]["d"]["bias"][0] = 7.0
+    with pytest.raises(CheckpointCorruptionError) as ei:
+        vfy.verify_checksums(tree, integrity, path="/x")
+    assert "params/d/bias" in str(ei.value)
+    assert ei.value.tensors == ["params/d/bias"]
+
+
+def test_bitflip_array_flips_exactly_one_bit():
+    a = np.zeros(8, np.float32)
+    b = vfy.bitflip_array(a, bit=6, index=3)
+    assert (a != b).sum() == 1
+    ab, bb = a.view(np.uint8), b.reshape(-1).view(np.uint8)
+    diff = np.nonzero(ab != bb)[0]
+    assert len(diff) == 1
+    assert bin(int(ab[diff[0]]) ^ int(bb[diff[0]])).count("1") == 1
+
+
+def test_fault_injector_fire_extras_matching():
+    fi = FaultInjector()
+    fi.inject("bitflip", at_step=3, target="disk")
+    fi.inject("bitflip", at_step=3)
+    # the state consumer (target=None) must not steal the disk plan
+    plan = fi.fire("bitflip", 3, target=None)
+    assert plan is not None and plan.get("target") is None
+    plan = fi.fire("bitflip", 3, target="disk")
+    assert plan is not None and plan["target"] == "disk"
+    assert fi.fire("bitflip", 3) is None  # both consumed
+
+
+# ----------------------------------------------------------------------
+# checkpoint integrity end to end
+# ----------------------------------------------------------------------
+def test_checkpoint_audit_and_corruption_detection(tmp_path):
+    m = small_model()
+    x, y = dataset()
+    path = str(tmp_path / "ck")
+    save_checkpoint(m, path, step=0)
+    rep = verify_checkpoint(path)
+    assert rep["ok"] and rep["has_integrity"] and rep["checked"] >= 4
+    corrupted = vfy.corrupt_checkpoint_tensor(path)
+    rep2 = verify_checkpoint(path)
+    assert not rep2["ok"]
+    assert rep2["corrupt"] and corrupted.endswith(rep2["corrupt"][0]
+                                                 .split("/", 1)[-1])
+    m2 = small_model()
+    with pytest.raises(CheckpointCorruptionError) as ei:
+        restore_checkpoint(m2, path)
+    assert rep2["corrupt"][0] in str(ei.value)
+
+
+def test_restore_latest_falls_back_past_corrupt_newest(tmp_path):
+    d = str(tmp_path / "ckpts")
+    m = small_model()
+    x, y = dataset()
+    m.fit(x, y, epochs=2, verbose=False, checkpoint_dir=d,
+          checkpoint_every_n_steps=4, resume=False)
+    mgr = CheckpointManager(d)
+    steps = mgr.list_steps()
+    assert len(steps) >= 2
+    vfy.corrupt_checkpoint_tensor(mgr.step_path(steps[-1]))
+    m2 = small_model()
+    with pytest.warns(UserWarning, match="falling back"):
+        info = mgr.restore_latest(m2)
+    assert info is not None and info.step == steps[-2]
+
+
+def test_bitflip_disk_site_caught_by_checksum_on_restore(tmp_path):
+    """Acceptance: FaultInjector(site='bitflip', target='disk') corrupts a
+    just-written checkpoint AFTER its checksums were recorded; the
+    restore-time integrity gate catches it and restore_latest falls back
+    to the previous intact checkpoint."""
+    d = str(tmp_path / "ckpts")
+    m = small_model()
+    x, y = dataset()
+    # 16 total steps; cadence 5 -> saves at 5, 10, 15 and the final
+    # done-save at 16. Arm the flip for step 16 so the NEWEST checkpoint
+    # on disk is the corrupt one.
+    fi = FaultInjector()
+    fi.inject("bitflip", at_step=16, target="disk")
+    m.fit(x, y, epochs=2, verbose=False, checkpoint_dir=d,
+          checkpoint_every_n_steps=5, resume=False, fault_injector=fi)
+    assert fi.fired.get("bitflip") == 1
+    mgr = CheckpointManager(d)
+    assert not verify_checkpoint(mgr.step_path(16))["ok"]
+    m2 = small_model()
+    with pytest.warns(UserWarning, match="falling back"):
+        info = mgr.restore_latest(m2)
+    assert info is not None and info.step == 15
+
+
+def test_old_checkpoints_without_integrity_still_restore(tmp_path):
+    import json
+
+    m = small_model()
+    path = str(tmp_path / "ck")
+    save_checkpoint(m, path, step=0)
+    meta_path = path + ".meta.json"
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta.pop("integrity")
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    rep = verify_checkpoint(path)
+    assert rep["ok"] and not rep["has_integrity"]
+    m2 = small_model()
+    restore_checkpoint(m2, path)  # no raise
+
+
+# ----------------------------------------------------------------------
+# SDC / determinism canary
+# ----------------------------------------------------------------------
+def test_canary_clean_run_matches_uncanaried_training():
+    x, y = dataset()
+    a = small_model()
+    a.fit(x, y, epochs=1, verbose=False)
+    b = small_model()
+    b.fit(x, y, epochs=1, verbose=False,
+          canary=CanaryConfig(every_n_steps=2, mode="determinism"))
+    pa, pb = params_of(a), params_of(b)
+    for name, wd in pa.items():
+        for k, v in wd.items():
+            np.testing.assert_allclose(pb[name][k], v, atol=1e-6,
+                                       err_msg=f"{name}/{k}")
+
+
+def test_canary_catches_bitflip_and_checkpoints(tmp_path):
+    """Acceptance: the SDC canary catches a mid-run bitflip; escalation
+    reverts to the pre-step state and flushes it as a checkpoint."""
+    d = str(tmp_path / "ckpts")
+    m = small_model()
+    x, y = dataset()
+    fi = FaultInjector()
+    fi.inject("bitflip", at_step=4)
+    with pytest.raises(CanaryMismatchError) as ei:
+        m.fit(x, y, epochs=2, verbose=False, checkpoint_dir=d,
+              checkpoint_every_n_steps=100, resume=False,
+              fault_injector=fi,
+              canary=CanaryConfig(every_n_steps=2, mode="determinism"))
+    assert ei.value.step == 4
+    assert ei.value.mismatches
+    assert ei.value.checkpoint_path is not None
+    assert os.path.isdir(ei.value.checkpoint_path)
+    # the flushed checkpoint is the PRE-step (trusted) state and intact
+    assert verify_checkpoint(ei.value.checkpoint_path)["ok"]
+
+
+def test_canary_sdc_mode_catches_exponent_flip():
+    m = small_model()
+    x, y = dataset()
+    fi = FaultInjector()
+    fi.inject("bitflip", at_step=2, bit=6, index=3)  # exponent bit
+    with pytest.raises(CanaryMismatchError):
+        m.fit(x, y, epochs=1, verbose=False, fault_injector=fi,
+              canary=CanaryConfig(every_n_steps=2, mode="sdc"))
+
+
+def test_invariant_loss_delta_escalates(tmp_path):
+    m = small_model()
+    x, y = dataset()
+    with pytest.raises(InvariantViolationError) as ei:
+        m.fit(x, y, epochs=1, verbose=False,
+              checkpoint_dir=str(tmp_path / "ck"),
+              canary=CanaryConfig(every_n_steps=0, max_loss_delta=0.0))
+    assert ei.value.invariant == "loss_delta"
+    assert ei.value.checkpoint_path is not None
+
+
+def test_canary_config_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="mode"):
+        CanaryConfig(mode="paranoid")
+
+
+# ----------------------------------------------------------------------
+# differential strategy verifier
+# ----------------------------------------------------------------------
+def test_verify_strategy_searched_mlp():
+    m = small_model(hidden=32, batch=32, search_budget=4, layers=3)
+    x, y = dataset(n=64)
+    v = verify_strategy(m, (x, y), steps=2, batch_size=32)
+    assert v.ok, v.summary()
+    assert v.steps == 2
+    assert not v.param_mismatches
+
+
+def test_verify_strategy_searched_cnn():
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.search_budget = 3
+    m = FFModel(cfg)
+    x = m.create_tensor((8, 3, 16, 16), DataType.DT_FLOAT)
+    t = m.conv2d(x, 8, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = m.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = m.flat(t)
+    t = m.dense(t, 4)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.05),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    rng = np.random.RandomState(0)
+    xc = rng.randn(16, 3, 16, 16).astype(np.float32)
+    yc = rng.randint(0, 4, (16, 1)).astype(np.int32)
+    v = verify_strategy(m, (xc, yc), steps=2)
+    assert v.ok, v.summary()
+
+
+def test_verify_strategy_searched_attention():
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.search_budget = 3
+    m = FFModel(cfg)
+    x = m.create_tensor((8, 16, 32), DataType.DT_FLOAT)
+    t = m.multihead_attention(x, x, x, 32, 4)
+    t = m.dense(t, 32, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, 4)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.05),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    rng = np.random.RandomState(0)
+    xa = rng.randn(16, 16, 32).astype(np.float32)
+    ya = rng.randint(0, 4, (16, 16, 1)).astype(np.int32)
+    v = verify_strategy(m, (xa, ya), steps=2)
+    assert v.ok, v.summary()
+
+
+def _break_activation(m):
+    """Simulate a broken substitution: the rewrite 'lost' an activation."""
+    for op in m.graph.ops:
+        if (op.op_type.name == "OP_LINEAR"
+                and getattr(op.params, "activation", None)
+                == ActiMode.AC_MODE_RELU):
+            op.params = dataclasses.replace(
+                op.params, activation=ActiMode.AC_MODE_NONE
+            )
+            return op.name
+    raise AssertionError("no relu dense op to break")
+
+
+def test_verify_strategy_names_broken_substitution_op():
+    """Acceptance: a deliberately-broken substitution (dropped activation)
+    must fail verification naming the diverging op."""
+    m = small_model(hidden=32, batch=32, search_budget=4, layers=3)
+    x, y = dataset(n=64)
+    broken = _break_activation(m)
+    m.executor.invalidate_step_cache()
+    v = verify_strategy(m, (x, y), steps=2, batch_size=32)
+    assert not v.ok
+    assert v.diverging_op is not None and broken in v.diverging_op
+
+
+def test_verify_strategy_names_wrong_reduction_axis():
+    """Acceptance: a wrong reduction axis (softmax over the batch axis
+    instead of the class axis) fails verification naming the op."""
+    m = small_model(hidden=32, batch=32, search_budget=4)
+    x, y = dataset(n=64)
+    soft = [op for op in m.graph.ops if op.op_type.name == "OP_SOFTMAX"]
+    assert soft
+    soft[0].params = dataclasses.replace(soft[0].params, dim=0)
+    m.executor.invalidate_step_cache()
+    v = verify_strategy(m, (x, y), steps=2, batch_size=32)
+    assert not v.ok
+    assert v.diverging_op is not None and soft[0].name in v.diverging_op
+
+
+def test_fit_preflight_verification(tmp_path):
+    m = small_model(hidden=16, batch=8, search_budget=3)
+    x, y = dataset()
+    m.fit(x, y, epochs=1, verbose=False, verify_strategy="preflight")
+    m2 = small_model(hidden=16, batch=8, search_budget=3)
+    _break_activation(m2)
+    m2.executor.invalidate_step_cache()
+    with pytest.raises(StrategyDivergenceError) as ei:
+        m2.fit(x, y, epochs=1, verbose=False, verify_strategy="preflight")
+    assert ei.value.diverging_op is not None
+
+
+def test_verify_strategy_does_not_mutate_live_state():
+    m = small_model(hidden=16, batch=8)
+    x, y = dataset()
+    before = params_of(m)
+    verify_strategy(m, (x, y), steps=2)
+    after = params_of(m)
+    for name, wd in before.items():
+        for k, v in wd.items():
+            np.testing.assert_array_equal(after[name][k], v)
+
+
+def test_fit_rejects_unknown_verify_mode():
+    m = small_model()
+    x, y = dataset()
+    with pytest.raises(ValueError, match="preflight"):
+        m.fit(x, y, epochs=1, verbose=False, verify_strategy="postflight")
+
+
+# ----------------------------------------------------------------------
+# strategy-validator hook
+# ----------------------------------------------------------------------
+def test_strategy_validator_hook_runs_on_compile():
+    from flexflow_tpu import search as search_mod
+
+    seen = []
+
+    def probe(graph, views, ndev):
+        seen.append((len(graph.ops), ndev))
+        return []
+
+    search_mod.register_strategy_validator(probe)
+    try:
+        small_model(hidden=16, batch=8, search_budget=2)
+    finally:
+        search_mod._STRATEGY_VALIDATORS.remove(probe)
+    assert seen and seen[0][1] >= 1
+
+
+def test_validate_searched_strategy_flags_dead_devices():
+    from flexflow_tpu.pcg.machine_view import MachineView
+
+    m = small_model(hidden=16, batch=8, search_budget=2)
+    views = dict(getattr(m, "searched_views", {}) or {})
+    views[999] = MachineView(start_device_id=6, dim=(4,), stride=(1,))
+    problems = vfy.validate_searched_strategy(m.graph, views, 4)
+    assert any("999" in p for p in problems)
+
+
+# ----------------------------------------------------------------------
+# satellites: _put_resharded / _restore_report coverage
+# ----------------------------------------------------------------------
+def test_put_resharded_keeps_sharding_when_divisible():
+    m = small_model()
+    like = m.state.params["op_linear_0"]["kernel"]
+    arr = np.random.RandomState(0).randn(*like.shape).astype(np.float32)
+    out = _put_resharded(arr, like)
+    assert out.sharding == like.sharding
+    np.testing.assert_allclose(np.asarray(out), arr, atol=0)
+
+
+def test_put_resharded_replicates_uneven_shapes(caplog):
+    """An elastic restore can land a shard count the live mesh doesn't
+    divide — the data must still arrive (replicated), with a warning."""
+    import logging
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    m = small_model()
+    mesh = m.executor.mesh
+    axis = mesh.axis_names[0]
+    sharded_like = jax.device_put(
+        np.zeros((mesh.shape[axis] * 2, 3), np.float32),
+        NamedSharding(mesh, PartitionSpec(axis)),
+    )
+    # uneven last-shard shape: 6 rows across an 8-way axis
+    arr = np.arange(6 * 3, dtype=np.float32).reshape(6, 3)
+    with caplog.at_level(logging.WARNING,
+                         logger="flexflow_tpu.runtime.checkpoint"):
+        out = _put_resharded(arr, sharded_like)
+    assert "replicating" in caplog.text
+    np.testing.assert_allclose(np.asarray(out), arr, atol=0)
+    spec = out.sharding.spec
+    assert all(s is None for s in spec), spec
+
+
+def test_restore_report_unmatched_tensor_paths(tmp_path):
+    # checkpoint from a 3-layer model, restored into a 2-layer model:
+    # the checkpoint's extra op lands in unmatched_checkpoint
+    big = small_model(layers=3)
+    path = str(tmp_path / "ck")
+    save_checkpoint(big, path, step=0)
+    small = small_model(layers=2)
+    restore_checkpoint(small, path, strict_topology=False)
+    rep = small._restore_report
+    assert any("op_linear_2" in n for n in rep["unmatched_checkpoint"])
+    # and the reverse: a model op missing from the checkpoint keeps its
+    # fresh init and lands in unmatched_model
+    small2 = small_model(layers=2)
+    fresh = params_of(small2)
+    path2 = str(tmp_path / "ck2")
+    save_checkpoint(small2, path2, step=0)
+    big2 = small_model(layers=3)
+    restore_checkpoint(big2, path2, strict_topology=False)
+    rep2 = big2._restore_report
+    assert any("op_linear_2" in n for n in rep2["unmatched_model"])
+    got = params_of(big2)
+    for k, v in fresh["op_linear_0"].items():
+        np.testing.assert_allclose(got["op_linear_0"][k], v, atol=1e-7)
+
+
+# ----------------------------------------------------------------------
+# satellites: typed errors replace bare asserts
+# ----------------------------------------------------------------------
+def test_uncompiled_apis_raise_not_compiled_error():
+    m = FFModel(FFConfig())
+    m.create_tensor((8, 4), DataType.DT_FLOAT)
+    with pytest.raises(NotCompiledError):
+        save_checkpoint(m, "/tmp/never-written")
+    with pytest.raises(NotCompiledError):
+        restore_checkpoint(m, "/tmp/never-written")
+    with pytest.raises(NotCompiledError):
+        m.fit(np.zeros((8, 4), np.float32), np.zeros((8, 1), np.int32),
+              verbose=False)
+    from flexflow_tpu import BatchScheduler
+
+    with pytest.raises(NotCompiledError):
+        BatchScheduler(m)
+    from flexflow_tpu.runtime.serving import greedy_generate
+
+    with pytest.raises(NotCompiledError):
+        greedy_generate(m, np.zeros((8, 4), np.int32))
+
+
+def test_serving_config_errors_are_typed():
+    from flexflow_tpu.runtime.serving import incremental_generate
+
+    m = small_model()
+    with pytest.raises(ServingConfigError, match="max_len"):
+        incremental_generate(m, np.zeros((8, 4), np.int32),
+                             max_new_tokens=100, max_len=8)
+
+
+# ----------------------------------------------------------------------
+# slow sweep: model-zoo strategies at a larger search budget
+# ----------------------------------------------------------------------
+@pytest.mark.slow
+def test_verify_strategy_zoo_sweep():
+    """scripts/verify_check.sh entry: the equivalence sweep at a larger
+    budget, covering deeper zoo-shaped graphs than the tier-1 trio."""
+    cases = []
+
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    cfg.search_budget = 8
+    m = FFModel(cfg)
+    x = m.create_tensor((16, 64), DataType.DT_FLOAT)
+    t = m.dense(x, 128, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, 64, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, 10)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.05),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    rng = np.random.RandomState(0)
+    cases.append((m, rng.randn(32, 64).astype(np.float32),
+                  rng.randint(0, 10, (32, 1)).astype(np.int32)))
+
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.search_budget = 8
+    m = FFModel(cfg)
+    x = m.create_tensor((8, 3, 32, 32), DataType.DT_FLOAT)
+    t = m.conv2d(x, 16, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = m.conv2d(t, 16, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = m.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = m.flat(t)
+    t = m.dense(t, 10)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.05),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    cases.append((m, rng.randn(16, 3, 32, 32).astype(np.float32),
+                  rng.randint(0, 10, (16, 1)).astype(np.int32)))
+
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.search_budget = 8
+    m = FFModel(cfg)
+    x = m.create_tensor((8, 32, 64), DataType.DT_FLOAT)
+    t = m.transformer_blocks(x, hidden_size=64, num_heads=8, num_layers=2)
+    t = m.dense(t, 10)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.05),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    cases.append((m, rng.randn(16, 32, 64).astype(np.float32),
+                  rng.randint(0, 10, (16, 32, 1)).astype(np.int32)))
+
+    for model, xd, yd in cases:
+        v = verify_strategy(model, (xd, yd), steps=3)
+        assert v.ok, v.summary()
