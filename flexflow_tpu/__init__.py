@@ -48,6 +48,15 @@ from .runtime.verify import (  # noqa: F401
     verify_checkpoint,
     verify_strategy,
 )
+from .analysis import (  # noqa: F401
+    AnalysisReport,
+    Diagnostic,
+    Severity,
+    StaticAnalysisError,
+    analyze_graph,
+    analyze_model,
+)
+from .search.substitution_loader import SubstitutionRuleError  # noqa: F401
 from .core.optimizers import AdamOptimizer, Optimizer, SGDOptimizer  # noqa: F401
 from .core.tensor import Layer, Tensor  # noqa: F401
 from .ff_types import (  # noqa: F401

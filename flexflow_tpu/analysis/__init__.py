@@ -1,0 +1,137 @@
+"""Static PCG analysis framework.
+
+Whole-graph static analysis over the parallel computation graph with a
+typed diagnostic model (`Diagnostic(severity, code, op_guid, message,
+fix_hint)`) and composable passes:
+
+  structure    — wiring/validity/acyclicity (backs Graph.check_correctness)
+  sharding     — shape/dtype/degree re-derivation vs declared tensors
+  collectives  — implied-collective consistency (order, axes, views)
+  memory       — static per-device HBM-fit from material shapes
+  rules        — substitution-rule soundness (substitution_lint)
+
+Entry points: `analyze_graph` (a graph + optional views), `analyze_model`
+(a compiled FFModel), `analyze_rules_path` (a substitution JSON), and the
+CLI `python -m flexflow_tpu.analysis`. The analyzer is wired into
+`compile()` through `search.register_strategy_validators`, and into
+training through `fit(lint="error"|"warn"|"off")`.
+
+Design goal: reject malformed strategies, deadlocking collective
+schedules, and OOM-by-construction machine views *before any device time
+is spent* — the static counterpart of runtime/verify.py's differential
+verifier.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from .collectives import collective_diagnostics  # noqa: F401
+from .diagnostics import (  # noqa: F401
+    AnalysisReport,
+    Diagnostic,
+    Severity,
+    StaticAnalysisError,
+)
+from .memory import (  # noqa: F401
+    estimate_per_device_bytes,
+    memory_diagnostics,
+    training_weight_multiplier,
+)
+from .sharding import sharding_diagnostics  # noqa: F401
+from .structure import graph_is_wellformed, structural_diagnostics  # noqa: F401
+from .substitution_lint import (  # noqa: F401
+    analyze_rules_path,
+    lint_rule,
+    lint_rules,
+)
+
+ALL_PASSES = ("structure", "sharding", "collectives", "memory")
+
+
+def analyze_graph(
+    graph,
+    views: Optional[Dict] = None,
+    num_devices: Optional[int] = None,
+    *,
+    hbm_bytes: Optional[int] = None,
+    optimizer=None,
+    train: bool = True,
+    grad_bytes_ratio: float = 1.0,
+    passes: Sequence[str] = ALL_PASSES,
+) -> AnalysisReport:
+    """Run the selected analysis passes over a PCG.
+
+    views: op guid -> MachineView (a search result's `.views`); ops fall
+    back to their own `machine_view`, then to whole-mesh placement.
+    num_devices: live device count (enables view-bounds and degree-
+    product checks). hbm_bytes: per-device budget for the memory pass.
+    """
+    rep = AnalysisReport()
+    if "structure" in passes:
+        rep.extend(structural_diagnostics(graph))
+        if not rep.ok:
+            # downstream passes assume a well-formed graph; inference over
+            # a dangling/cyclic graph would only produce noise
+            return rep
+    if "sharding" in passes:
+        rep.extend(sharding_diagnostics(graph, num_devices=num_devices))
+    if "collectives" in passes:
+        rep.extend(collective_diagnostics(graph, views=views,
+                                          num_devices=num_devices))
+    if "memory" in passes:
+        mem_rep, _ = memory_diagnostics(
+            graph, views=views, num_devices=num_devices or 1,
+            hbm_bytes=hbm_bytes, train=train, optimizer=optimizer,
+            grad_bytes_ratio=grad_bytes_ratio,
+        )
+        rep.extend(mem_rep)
+    return rep
+
+
+def analyze_model(model, *, passes: Sequence[str] = ALL_PASSES,
+                  hbm_bytes: Optional[int] = None) -> AnalysisReport:
+    """Analyze a compiled FFModel: its (possibly searched) PCG, the
+    searched machine views, the live device count, and the configured
+    per-chip HBM budget."""
+    import jax
+
+    graph = model.graph
+    if graph is None:
+        from ..runtime.verify import NotCompiledError
+
+        raise NotCompiledError("analyze_model: call compile() first")
+    ndev = min(model.config.numWorkers, len(jax.devices()))
+    if hbm_bytes is None:
+        hbm_bytes = model.config.device_mem or None
+        if hbm_bytes is None:
+            try:
+                hbm_bytes = model._build_cost_model().machine.chip.hbm_capacity
+            except Exception as e:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "analyze_model: no machine model for the HBM budget "
+                    "(%s); skipping the memory-fit check", e)
+                hbm_bytes = None
+    return analyze_graph(
+        graph,
+        views=getattr(model, "searched_views", None),
+        num_devices=ndev,
+        hbm_bytes=hbm_bytes,
+        optimizer=model.optimizer,
+        train=model._is_training_compile(),
+        grad_bytes_ratio=model._grad_bytes_ratio(),
+        passes=passes,
+    )
+
+
+def strategy_violations(graph, views, num_devices: int) -> list:
+    """Adapter for the `search.register_strategy_validators` hook:
+    ERROR-severity diagnostics as violation strings. The memory pass is
+    excluded here (the hook has no budget context); compile-time memory
+    vetting goes through the memory-aware search / fit(lint=...)."""
+    rep = analyze_graph(
+        graph, views=views, num_devices=num_devices,
+        passes=("structure", "sharding", "collectives"),
+    )
+    return [d.format() for d in rep.errors]

@@ -1,0 +1,59 @@
+"""CLI for the static analyzer.
+
+    python -m flexflow_tpu.analysis                  # lint the shipped
+                                                     # substitution collection
+    python -m flexflow_tpu.analysis rules a.json b.json
+
+Graph-level analysis has no file format to read from the CLI; it runs
+in-process via `flexflow_tpu.analysis.analyze_graph` / `analyze_model`
+and through `fit(lint=...)`. Exit codes: 0 clean, 1 ERROR diagnostics
+found, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from . import analyze_rules_path
+from .diagnostics import Severity
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m flexflow_tpu.analysis",
+        description="Static PCG / substitution-rule analyzer",
+    )
+    p.add_argument("command", nargs="?", default="rules",
+                   choices=["rules"],
+                   help="what to analyze (default: rules)")
+    p.add_argument("paths", nargs="*",
+                   help="substitution-rule JSON files (default: the "
+                        "shipped collection)")
+    p.add_argument("--quiet", action="store_true",
+                   help="only print errors")
+    args = p.parse_args(argv)
+
+    paths = args.paths
+    if not paths:
+        from ..search.substitution_loader import default_rules_path
+
+        paths = [default_rules_path()]
+
+    rc = 0
+    for path in paths:
+        rep = analyze_rules_path(path)
+        n_err = len(rep.errors)
+        print(f"== {path}: {n_err} error(s), {len(rep.warnings)} "
+              f"warning(s)")
+        for d in rep:
+            if args.quiet and d.severity is not Severity.ERROR:
+                continue
+            print("  " + d.format())
+        if n_err:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,109 @@
+"""Static per-device HBM-fit analysis.
+
+Computes a peak per-device memory estimate for a placed strategy from
+material tensor shapes alone — no simulator profiling, no device time:
+each op's shard bytes (inputs + outputs as the backward residual stash,
+weights under the training multiplier `1 + grad_ratio +
+optimizer.state_slots_per_weight()`) land on the devices of its
+MachineView (or on every device when unplaced, i.e. replicated SPMD).
+Strategies that cannot fit are rejected before the simulator or the
+executor ever touches them.
+
+Codes: FFA301 over budget (error), FFA302 usage report (info).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from .diagnostics import AnalysisReport, Severity
+
+
+def training_weight_multiplier(optimizer=None,
+                               grad_bytes_ratio: float = 1.0) -> float:
+    """Weight-sized allocations held per parameter during training
+    (mirrors search.memory_optimization.weight_bytes_multiplier, without
+    importing the search stack): master weight + gradient buffer +
+    optimizer state slots."""
+    slots = 0
+    if optimizer is not None:
+        get = getattr(optimizer, "state_slots_per_weight", None)
+        slots = get() if get is not None else 0
+    return 1.0 + grad_bytes_ratio + slots
+
+
+def _shard_bytes(t) -> int:
+    deg = max(1, t.get_total_degree())
+    return (t.get_volume() // deg) * t.data_type.size
+
+
+def estimate_per_device_bytes(
+    graph,
+    views: Optional[Dict] = None,
+    num_devices: int = 1,
+    *,
+    train: bool = True,
+    optimizer=None,
+    grad_bytes_ratio: float = 1.0,
+) -> Dict[int, int]:
+    """device id -> estimated peak bytes for the placed strategy."""
+    views = views or {}
+    wmul = (training_weight_multiplier(optimizer, grad_bytes_ratio)
+            if train else 1.0)
+    per_dev: Dict[int, int] = {}
+    all_devs = list(range(max(1, num_devices)))
+    for op in graph.ops:
+        act = sum(_shard_bytes(t) for t in op.inputs)
+        act += sum(_shard_bytes(t) for t in op.outputs)
+        wb = int(sum(_shard_bytes(w) for w in op.weights) * wmul)
+        view = views.get(op.guid) or op.machine_view
+        devs = view.device_ids() if view is not None else all_devs
+        share = act + wb
+        for d in devs:
+            per_dev[d] = per_dev.get(d, 0) + share
+    return per_dev
+
+
+def memory_diagnostics(
+    graph,
+    views: Optional[Dict] = None,
+    num_devices: int = 1,
+    hbm_bytes: Optional[int] = None,
+    *,
+    train: bool = True,
+    optimizer=None,
+    grad_bytes_ratio: float = 1.0,
+) -> Tuple[AnalysisReport, Dict[int, int]]:
+    rep = AnalysisReport()
+    per_dev = estimate_per_device_bytes(
+        graph, views, num_devices, train=train, optimizer=optimizer,
+        grad_bytes_ratio=grad_bytes_ratio,
+    )
+    if not per_dev:
+        return rep, per_dev
+    peak_dev = max(per_dev, key=per_dev.get)
+    peak = per_dev[peak_dev]
+    mib = 1024.0 ** 2
+    if hbm_bytes:
+        rep.add(
+            Severity.INFO, "FFA302",
+            f"static peak HBM estimate: {peak / mib:.1f} MiB on device "
+            f"{peak_dev} (budget {hbm_bytes / mib:.1f} MiB, "
+            f"{len(per_dev)} device(s) used)",
+        )
+        if peak > hbm_bytes:
+            rep.add(
+                Severity.ERROR, "FFA301",
+                f"strategy cannot fit: device {peak_dev} needs "
+                f"{peak / mib:.1f} MiB of {hbm_bytes / mib:.1f} MiB HBM "
+                "(weights x (1 + grad + optimizer slots) + activation "
+                "stash, from material shapes)",
+                fix_hint="shard further / add devices, enable "
+                         "perform_memory_search, or reduce batch size",
+            )
+    else:
+        rep.add(
+            Severity.INFO, "FFA302",
+            f"static peak HBM estimate: {peak / mib:.1f} MiB on device "
+            f"{peak_dev} ({len(per_dev)} device(s) used; no budget given)",
+        )
+    return rep, per_dev

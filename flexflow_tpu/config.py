@@ -115,7 +115,7 @@ class FFConfig:
                 # multi-host (runtime/distributed.py): one "node" per
                 # process, like the reference's one-Legion-rank-per-host
                 self.numNodes = max(1, jax.process_count())
-            except Exception:  # pragma: no cover
+            except Exception:  # pragma: no cover  # fflint: disable=FFL002
                 pass
         argv = sys.argv[1:]
         if argv:

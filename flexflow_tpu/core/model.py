@@ -1267,11 +1267,33 @@ class FFModel:
         health_monitor=None,
         verify_strategy=None,
         canary=None,
+        lint: Optional[str] = None,
     ):
         if self.executor is None:
             from ..runtime.verify import NotCompiledError
 
             raise NotCompiledError("fit: call compile() first")
+        if lint not in (None, "off", "warn", "error"):
+            raise ValueError(
+                'fit(lint=...) accepts "error", "warn", or "off" '
+                f"(got {lint!r})"
+            )
+        if lint in ("warn", "error"):
+            # static preflight (analysis/): shape/sharding inference,
+            # collective consistency, and HBM-fit over the compiled PCG —
+            # rejects a broken strategy before ANY device time is spent
+            # (the differential verify_strategy preflight below still
+            # needs 2 real steps)
+            from ..analysis import StaticAnalysisError, analyze_model
+
+            report = analyze_model(self)
+            if not report.ok:
+                if lint == "error":
+                    raise StaticAnalysisError(report)
+                warnings.warn("static analysis found problems "
+                              "(fit(lint='warn')):\n" + report.summary())
+            elif verbose and len(report):
+                print(f"[analysis] {report!r}")
         x, y = _unwrap_loaders(x, y)
         xs = x if isinstance(x, (list, tuple)) else [x]
         bs = batch_size or self.config.batch_size
@@ -1452,7 +1474,7 @@ class FFModel:
         try:
             if jnp.issubdtype(self._rng.dtype, jax.dtypes.prng_key):
                 arr = jax.random.wrap_key_data(arr)
-        except Exception:
+        except Exception:  # fflint: disable=FFL002 — old jax: raw uint32 key
             pass
         self._rng = arr
 

@@ -63,5 +63,5 @@ class NativeDataLoader:
             if getattr(self, "_handle", None):
                 self._lib.ffdl_destroy(self._handle)
                 self._handle = None
-        except Exception:
+        except Exception:  # fflint: disable=FFL002 — best-effort destructor
             pass

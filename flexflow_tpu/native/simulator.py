@@ -122,5 +122,5 @@ class NativeSimulator:
             if getattr(self, "_handle", None):
                 self._lib.ffsim_destroy(self._handle)
                 self._handle = None
-        except Exception:
+        except Exception:  # fflint: disable=FFL002 — best-effort destructor
             pass

@@ -115,12 +115,14 @@ class Graph:
 
     def check_correctness(self) -> bool:
         """reference: Graph::check_correctness — every op input either comes
-        from another op or is a graph input; shapes valid."""
-        for op in self.ops:
-            for t in op.outputs:
-                if not t.check_valid():
-                    return False
-        return True
+        from another op or is a graph input; every tensor produced at most
+        once; shapes valid; graph acyclic. Delegates to the static
+        analyzer's structure pass (analysis/structure.py), which names the
+        violation when one wants the details (the search only needs the
+        boolean gate)."""
+        from ..analysis.structure import graph_is_wellformed
+
+        return graph_is_wellformed(self)
 
     def hash(self) -> int:
         """Structural hash (reference: Graph::hash used in dp_state_hash).

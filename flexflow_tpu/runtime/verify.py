@@ -488,8 +488,12 @@ def build_reference_executor(model):
 def _host_params(params) -> Dict[str, Dict[str, np.ndarray]]:
     import jax
 
+    # np.array(copy=True), NOT np.asarray: device_get on the CPU backend
+    # returns zero-copy views into live buffers, and these snapshots must
+    # survive later donated train-step dispatches (tools/fflint.py FFL101)
     return {
-        opn: {wn: np.asarray(jax.device_get(w)) for wn, w in wd.items()}
+        opn: {wn: np.array(jax.device_get(w), copy=True)
+              for wn, w in wd.items()}
         for opn, wd in params.items()
     }
 
@@ -672,7 +676,8 @@ def verify_strategy(model, data, *, steps: int = 2,
     ref_ex = build_reference_executor(model)
     params_host = _host_params(model.state.params)
     net_host = {
-        opn: {bn: np.asarray(jax.device_get(b)) for bn, b in bufs.items()}
+        opn: {bn: np.array(jax.device_get(b), copy=True)
+              for bn, b in bufs.items()}
         for opn, bufs in (model.state.net_state or {}).items()
     }
     from ..parallel.executor import TrainState, global_grad_norm
@@ -737,8 +742,8 @@ def verify_strategy(model, data, *, steps: int = 2,
                                       ex.put_replicated(sub))
         ref_state, p_r = ref_step(ref_state, bx_r, by_r,
                                   ref_ex.put_replicated(sub))
-        loss_s = float(np.asarray(jax.device_get(p_s["loss"])))
-        loss_r = float(np.asarray(jax.device_get(p_r["loss"])))
+        loss_s = float(jax.device_get(p_s["loss"]))
+        loss_r = float(jax.device_get(p_r["loss"]))
         verdict.loss_diffs.append(abs(loss_s - loss_r))
         verdict.steps = k + 1
         if not np.isclose(loss_s, loss_r, rtol=r,

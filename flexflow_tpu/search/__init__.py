@@ -48,4 +48,15 @@ def _default_structural_validator(graph, views, num_devices):
     return validate_searched_strategy(graph, views, num_devices)
 
 
+def _static_analysis_validator(graph, views, num_devices):
+    """The static PCG analyzer (analysis/) as a strategy validator:
+    structure + sharding/shape inference + collective consistency over
+    every search result, so a malformed strategy is named at compile()
+    time instead of producing wrong numbers or a deadlock on device."""
+    from ..analysis import strategy_violations
+
+    return strategy_violations(graph, views, num_devices)
+
+
 register_strategy_validator(_default_structural_validator)
+register_strategy_validator(_static_analysis_validator)

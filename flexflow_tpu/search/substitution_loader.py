@@ -93,6 +93,17 @@ ACTI_OF_OP = {
 }
 
 
+class SubstitutionRuleError(ValueError):
+    """A substitution rule is malformed or unsound, detected at LOAD time
+    (the alternative is a KeyError or a silent mis-rewrite deep inside
+    the search). Carries the rule name and the offending field."""
+
+    def __init__(self, rule: str, field: str, message: str):
+        self.rule = rule
+        self.field = field
+        super().__init__(f"substitution rule {rule!r}, {field}: {message}")
+
+
 @dataclasses.dataclass
 class TensorRef:
     """reference: substitution_loader.h Tensor{opId, tsId}"""
@@ -125,37 +136,91 @@ class Rule:
         return all(p.op_type is not None for p in self.src_ops + self.dst_ops)
 
 
-def _parse_op(d: dict) -> OpPattern:
+def _parse_op(d: dict, rule: str, where: str) -> OpPattern:
+    if not isinstance(d, dict):
+        raise SubstitutionRuleError(rule, where, f"operator is {type(d).__name__}, "
+                                                "expected an object")
+    if not isinstance(d.get("type"), str):
+        raise SubstitutionRuleError(rule, f"{where}.type",
+                                    "missing or non-string op type")
+    inputs = []
+    for i, t in enumerate(d.get("input", [])):
+        for key in ("opId", "tsId"):
+            if not isinstance(t, dict) or not isinstance(t.get(key), int):
+                raise SubstitutionRuleError(
+                    rule, f"{where}.input[{i}].{key}",
+                    "missing or non-integer tensor ref field")
+        inputs.append(TensorRef(t["opId"], t["tsId"]))
+    params = {}
+    for i, p in enumerate(d.get("para", [])):
+        if not isinstance(p, dict) or not isinstance(p.get("key"), str) \
+                or not isinstance(p.get("value"), int):
+            raise SubstitutionRuleError(
+                rule, f"{where}.para[{i}]",
+                "parameter entries need a string 'key' and integer 'value'")
+        params[p["key"]] = p["value"]
     return OpPattern(
         type_str=d["type"],
         op_type=_TYPE_MAP.get(d["type"]),
-        inputs=[TensorRef(t["opId"], t["tsId"]) for t in d.get("input", [])],
-        params={p["key"]: p["value"] for p in d.get("para", [])},
+        inputs=inputs,
+        params=params,
     )
 
 
-def load_rule_collection(obj: dict) -> List[Rule]:
-    """reference: substitution_loader.cc load_rule_collection"""
+def load_rule_collection(obj: dict, validate: bool = True) -> List[Rule]:
+    """reference: substitution_loader.cc load_rule_collection.
+
+    With `validate=True` (the default) every rule is structurally parsed
+    AND symbolically vetted by the analyzer's substitution lint
+    (analysis/substitution_lint.py); malformed or unsound rules raise a
+    typed SubstitutionRuleError naming the rule and the offending field,
+    instead of failing deep inside the search. Rules with unsupported op
+    types load fine and are skipped later, like the reference."""
     rules = []
     for r in obj.get("rule", []):
+        name = r.get("name", f"rule_{len(rules)}")
+        if not isinstance(name, str):
+            raise SubstitutionRuleError(str(name), "name",
+                                        "rule name must be a string")
+        mapped = []
+        for i, m in enumerate(r.get("mappedOutput", [])):
+            for key in ("srcOpId", "srcTsId", "dstOpId", "dstTsId"):
+                if not isinstance(m, dict) or not isinstance(m.get(key), int):
+                    raise SubstitutionRuleError(
+                        name, f"mappedOutput[{i}].{key}",
+                        "missing or non-integer mapped-output field")
+            mapped.append((m["srcOpId"], m["srcTsId"], m["dstOpId"],
+                           m["dstTsId"]))
         rules.append(
             Rule(
-                name=r.get("name", f"rule_{len(rules)}"),
-                src_ops=[_parse_op(o) for o in r.get("srcOp", [])],
-                dst_ops=[_parse_op(o) for o in r.get("dstOp", [])],
-                mapped_outputs=[
-                    (m["srcOpId"], m["srcTsId"], m["dstOpId"], m["dstTsId"])
-                    for m in r.get("mappedOutput", [])
-                ],
+                name=name,
+                src_ops=[_parse_op(o, name, f"srcOp[{i}]")
+                         for i, o in enumerate(r.get("srcOp", []))],
+                dst_ops=[_parse_op(o, name, f"dstOp[{i}]")
+                         for i, o in enumerate(r.get("dstOp", []))],
+                mapped_outputs=mapped,
             )
         )
+    if validate:
+        from ..analysis.substitution_lint import lint_rule
+
+        for rule in rules:
+            errs = lint_rule(rule).errors
+            if errs:
+                raise SubstitutionRuleError(rule.name, errs[0].code,
+                                            errs[0].message)
     return rules
 
 
-def load_rule_collection_from_path(path: str) -> List[Rule]:
+def load_rule_collection_from_path(path: str, validate: bool = True
+                                   ) -> List[Rule]:
     """reference: substitution_loader.cc load_rule_collection_from_path"""
     with open(path) as f:
-        return load_rule_collection(json.load(f))
+        try:
+            obj = json.load(f)
+        except json.JSONDecodeError as e:
+            raise SubstitutionRuleError(path, "json", str(e)) from e
+    return load_rule_collection(obj, validate=validate)
 
 
 def default_rules_path() -> str:
@@ -448,8 +513,8 @@ def apply_rule(graph: Graph, rule: Rule) -> Iterator[Graph]:
                 new_ops.append(nop)
         except MergeAfterMaterializationError:
             raise  # a caller bug, not an inapplicable site — surface it
-        except Exception:
-            continue  # rule not applicable at this site
+        except Exception:  # fflint: disable=FFL002 — inapplicable match site
+            continue
 
         # rewire mapped outputs: consumers of src outputs now read dst
         ok = True

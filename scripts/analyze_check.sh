@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Static-analysis sweep (ISSUE 4), mirroring verify_check.sh: the
+# project AST linter, the substitution-rule lint over the shipped
+# collection, and the analyzer test suite on CPU meshes of varying
+# size — seeded-defect PCGs (wrong reduction axis, degree-vs-devices
+# mismatch, cross-shard collective order, over-HBM views) must each
+# produce their diagnostic code STATICALLY, and the clean searched zoo
+# strategies must produce zero errors. Use before touching pcg/,
+# search/, parallel strategies, or the analyzer itself:
+#
+#   scripts/analyze_check.sh                 # full sweep (8, 4-device)
+#   FF_ANALYZE_DEVICES=8 scripts/analyze_check.sh -k collective
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== fflint: project AST rules over flexflow_tpu/ ==="
+python tools/fflint.py flexflow_tpu/
+
+echo "=== substitution-rule lint: shipped collection ==="
+env JAX_PLATFORMS=cpu python -m flexflow_tpu.analysis
+
+devices="${FF_ANALYZE_DEVICES:-8 4}"
+for n in $devices; do
+    echo "=== analysis sweep: ${n}-device CPU mesh ==="
+    env JAX_PLATFORMS=cpu \
+        JAX_NUM_CPU_DEVICES="$n" \
+        XLA_FLAGS="--xla_force_host_platform_device_count=$n" \
+        python -m pytest tests/test_analysis.py -v -p no:cacheprovider "$@"
+done

@@ -42,7 +42,7 @@ try:
     from jax._src import xla_bridge as _xb
 
     _xb._backend_factories.pop("axon", None)
-except Exception:
+except Exception:  # fflint: disable=FFL002 — jax-internal API may not exist
     pass
 jax.config.update("jax_platforms", "cpu")
 # JAX_NUM_CPU_DEVICES overrides the 8-device default so sweeps can vary

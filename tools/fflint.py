@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""fflint — project-level AST lints distilled from real shipped bugs.
+
+Each rule encodes a bug class this repo actually shipped and fixed; the
+linter makes the fix mechanical instead of tribal knowledge. Stdlib
+only (ast) so CI can run it before any heavy install.
+
+Rules
+-----
+FFL001  bare `except:`
+        Swallows KeyboardInterrupt/SystemExit too. Never shipped here,
+        banned so it never is.
+FFL002  silent `except Exception` (handler body is only pass/continue)
+        Historical: silent except-Exception blocks in the checkpoint
+        restore path masked corrupted tensors until PR 3 narrowed them
+        to typed exceptions with logged warnings. A handler must raise,
+        log, warn, or produce a fallback value — not just swallow.
+FFL101  `np.asarray(jax.device_get(...))` (or np.array without copy)
+        Historical: on the CPU backend device_get returns a ZERO-COPY
+        view into the live buffer; with donated train steps the next
+        dispatch reuses that memory and the "snapshot" silently mutates
+        — PR 2's checkpoint-corruption bug. Use
+        `np.array(..., copy=True)` (or `.copy()`).
+FFL102  reuse of a donated state after a donated step call
+        Historical: the same PR 2 class — a variable passed into a
+        `build_train_step()` callable (donating by default) is dead
+        after the call; reading it again observes reused buffers.
+        Rebind it from the step's return value first.
+
+Suppression: append `# fflint: disable=FFL002` (comma-list) to the
+offending line (for except-handlers: to the `except` line).
+
+Usage:  python tools/fflint.py [--list-rules] PATH [PATH...]
+Exit codes: 0 clean, 1 findings, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Set
+
+RULES = {
+    "FFL001": "bare `except:` clause",
+    "FFL002": "silent `except Exception:` handler (body only "
+              "pass/continue)",
+    "FFL101": "np.asarray/np.array without copy=True on "
+              "jax.device_get(...) output",
+    "FFL102": "donated train-step input read again after the step call",
+}
+
+_PRAGMA = re.compile(r"#\s*fflint:\s*disable=([A-Z0-9,\s]+)")
+
+
+class Finding:
+    def __init__(self, path: str, line: int, col: int, code: str, msg: str):
+        self.path, self.line, self.col = path, line, col
+        self.code, self.msg = code, msg
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.msg}"
+
+
+def _pragmas(source: str) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for i, text in enumerate(source.splitlines(), 1):
+        m = _PRAGMA.search(text)
+        if m:
+            out[i] = {c.strip() for c in m.group(1).split(",") if c.strip()}
+    return out
+
+
+def _dotted(node: ast.AST) -> str:
+    """best-effort dotted-name rendering of Name/Attribute chains."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# ----------------------------------------------------------------------
+# FFL001 / FFL002 — exception-handler rules
+# ----------------------------------------------------------------------
+def _check_excepts(tree: ast.AST, path: str, findings: List[Finding]) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            findings.append(Finding(
+                path, node.lineno, node.col_offset, "FFL001",
+                "bare `except:` also catches KeyboardInterrupt/SystemExit; "
+                "catch a concrete exception type",
+            ))
+            continue
+        names = []
+        if isinstance(node.type, (ast.Name, ast.Attribute)):
+            names = [_dotted(node.type)]
+        elif isinstance(node.type, ast.Tuple):
+            names = [_dotted(e) for e in node.type.elts]
+        if not any(n in ("Exception", "BaseException") for n in names):
+            continue
+        if all(isinstance(s, (ast.Pass, ast.Continue)) for s in node.body):
+            findings.append(Finding(
+                path, node.lineno, node.col_offset, "FFL002",
+                "except Exception that only swallows (pass/continue): "
+                "raise a typed error, log, or produce a fallback "
+                "(historical: silent restore-path excepts masked "
+                "checkpoint corruption)",
+            ))
+
+
+# ----------------------------------------------------------------------
+# FFL101 — zero-copy view of device memory
+# ----------------------------------------------------------------------
+def _is_device_get(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and \
+        _dotted(node.func).split(".")[-1] == "device_get"
+
+
+def _check_asarray(tree: ast.AST, path: str, findings: List[Finding]) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = _dotted(node.func)
+        leaf = fn.split(".")[-1]
+        if leaf not in ("asarray", "array") or not node.args:
+            continue
+        if not _is_device_get(node.args[0]):
+            continue
+        if leaf == "array":
+            copy_kw = next((k for k in node.keywords if k.arg == "copy"),
+                           None)
+            if copy_kw is not None and \
+                    getattr(copy_kw.value, "value", None) is True:
+                continue
+        findings.append(Finding(
+            path, node.lineno, node.col_offset, "FFL101",
+            f"{fn}(jax.device_get(...)) may be a zero-copy view of a "
+            "live (donatable) device buffer; use np.array(..., copy=True) "
+            "(historical: donated-step aliasing corrupted checkpoints)",
+        ))
+
+
+# ----------------------------------------------------------------------
+# FFL102 — donated buffer reused after the step
+# ----------------------------------------------------------------------
+def _check_donated_reuse(tree: ast.AST, path: str,
+                         findings: List[Finding]) -> None:
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # step-fn variables: x = <...>.build_train_step(...) without
+        # donate=False
+        step_fns: Set[str] = set()
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            callee = _dotted(node.value.func)
+            if not callee.endswith("build_train_step"):
+                continue
+            donate_off = any(
+                k.arg == "donate"
+                and getattr(k.value, "value", None) is False
+                for k in node.value.keywords
+            )
+            # donate=(expr) that may be False at runtime: trust it only
+            # when literally False
+            if donate_off:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    step_fns.add(tgt.id)
+        if not step_fns:
+            continue
+        # calls step(arg0, ...): arg0 is donated; flag loads of arg0's
+        # expression after the call line and before a re-store of it
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in step_fns and node.args):
+                continue
+            target = _dotted(node.args[0])
+            if not target:
+                continue
+            stores = [
+                n.lineno for n in ast.walk(fn)
+                if isinstance(n, (ast.Name, ast.Attribute))
+                and isinstance(getattr(n, "ctx", None), ast.Store)
+                and _dotted(n) == target and n.lineno >= node.lineno
+            ]  # >=: `state, out = step_fn(state, ...)` rebinds in place
+            rebound = min(stores) if stores else None
+            for n in ast.walk(fn):
+                if not isinstance(n, (ast.Name, ast.Attribute)):
+                    continue
+                if not isinstance(getattr(n, "ctx", None), ast.Load):
+                    continue
+                if _dotted(n) != target or n.lineno <= node.lineno:
+                    continue
+                if rebound is not None and n.lineno >= rebound:
+                    continue
+                if n.end_col_offset is not None and \
+                        n.lineno == node.lineno:
+                    continue
+                findings.append(Finding(
+                    path, n.lineno, n.col_offset, "FFL102",
+                    f"`{target}` was donated to `{node.func.id}(...)` on "
+                    f"line {node.lineno} and is read again before being "
+                    "rebound — donated buffers are reused by the next "
+                    "dispatch (historical: stale-state reads after "
+                    "donation)",
+                ))
+                break  # one finding per donated call is enough
+
+
+# ----------------------------------------------------------------------
+def lint_source(source: str, path: str) -> List[Finding]:
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, 0, "FFL000",
+                        f"syntax error: {e.msg}")]
+    findings: List[Finding] = []
+    _check_excepts(tree, path, findings)
+    _check_asarray(tree, path, findings)
+    _check_donated_reuse(tree, path, findings)
+    pragmas = _pragmas(source)
+    return [
+        f for f in findings
+        if f.code not in pragmas.get(f.line, set())
+    ]
+
+
+def lint_path(path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    if os.path.isfile(path):
+        files = [path]
+    else:
+        files = []
+        for root, dirs, names in os.walk(path):
+            dirs[:] = [d for d in dirs
+                       if d not in (".git", "__pycache__", ".jax_cache")]
+            files.extend(os.path.join(root, n) for n in sorted(names)
+                         if n.endswith(".py"))
+    for f in files:
+        try:
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError as e:
+            findings.append(Finding(f, 0, 0, "FFL000", f"unreadable: {e}"))
+            continue
+        findings.extend(lint_source(src, f))
+    return findings
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="fflint", description=__doc__.split("\n\n")[0])
+    p.add_argument("paths", nargs="*", help="files or directories to lint")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    args = p.parse_args(argv)
+    if args.list_rules:
+        for code, desc in sorted(RULES.items()):
+            print(f"{code}  {desc}")
+        return 0
+    if not args.paths:
+        p.print_usage()
+        return 2
+    findings: List[Finding] = []
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"fflint: no such path: {path}", file=sys.stderr)
+            return 2
+        findings.extend(lint_path(path))
+    for f in findings:
+        print(f.format())
+    if findings:
+        print(f"fflint: {len(findings)} finding(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
