@@ -58,6 +58,7 @@ from .analysis import (  # noqa: F401
 )
 from .search.substitution_loader import SubstitutionRuleError  # noqa: F401
 from .core.optimizers import AdamOptimizer, Optimizer, SGDOptimizer  # noqa: F401
+from .obs import TelemetryConfig, explain_strategy  # noqa: F401
 from .core.tensor import Layer, Tensor  # noqa: F401
 from .ff_types import (  # noqa: F401
     ActiMode,

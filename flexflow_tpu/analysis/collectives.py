@@ -48,6 +48,42 @@ def _view_of(op, views: Dict) -> Optional[object]:
     return op.machine_view
 
 
+def estimate_collective_bytes(graph, views: Optional[Dict] = None
+                              ) -> "list[dict]":
+    """Static per-op collective payload estimate for a placed strategy.
+
+    For each parallel op, the wire bytes its implied collective moves
+    per step under the standard ring algorithms (all-reduce 2(p-1)/p of
+    the buffer, all-gather/scatter/all-to-all/broadcast (p-1)/p), where
+    p is the participant count (the view's parts, falling back to the
+    tensor's parallel degree). Feeds the telemetry gauge
+    ``ff_pcg_collective_bytes`` so a strategy's communication footprint
+    is visible without running it."""
+    out = []
+    for op in graph.topo_order():
+        kind = _COLLECTIVE_OF.get(op.op_type)
+        if kind is None:
+            continue
+        t = op.inputs[0] if op.inputs else (
+            op.outputs[0] if op.outputs else None
+        )
+        if t is None:
+            continue
+        full = t.get_volume() * t.data_type.size
+        v = _view_of(op, views or {})
+        p = max(1, v.num_parts()) if v is not None else \
+            max(1, t.get_total_degree())
+        if p <= 1:
+            wire = 0
+        elif kind == "all-reduce":
+            wire = int(full * 2 * (p - 1) / p)
+        else:
+            wire = int(full * (p - 1) / p)
+        out.append({"op": op.name, "guid": op.guid, "kind": kind,
+                    "bytes": wire, "parts": p})
+    return out
+
+
 def collective_diagnostics(graph, views: Optional[Dict] = None,
                            num_devices: Optional[int] = None
                            ) -> AnalysisReport:

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..config import FFConfig, FFIterationConfig
 from ..ff_types import (
     ActiMode,
@@ -713,6 +714,12 @@ class FFModel:
         self.loss_type = to_loss_type(loss_type)
         self.comp_mode = comp_mode
         self.metrics_obj = Metrics(self.loss_type, metrics)
+        # Every compile records what it did (phase timings + every search
+        # decision) into a bounded in-memory trajectory; fit(telemetry=)
+        # replays it into the event log and obs.explain_strategy joins it
+        # with on-device measurements (obs/trajectory.py).
+        self.search_trajectory = obs.SearchTrajectory()
+        _t_phase = time.perf_counter()
 
         # 1. Layer graph -> PCG (reference: create_operators_from_layers)
         self.graph, self._tensor_map = layers_to_pcg(self.layers)
@@ -723,6 +730,8 @@ class FFModel:
             from ..pcg.fusion import apply_fusion
 
             self.graph = apply_fusion(self.graph)
+        self.search_trajectory.phase("lowering", _t_phase,
+                                     ops=len(self.graph.ops))
         self._pt_by_guid = {}
         for op in self.graph.ops:
             for t in list(op.outputs) + list(op.weights):
@@ -758,8 +767,11 @@ class FFModel:
             if t.guid in self._constant_values
             and self._tensor_map.get(t.guid) in pre_pos
         }
+        _t_phase = time.perf_counter()
         if self.config.search_budget >= 0 and not self.config.only_data_parallel:
             mesh = self._run_strategy_search(ndev)
+            self.search_trajectory.phase("strategy_search", _t_phase,
+                                         devices=ndev)
         else:
             tp = max(1, self.config.tensor_parallel_degree)
             sp = max(1, self.config.sequence_parallel_degree)
@@ -774,6 +786,10 @@ class FFModel:
             strategies.apply_sequence_parallel(self.graph, sp, axis_idx=2)
             strategies.apply_expert_parallel(self.graph, ep, axis_idx=3)
             strategies.apply_pipeline_parallel(self.graph, pp, axis_idx=4)
+            self.search_trajectory.phase(
+                "manual_lowering", _t_phase, devices=ndev,
+                data=dp, model=tp, seq=sp, expert=ep, pipe=pp,
+            )
 
         # 3. Label tensor matched to final op's sharding (model.cc:3054)
         logits_pt = self.graph.output_tensors()[-1]
@@ -831,6 +847,7 @@ class FFModel:
             cur_inputs[i].guid: (cur_inputs[i], v)
             for i, v in self._constant_positions.items()
         }
+        _t_phase = time.perf_counter()
         self.executor = PCGExecutor(
             self.graph,
             mesh,
@@ -845,7 +862,10 @@ class FFModel:
             constants=constants,
             plan_cost_model=self._build_cost_model(),
         )
+        self.search_trajectory.phase("executor_build", _t_phase)
+        _t_phase = time.perf_counter()
         self.state = self.executor.init_state()
+        self.search_trajectory.phase("init_state", _t_phase)
         self.perf_metrics = PerfMetrics()
 
     def _build_cost_model(self):
@@ -879,7 +899,18 @@ class FFModel:
                 dcn_bandwidth=machine.dcn_bandwidth,
                 chip=machine.chip,
             )
-        return CostModel(machine, bf16=cfg.allow_mixed_precision)
+        cm = CostModel(machine, bf16=cfg.allow_mixed_precision)
+        profiled = getattr(self, "_profiled_op_costs", None)
+        if profiled:
+            # explain_strategy(...).apply(model) fed real on-device op
+            # timings back: serial-view costs resolve to those
+            # measurements instead of the analytic roofline (the
+            # --measured-search attach below, if enabled, supersedes
+            # this with proper per-shard measurement)
+            from ..obs.explain import attach_profiled_costs
+
+            attach_profiled_costs(cm, profiled)
+        return cm
 
     def _run_strategy_search(self, ndev: int):
         """Unity search over the lowered PCG (reference: compile's
@@ -912,7 +943,7 @@ class FFModel:
                 ),
                 cache_path=cfg.measured_cache_path or None,
             )
-        sh = SearchHelper(cost_model)
+        sh = SearchHelper(cost_model, trajectory=self.search_trajectory)
         degrees = []
         d = 2
         while d <= machine.num_workers:
@@ -959,6 +990,7 @@ class FFModel:
                 alpha=cfg.search_alpha, budget=budget,
                 train=self._is_training_compile(), optimizer=self.optimizer,
                 grad_bytes_ratio=self._grad_bytes_ratio(),
+                trajectory=self.search_trajectory,
             )
         else:
             gsh = GraphSearchHelper(
@@ -966,6 +998,7 @@ class FFModel:
                 xfers,
                 alpha=cfg.search_alpha,
                 budget=budget,
+                trajectory=self.search_trajectory,
             )
             best_graph, result = gsh.graph_optimize(self.graph, res)
         self.graph = best_graph
@@ -987,6 +1020,10 @@ class FFModel:
             self.graph, result = alt
             self.searched_views = result.views
             self.searched_cost = result.cost
+        self.search_trajectory.event(
+            "pipeline_search", degree=pipe,
+            replaced_by_researched=alt is not None, cost=result.cost,
+        )
         # re-index pt lookup for the (possibly rewritten) graph
         self._pt_by_guid = {}
         for op in self.graph.ops:
@@ -1196,6 +1233,7 @@ class FFModel:
                 alpha=cfg.search_alpha, budget=budget,
                 train=train, optimizer=self.optimizer,
                 grad_bytes_ratio=gratio,
+                trajectory=self.search_trajectory,
             )
             if mem2.max_bytes <= mem_budget and r2.cost < best_t:
                 return 1, (g2, r2)
@@ -1268,6 +1306,7 @@ class FFModel:
         verify_strategy=None,
         canary=None,
         lint: Optional[str] = None,
+        telemetry=None,
     ):
         if self.executor is None:
             from ..runtime.verify import NotCompiledError
@@ -1278,6 +1317,51 @@ class FFModel:
                 'fit(lint=...) accepts "error", "warn", or "off" '
                 f"(got {lint!r})"
             )
+        # -- telemetry session (obs/): fit(telemetry=TelemetryConfig(...))
+        # runs one session end to end — compile/search trajectory replay,
+        # per-step events, metrics — and flushes events.jsonl /
+        # metrics.prom / trace.json on exit. A session the caller already
+        # opened (obs.session(...)) is fed without being finished here.
+        tel = None
+        _own_session = False
+        if telemetry is not None:
+            if not isinstance(telemetry, obs.TelemetryConfig):
+                raise ValueError(
+                    "fit(telemetry=...) takes an obs.TelemetryConfig "
+                    f"(got {telemetry!r})"
+                )
+            tel = obs.start(telemetry)
+            _own_session = True
+        else:
+            tel = obs.active()
+        if tel is not None:
+            tel.attach_model(self)
+        try:
+            return self._fit_impl(
+                x, y, batch_size, epochs, verbose,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every_n_steps=checkpoint_every_n_steps,
+                keep_last_n=keep_last_n, resume=resume,
+                skip_nonfinite_steps=skip_nonfinite_steps,
+                step_guard=step_guard,
+                max_consecutive_skips=max_consecutive_skips,
+                fault_injector=fault_injector,
+                preemption_signal=preemption_signal,
+                elastic=elastic, health_monitor=health_monitor,
+                verify_strategy=verify_strategy, canary=canary,
+                lint=lint, tel=tel,
+            )
+        finally:
+            if _own_session:
+                obs.finish()
+
+    def _fit_impl(
+        self, x, y, batch_size, epochs, verbose, *,
+        checkpoint_dir, checkpoint_every_n_steps, keep_last_n, resume,
+        skip_nonfinite_steps, step_guard, max_consecutive_skips,
+        fault_injector, preemption_signal, elastic, health_monitor,
+        verify_strategy, canary, lint, tel,
+    ):
         if lint in ("warn", "error"):
             # static preflight (analysis/): shape/sharding inference,
             # collective consistency, and HBM-fit over the compiled PCG —
@@ -1293,7 +1377,8 @@ class FFModel:
                 warnings.warn("static analysis found problems "
                               "(fit(lint='warn')):\n" + report.summary())
             elif verbose and len(report):
-                print(f"[analysis] {report!r}")
+                obs.progress(f"[analysis] {report!r}", name="analysis",
+                             cat="compile")
         x, y = _unwrap_loaders(x, y)
         xs = x if isinstance(x, (list, tuple)) else [x]
         bs = batch_size or self.config.batch_size
@@ -1304,8 +1389,11 @@ class FFModel:
                 f"dataset has {n} samples < batch_size {bs}; nothing to train on"
             )
         if n % bs != 0:
-            print(f"[flexflow_tpu] warning: dropping {n % bs} tail samples "
-                  f"(dataset {n} % batch {bs})")
+            obs.progress(
+                f"[flexflow_tpu] warning: dropping {n % bs} tail samples "
+                f"(dataset {n} % batch {bs})",
+                name="tail_samples_dropped", dropped=n % bs,
+            )
         if verify_strategy:
             # differential preflight (runtime/verify.py): K steps of the
             # searched strategy vs a serial single-device reference from
@@ -1323,9 +1411,11 @@ class FFModel:
                 self, (xs, y), steps=2, batch_size=bs,
                 raise_on_divergence=True,
             )
-            if verbose:
-                print("[verify] preflight: "
-                      + verdict.summary().split("\n")[0])
+            obs.progress(
+                "[verify] preflight: " + verdict.summary().split("\n")[0],
+                verbose=verbose, name="verify_preflight", cat="runtime",
+                ok=verdict.ok,
+            )
         if (checkpoint_dir is not None or skip_nonfinite_steps
                 or step_guard is not None or fault_injector is not None
                 or preemption_signal is not None or elastic
@@ -1349,6 +1439,7 @@ class FFModel:
                 elastic=elastic,
                 health_monitor=health_monitor,
                 canary=canary,
+                tel=tel,
             )
         # guard residue from a previous resilient fit would change the
         # step signature; drop it for the fast unguarded paths
@@ -1368,15 +1459,23 @@ class FFModel:
                 np.asarray(a, pt.data_type.np_dtype)
                 for pt, a in zip(in_pts, first[:-1])
             ]
-            times = profile_ops(self, cast)
-            for op_name, t in sorted(times.items(), key=lambda kv: -kv[1]):
-                print(f"[profiling] {op_name}: {t*1e3:.3f} ms")
+            profs = profile_ops(self, cast, backward=True)
+            for op_name, p in sorted(profs.items(),
+                                     key=lambda kv: -kv[1].total_s):
+                obs.progress(
+                    f"[profiling] {op_name}: {p.forward_s * 1e3:.3f} ms fwd"
+                    f" + {p.backward_s * 1e3:.3f} ms bwd",
+                    name="op_profile", cat="runtime", op=op_name,
+                    forward_s=p.forward_s, backward_s=p.backward_s,
+                )
         label_dt = self.label_tensor.data_type.jnp_dtype
         spd = max(1, self.config.iterations_per_dispatch)
         scan_fn = self.executor.build_train_scan() if spd > 1 else None
         self.perf_metrics = PerfMetrics()
         if jax.process_count() > 1:
             self._assert_same_global_batch(xs, y, bs)
+        n_chips = max(1, self.executor.mesh.devices.size)
+        tstep = 0
         start = time.time()
         num_samples = 0
         for epoch in range(ep):
@@ -1392,6 +1491,8 @@ class FFModel:
                 # fuse the chunk's steps into ONE dispatch (lax.scan driver
                 # — the Legion trace-replay analog); partials come back
                 # stacked on a steps axis
+                nonlocal tstep
+                t0 = time.perf_counter() if tel is not None else 0.0
                 bxs = [
                     self.executor.shard_batch_stack(
                         pt,
@@ -1414,6 +1515,13 @@ class FFModel:
                     self.executor.put_replicated(jnp.stack(subs)),
                 )
                 device_partials.append(partials)
+                if tel is not None:
+                    tel.record_chunk(
+                        first_step=tstep, steps=len(chunk),
+                        dur_s=time.perf_counter() - t0, batch_size=bs,
+                        n_chips=n_chips, t0=t0,
+                    )
+                tstep += len(chunk)
 
             for batch in self._batches(list(xs) + [y], bs):
                 if spd > 1:
@@ -1422,6 +1530,7 @@ class FFModel:
                         flush(chunk)
                         chunk = []
                 else:
+                    t0 = time.perf_counter() if tel is not None else 0.0
                     bx = [
                         self.executor.shard_batch(pt, np.asarray(a, pt.data_type.np_dtype))
                         for pt, a in zip(in_pts, batch[:-1])
@@ -1434,6 +1543,18 @@ class FFModel:
                         self.state, bx, by, self.executor.put_replicated(sub)
                     )
                     device_partials.append(partials)
+                    if tel is not None:
+                        loss_val = None
+                        if tel.config.sync_per_step:
+                            loss_val = float(
+                                _fetch_global(partials["loss"]).ravel()[-1]
+                            )
+                        tel.record_step(
+                            step=tstep, dur_s=time.perf_counter() - t0,
+                            batch_size=bs, n_chips=n_chips, loss=loss_val,
+                            t0=t0,
+                        )
+                    tstep += 1
                 num_samples += bs
             if chunk:  # tail chunk shorter than spd (own compiled shape)
                 flush(chunk)
@@ -1445,16 +1566,24 @@ class FFModel:
                 _fetch_global(device_partials[-1]["loss"]).ravel()[-1]
             )
             folded.pop("loss", None)
+            gnorm_sum = folded.pop("grad_norm", None)
             self.perf_metrics.update(folded)
-            if verbose:
-                print(f"epoch {epoch}: loss={last_loss:.4f} "
-                      + self.perf_metrics.report())
+            if tel is not None:
+                tel.record_epoch(epoch=epoch, loss=last_loss,
+                                 grad_norm_sum=gnorm_sum,
+                                 steps=len(device_partials))
+            obs.progress(
+                f"epoch {epoch}: loss={last_loss:.4f} "
+                + self.perf_metrics.report(),
+                verbose=verbose, name="epoch", epoch=epoch, loss=last_loss,
+            )
         jax.block_until_ready(self.state.params)
         elapsed = time.time() - start
         # reference: transformer.cc:208-211 throughput print
-        print(
+        obs.progress(
             f"ELAPSED TIME = {elapsed:.4f}s, "
-            f"THROUGHPUT = {num_samples / elapsed:.2f} samples/s"
+            f"THROUGHPUT = {num_samples / elapsed:.2f} samples/s",
+            name="fit_done", elapsed_s=elapsed, samples=num_samples,
         )
         return self.perf_metrics
 
@@ -1503,6 +1632,10 @@ class FFModel:
         the same checkpoint-and-raise escalation the watchdog uses.
         Returns the updated (prev_pnorm, prev_loss) trackers."""
         def escalate(exc):
+            obs.event("canary_violation", cat="runtime", step=global_step,
+                      error=type(exc).__name__, detail=str(exc)[:500])
+            obs.count("ff_canary_violations_total",
+                      help="canary / invariant violations")
             self.state = prev_state
             if manager is not None:
                 exc.checkpoint_path = self._save_resilient_ckpt(
@@ -1529,6 +1662,8 @@ class FFModel:
                     self.state = dataclasses.replace(
                         self.state, params=flipped
                     )
+            obs.count("ff_canary_checks_total",
+                      help="canary step re-executions")
             state2, partials2 = step_fn(*args)
             bad = vfy.compare_step_results(
                 {"params": self.state.params, "loss": partials["loss"]},
@@ -1577,7 +1712,7 @@ class FFModel:
                        skip_nonfinite_steps, step_guard,
                        max_consecutive_skips, fault_injector,
                        preemption_signal, elastic=False,
-                       health_monitor=None, canary=None):
+                       health_monitor=None, canary=None, tel=None):
         from ..runtime import resilience as rz
         from ..runtime import verify as vfy
 
@@ -1587,10 +1722,20 @@ class FFModel:
             # strategy for the surviving machine and recompile; the
             # checkpoint restore below reshards the weights onto it.
             n = len(jax.devices())
-            if verbose:
-                print(f"[elastic] device topology changed; re-searching "
-                      f"strategy for {n} device(s) and recompiling")
+            obs.progress(
+                f"[elastic] device topology changed; re-searching "
+                f"strategy for {n} device(s) and recompiling",
+                verbose=verbose, name="elastic_recompile", cat="runtime",
+                devices=n,
+            )
             self.recompile_for_topology(n)
+            if tel is not None:
+                # the recompile minted a fresh trajectory/executor —
+                # replay the re-search into the event log too
+                tel._attached_models = [
+                    m for m in tel._attached_models if m is not self
+                ]
+                tel.attach_model(self)
 
         guard_cfg = step_guard
         if guard_cfg is None and skip_nonfinite_steps:
@@ -1625,6 +1770,7 @@ class FFModel:
         step_fn = self.executor.build_train_step(donate=(canary is None))
         in_pts = self.executor.input_pts
         label_dt = self.label_tensor.data_type.jnp_dtype
+        n_chips = max(1, self.executor.mesh.devices.size)
         if jax.process_count() > 1:
             self._assert_same_global_batch(xs, y, bs)
         pnorm_fn = None
@@ -1646,13 +1792,17 @@ class FFModel:
 
                 saved_topo = (info.meta or {}).get("topology")
                 live_topo = topology_fingerprint(self.executor.mesh)
-                if not topology_matches(saved_topo, live_topo) and verbose:
-                    print(
+                if not topology_matches(saved_topo, live_topo):
+                    obs.progress(
                         f"[elastic] resumed step {info.step} across a "
                         f"topology change "
                         f"({(saved_topo or {}).get('num_devices', '?')} -> "
                         f"{live_topo['num_devices']} devices); strategy "
-                        "re-searched and parameters resharded"
+                        "re-searched and parameters resharded",
+                        verbose=verbose, name="elastic_resume",
+                        cat="runtime", step=info.step,
+                        saved_devices=(saved_topo or {}).get("num_devices"),
+                        live_devices=live_topo["num_devices"],
                     )
             if info is not None:
                 tm = (info.meta or {}).get("train", {})
@@ -1664,9 +1814,13 @@ class FFModel:
                 if start_batch >= steps_per_epoch:
                     start_epoch += 1
                     start_batch = 0
-                if verbose:
-                    print(f"[resilience] resumed from step {info.step} "
-                          f"(epoch {start_epoch}, batch {start_batch})")
+                obs.progress(
+                    f"[resilience] resumed from step {info.step} "
+                    f"(epoch {start_epoch}, batch {start_batch})",
+                    verbose=verbose, name="checkpoint_resume",
+                    cat="checkpoint", step=info.step, epoch=start_epoch,
+                    batch=start_batch,
+                )
 
         self.perf_metrics = PerfMetrics()
         start = time.time()
@@ -1722,6 +1876,7 @@ class FFModel:
                                 step=global_step, info=mon.hang_info,
                             )
                         mon.step_started(global_step)
+                    t0 = time.perf_counter() if tel is not None else 0.0
                     bx = [
                         self.executor.shard_batch(
                             pt, np.asarray(a, pt.data_type.np_dtype)
@@ -1757,6 +1912,20 @@ class FFModel:
                             global_step, epoch, bi, pnorm_fn,
                             prev_pnorm, prev_loss,
                         )
+                    if tel is not None:
+                        loss_val = None
+                        if tel.config.sync_per_step or mon is not None:
+                            # the monitor already synced on the loss, so
+                            # fetching it costs nothing extra
+                            loss_val = float(
+                                _fetch_global(partials["loss"]).ravel()[-1]
+                            )
+                        tel.record_step(
+                            step=global_step,
+                            dur_s=time.perf_counter() - t0,
+                            batch_size=bs, n_chips=n_chips, loss=loss_val,
+                            t0=t0,
+                        )
                     device_partials.append(partials)
                     num_samples += bs
                     global_step += 1
@@ -1766,6 +1935,13 @@ class FFModel:
                         skips = int(_fetch_global(
                             self.state.guard.consecutive_skips
                         ))
+                        if tel is not None:
+                            tel.metrics.gauge(
+                                "ff_loss_scale",
+                                "dynamic loss scale (step guard)",
+                            ).set(float(_fetch_global(
+                                self.state.guard.loss_scale
+                            )))
                         if skips >= guard_cfg.max_consecutive_skips:
                             raise rz.NonFiniteGradientsError(
                                 f"{skips} consecutive non-finite gradient "
@@ -1788,13 +1964,22 @@ class FFModel:
                     )
                     folded.pop("loss", None)
                     skipped = folded.pop("skipped", 0.0)
-                    folded.pop("grad_norm", None)
+                    gnorm_sum = folded.pop("grad_norm", None)
                     self.perf_metrics.update(folded)
-                    if verbose:
-                        extra = (f" skipped_steps={int(skipped)}"
-                                 if skipped else "")
-                        print(f"epoch {epoch}: loss={last_loss:.4f} "
-                              + self.perf_metrics.report() + extra)
+                    if tel is not None:
+                        tel.record_epoch(
+                            epoch=epoch, loss=last_loss,
+                            grad_norm_sum=gnorm_sum,
+                            steps=len(device_partials), skipped=skipped,
+                        )
+                    extra = (f" skipped_steps={int(skipped)}"
+                             if skipped else "")
+                    obs.progress(
+                        f"epoch {epoch}: loss={last_loss:.4f} "
+                        + self.perf_metrics.report() + extra,
+                        verbose=verbose, name="epoch", epoch=epoch,
+                        loss=last_loss, skipped_steps=int(skipped),
+                    )
         except rz.TrainingPreempted as e:
             if manager is not None and e.graceful:
                 # SIGTERM grace period: flush a final checkpoint so the
@@ -1817,9 +2002,10 @@ class FFModel:
             self._save_resilient_ckpt(manager, global_step, ep, 0, done=True)
         elapsed = time.time() - start
         if num_samples:
-            print(
+            obs.progress(
                 f"ELAPSED TIME = {elapsed:.4f}s, "
-                f"THROUGHPUT = {num_samples / elapsed:.2f} samples/s"
+                f"THROUGHPUT = {num_samples / elapsed:.2f} samples/s",
+                name="fit_done", elapsed_s=elapsed, samples=num_samples,
             )
         return self.perf_metrics
 
@@ -1843,7 +2029,7 @@ class FFModel:
             _, partials = step_fn(self.state.params, bx, by,
                                   self.state.net_state)
             pm.update({k: float(v) for k, v in partials.items()})
-        print(pm.report())
+        obs.progress(pm.report(), name="eval_done")
         return pm
 
     def predict(self, x, batch_size: Optional[int] = None):
@@ -2017,7 +2203,10 @@ class FFModel:
         for i, layer in enumerate(self.layers):
             if id in (-1, i):
                 shapes = [tuple(t.dims) for t in layer.outputs]
-                print(f"layer {i}: {layer.name} ({layer.op_type.name}) -> {shapes}")
+                # user-facing inspection API: printing IS the contract
+                print(  # fflint: disable=FFL201
+                    f"layer {i}: {layer.name} ({layer.op_type.name}) "
+                    f"-> {shapes}")
 
     # ------------------------------------------------------------------
     # weight access (reference: parallel_tensor.cc set_tensor/get_tensor)

@@ -13,6 +13,7 @@ training loop are identical either way.
 """
 import time
 
+from .... import obs
 from ....core.model import FFModel
 from ....config import FFConfig
 from ...keras import losses as ff_keras_losses
@@ -164,15 +165,21 @@ class BaseModel:
             stop = False
             for cb in cbs:
                 if cb.on_epoch_end(epoch, logs):
-                    print(f"Accuracy reaches, now early stop, epoch: {epoch}")
+                    obs.progress(
+                        f"Accuracy reaches, now early stop, epoch: {epoch}",
+                        name="early_stop", epoch=epoch,
+                    )
                     stop = True
             if stop:
                 break
         run_time = time.time() - start
         iters = num_samples // self._ffconfig.batch_size
-        print(f"epochs {epochs}, ELAPSED TIME = {run_time:.4f}s, "
-              f"interations {iters}, samples {num_samples}, THROUGHPUT = "
-              f"{num_samples * epochs / run_time:.2f} samples/s\n")
+        obs.progress(
+            f"epochs {epochs}, ELAPSED TIME = {run_time:.4f}s, "
+            f"interations {iters}, samples {num_samples}, THROUGHPUT = "
+            f"{num_samples * epochs / run_time:.2f} samples/s\n",
+            name="fit_done", elapsed_s=run_time, samples=num_samples,
+        )
         for cb in cbs:
             cb.on_train_end()
         return pm

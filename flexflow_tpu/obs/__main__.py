@@ -1,0 +1,116 @@
+"""Telemetry artifact CLI.
+
+Usage:
+    python -m flexflow_tpu.obs trace   <events.jsonl> [-o trace.json]
+    python -m flexflow_tpu.obs summary <events.jsonl>
+    python -m flexflow_tpu.obs prom    <metrics.jsonl> [-o metrics.prom]
+
+``trace`` converts a structured event log to Chrome-trace JSON (open at
+https://ui.perfetto.dev). ``summary`` schema-validates the log and
+prints per-category/event counts plus step/search aggregates.
+``prom`` re-renders the last metrics.jsonl snapshot as Prometheus text.
+
+This module is a CLI entry point: bare print() is its job (fflint FFL201
+allowlists __main__ modules).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter
+
+from .tracer import read_events_jsonl, to_chrome_trace
+
+
+def _cmd_trace(args) -> int:
+    events, problems = read_events_jsonl(args.events)
+    for p in problems:
+        print(f"warning: {p}", file=sys.stderr)
+    out = args.output or "trace.json"
+    with open(out, "w") as f:
+        json.dump(to_chrome_trace(events), f)
+    print(f"wrote {out}: {len(events)} event(s) "
+          f"({len(problems)} malformed line(s) skipped)")
+    return 0
+
+
+def _cmd_summary(args) -> int:
+    events, problems = read_events_jsonl(args.events)
+    if problems:
+        for p in problems:
+            print(f"schema: {p}", file=sys.stderr)
+    by_name = Counter((e["cat"], e["name"]) for e in events)
+    print(f"{args.events}: {len(events)} event(s), "
+          f"{len(problems)} malformed line(s)")
+    for (cat, name), n in sorted(by_name.items()):
+        print(f"  {cat:<12} {name:<24} {n}")
+    steps = [e for e in events
+             if e["name"] == "step" and e["ph"] == "X"]
+    if steps:
+        total = sum(e["dur"] for e in steps)
+        print(f"steps: {len(steps)}, total {total:.3f}s, "
+              f"mean {total / len(steps) * 1e3:.2f}ms")
+    mcmc = [e for e in events if e["name"] == "mcmc_iter"]
+    if mcmc:
+        acc = sum(1 for e in mcmc if e.get("args", {}).get("accept"))
+        print(f"mcmc: {len(mcmc)} proposal(s), {acc} accepted "
+              f"({100.0 * acc / len(mcmc):.1f}%)")
+    cands = [e for e in events if e["name"] == "xfer_candidate"]
+    if cands:
+        best = sum(1 for e in cands if e.get("args", {}).get("best"))
+        print(f"substitutions: {len(cands)} candidate(s), "
+              f"{best} improved the best strategy")
+    return 1 if problems else 0
+
+
+def _cmd_prom(args) -> int:
+    from .metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    with open(args.metrics) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    # keep only the newest snapshot per (name, labels)
+    latest = {}
+    for r in records:
+        latest[(r["name"], tuple(sorted(r["labels"].items())))] = r
+    for r in latest.values():
+        labels = dict(r["labels"])
+        if r["kind"] == "counter":
+            reg.counter(r["name"], **labels).inc(r["value"])
+        elif r["kind"] == "gauge":
+            reg.gauge(r["name"], **labels).set(r["value"])
+        else:  # histogram snapshots only carry aggregates; re-emit sum
+            h = reg.histogram(r["name"], **labels)
+            h.sum, h.count = r.get("sum", 0.0), r.get("count", 0)
+    text = reg.to_prometheus()
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+        print(f"wrote {args.output}")
+    else:
+        print(text, end="")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m flexflow_tpu.obs",
+        description=__doc__.split("\n\n")[0],
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    t = sub.add_parser("trace", help="events.jsonl -> Chrome/Perfetto trace")
+    t.add_argument("events")
+    t.add_argument("-o", "--output")
+    s = sub.add_parser("summary", help="validate + summarize an event log")
+    s.add_argument("events")
+    m = sub.add_parser("prom", help="metrics.jsonl -> Prometheus text")
+    m.add_argument("metrics")
+    m.add_argument("-o", "--output")
+    args = p.parse_args(argv)
+    return {"trace": _cmd_trace, "summary": _cmd_summary,
+            "prom": _cmd_prom}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,192 @@
+"""Strategy-search explainability.
+
+`explain_strategy(model)` answers "why did the search pick this plan,
+and where is its cost model wrong?": it joins the recorded search
+trajectory (obs/trajectory.py — MCMC accept/reject decisions,
+substitution candidates, final simulated cost) with REAL on-device
+measurements (runtime/profiler.profile_ops, warmup + forward + backward)
+and ranks every compute op by |simulated − measured| single-device cost.
+The reference closes this loop implicitly — its Simulator IS built from
+on-device microbenchmarks (simulator.cc:489) — while our analytic
+roofline can drift per op class; this report makes the drift visible and
+`apply()` feeds the measurements back into the next compile's search.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+# view-independent cost-model key: CostModel._key minus the view hash
+def _op_cost_key(op) -> Tuple:
+    return (
+        op.op_type,
+        op.params,
+        tuple(t.shape_key() for t in op.inputs),
+        tuple(w.shape_key() for w in op.weights),
+    )
+
+
+def attach_profiled_costs(cost_model, profiled: Dict[Tuple, Tuple[float, float]]) -> None:
+    """Install profile_ops measurements as a measured-mode oracle on a
+    CostModel: serial (single-part) views resolve to the measured
+    (fwd, bwd) seconds, sharded views fall back to the analytic roofline
+    (the measurements were taken at full material shapes on one device,
+    so they say nothing about shard-shaped execution)."""
+
+    def measure(op, view):
+        if max(1, view.num_parts()) == 1:
+            fb = profiled.get(_op_cost_key(op))
+            if fb is not None:
+                return fb
+        return (float("nan"), float("nan"))
+
+    cost_model.measure_fn = measure
+
+
+class StrategyExplanation:
+    """Per-op simulated-vs-measured cost table + search-trajectory join.
+
+    rows: dicts sorted by descending |simulated − measured| total cost:
+      name, op_type, parts (searched view parts), sim_fwd_s, sim_bwd_s,
+      meas_fwd_s, meas_bwd_s, abs_err_s, ratio (measured/simulated).
+    """
+
+    def __init__(self, rows: List[dict], trajectory_summary: dict,
+                 searched_cost: Optional[float]):
+        self.rows = rows
+        self.trajectory = trajectory_summary
+        self.searched_cost = searched_cost
+
+    def top(self, n: int = 10) -> List[dict]:
+        return self.rows[:n]
+
+    def most_miscalibrated(self) -> Optional[dict]:
+        return self.rows[0] if self.rows else None
+
+    def calibration_ratios(self) -> Dict[str, float]:
+        """Median measured/simulated ratio per op class — >1 means the
+        cost model is optimistic for that class, <1 pessimistic."""
+        by_cls: Dict[str, List[float]] = {}
+        for r in self.rows:
+            if r["sim_total_s"] > 0:
+                by_cls.setdefault(r["op_type"], []).append(r["ratio"])
+        out = {}
+        for cls, ratios in by_cls.items():
+            ratios.sort()
+            out[cls] = ratios[len(ratios) // 2]
+        return out
+
+    def profiled_costs(self) -> Dict[Tuple, Tuple[float, float]]:
+        return {r["_key"]: (r["meas_fwd_s"], r["meas_bwd_s"])
+                for r in self.rows}
+
+    def apply(self, model) -> int:
+        """Feed the measurements back into the search loop: the model's
+        next compile() builds its cost model with these (fwd, bwd)
+        seconds overriding the analytic roofline for serial views
+        (FFModel._build_cost_model -> attach_profiled_costs). Returns
+        the number of ops fed back."""
+        model._profiled_op_costs = self.profiled_costs()
+        return len(model._profiled_op_costs)
+
+    def summary(self, n: int = 10) -> str:
+        lines = ["strategy explanation "
+                 "(|simulated - measured| cost, worst first)"]
+        if self.searched_cost is not None:
+            lines.append(f"  searched strategy simulated step time: "
+                         f"{self.searched_cost * 1e3:.3f} ms")
+        mc = self.trajectory.get("mcmc", {})
+        sub = self.trajectory.get("substitution", {})
+        lines.append(
+            f"  search: {mc.get('iterations', 0)} MCMC proposal(s) "
+            f"({mc.get('accepted', 0)} accepted), "
+            f"{sub.get('candidates', 0)} substitution candidate(s) "
+            f"({sub.get('improved', 0)} improved the best)"
+        )
+        hdr = (f"  {'op':<28} {'type':<20} {'sim ms':>9} {'meas ms':>9} "
+               f"{'|err| ms':>9} {'ratio':>7}")
+        lines.append(hdr)
+        for r in self.rows[:n]:
+            lines.append(
+                f"  {r['name'][:28]:<28} {r['op_type'][:20]:<20} "
+                f"{r['sim_total_s'] * 1e3:>9.4f} "
+                f"{r['meas_total_s'] * 1e3:>9.4f} "
+                f"{r['abs_err_s'] * 1e3:>9.4f} "
+                f"{r['ratio']:>7.2f}"
+            )
+        ratios = self.calibration_ratios()
+        if ratios:
+            worst = sorted(ratios.items(),
+                           key=lambda kv: abs(kv[1] - 1.0), reverse=True)
+            lines.append("  per-class measured/simulated medians: "
+                         + ", ".join(f"{k}={v:.2f}" for k, v in worst[:6]))
+        return "\n".join(lines)
+
+
+def explain_strategy(model, x=None, *, repeats: int = 3, warmup: int = 1,
+                     cost_model=None) -> StrategyExplanation:
+    """Rank the compiled model's compute ops by cost-model
+    miscalibration: simulated single-device (fwd + bwd) seconds from the
+    search's cost oracle vs measured seconds from
+    runtime/profiler.profile_ops on this host's device.
+
+    `x`: batch input arrays (defaults to random data at the compiled
+    input shapes). `cost_model`: the oracle to audit (defaults to the
+    model's own, the one the search used)."""
+    import numpy as np
+
+    from ..pcg.machine_view import MachineView
+    from ..runtime.profiler import profile_ops
+    from ..runtime.verify import NotCompiledError
+
+    if model.executor is None:
+        raise NotCompiledError("explain_strategy: call compile() first")
+    cm = cost_model if cost_model is not None else model._build_cost_model()
+    in_pts = model.executor.input_pts
+    if x is None:
+        rng = np.random.RandomState(0)
+        x = []
+        for pt in in_pts:
+            shape = pt.material_shape()
+            if pt.data_type.np_dtype in (np.int32, np.int64):
+                x.append(rng.randint(0, 2, shape).astype(pt.data_type.np_dtype))
+            else:
+                x.append(rng.rand(*shape).astype(pt.data_type.np_dtype))
+    else:
+        x = [np.asarray(a, pt.data_type.np_dtype)
+             for pt, a in zip(in_pts, x if isinstance(x, (list, tuple))
+                              else [x])]
+
+    measured = profile_ops(model, x, repeats=repeats, warmup=warmup,
+                           backward=True)
+    views = getattr(model, "searched_views", None) or {}
+    v1 = MachineView(start_device_id=0, dim=(1,), stride=(1,))
+    rows: List[dict] = []
+    for op in model.graph.ops:
+        if op.is_parallel_op:
+            continue
+        prof = measured.get(op.name)
+        if prof is None:
+            continue
+        c = cm.measure_operator_cost(op, v1)
+        sim_f, sim_b = c.forward_time, c.backward_time
+        meas_f, meas_b = prof.forward_s, prof.backward_s
+        sim_t = sim_f + sim_b
+        meas_t = meas_f + meas_b
+        view = views.get(op.guid) or op.machine_view
+        rows.append({
+            "name": op.name,
+            "op_type": op.op_type.name,
+            "parts": max(1, view.num_parts()) if view is not None else 1,
+            "sim_fwd_s": sim_f, "sim_bwd_s": sim_b, "sim_total_s": sim_t,
+            "meas_fwd_s": meas_f, "meas_bwd_s": meas_b,
+            "meas_total_s": meas_t,
+            "abs_err_s": abs(sim_t - meas_t),
+            "ratio": (meas_t / sim_t) if sim_t > 0 else float("inf"),
+            "_key": _op_cost_key(op),
+        })
+    rows.sort(key=lambda r: r["abs_err_s"], reverse=True)
+    traj = getattr(model, "search_trajectory", None)
+    tsum = traj.summary() if traj is not None else {}
+    return StrategyExplanation(
+        rows, tsum, getattr(model, "searched_cost", None)
+    )

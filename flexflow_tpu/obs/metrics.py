@@ -1,0 +1,235 @@
+"""Metrics registry: counters, gauges, histograms.
+
+The reference surfaces runtime health as scattered prints; here every
+runtime subsystem feeds named series in one registry, exported as a
+Prometheus text file (node-exporter textfile-collector compatible) and as
+JSONL snapshots. Series support optional labels (`registry.counter(name,
+kind="all-reduce")`), thread-safe under one registry lock — updates come
+from the training loop, the serving worker and the health-monitor
+threads concurrently.
+
+Naming follows Prometheus conventions: `ff_<noun>_<unit>` gauges /
+histograms, `ff_<noun>_total` counters, base units (seconds, bytes).
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+# default histogram buckets: 100us .. ~2min, log-spaced — wide enough for
+# both per-step wall times and serving latencies
+DEFAULT_BUCKETS = tuple(
+    1e-4 * (2.5 ** i) for i in range(12)
+) + (float("inf"),)
+
+_RESERVOIR = 4096  # raw samples kept per histogram for exact quantiles
+
+
+class Counter:
+    __slots__ = ("value", "_lock")
+
+    kind = "counter"
+
+    def __init__(self, lock):
+        self.value = 0.0
+        self._lock = lock
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    __slots__ = ("value", "_lock")
+
+    kind = "gauge"
+
+    def __init__(self, lock):
+        self.value = 0.0
+        self._lock = lock
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Histogram:
+    """Cumulative-bucket histogram + a bounded reservoir of raw samples
+    (newest `_RESERVOIR`) so `quantile()` reports exact percentiles of
+    recent traffic instead of bucket-edge approximations."""
+
+    __slots__ = ("buckets", "counts", "sum", "count", "_samples", "_lock")
+
+    kind = "histogram"
+
+    def __init__(self, lock, buckets=DEFAULT_BUCKETS):
+        self.buckets = tuple(sorted(buckets))
+        if not self.buckets or self.buckets[-1] != float("inf"):
+            self.buckets = self.buckets + (float("inf"),)
+        self.counts = [0] * len(self.buckets)
+        self.sum = 0.0
+        self.count = 0
+        self._samples: List[float] = []
+        self._lock = lock
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.sum += v
+            self.count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+                    break
+            self._samples.append(v)
+            if len(self._samples) > _RESERVOIR:
+                del self._samples[: len(self._samples) - _RESERVOIR]
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return float("nan")
+            s = sorted(self._samples)
+        i = min(len(s) - 1, max(0, int(math.ceil(q * len(s))) - 1))
+        return s[i]
+
+
+def _fmt_labels(labels: Optional[Tuple[Tuple[str, str], ...]],
+                extra: Optional[Dict[str, str]] = None) -> str:
+    items = list(labels or ())
+    if extra:
+        items += list(extra.items())
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in items) + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if v != v:
+        return "NaN"
+    return repr(float(v))
+
+
+class MetricsRegistry:
+    """Get-or-create registry of named (and optionally labeled) series."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # name -> (kind, help, {label-tuple: series})
+        self._families: Dict[str, Tuple[str, str, Dict]] = {}
+
+    def _series(self, cls, name: str, help_: str, labels: dict, **kw):
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = (cls.kind, help_, {})
+                self._families[name] = fam
+            elif fam[0] != cls.kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam[0]}, "
+                    f"requested {cls.kind}"
+                )
+            series = fam[2].get(key)
+            if series is None:
+                series = cls(self._lock, **kw)
+                fam[2][key] = series
+            return series
+
+    def counter(self, name: str, help: str = "", **labels) -> Counter:
+        return self._series(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "", **labels) -> Gauge:
+        return self._series(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets=DEFAULT_BUCKETS, **labels) -> Histogram:
+        return self._series(Histogram, name, help, labels, buckets=buckets)
+
+    # -- export ----------------------------------------------------------
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format (v0.0.4)."""
+        lines: List[str] = []
+        with self._lock:
+            fams = {
+                name: (kind, help_, dict(series))
+                for name, (kind, help_, series) in sorted(
+                    self._families.items()
+                )
+            }
+        for name, (kind, help_, series) in fams.items():
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {kind}")
+            for key, s in series.items():
+                if kind == "histogram":
+                    cum = 0
+                    for b, c in zip(s.buckets, s.counts):
+                        cum += c
+                        lines.append(
+                            f"{name}_bucket"
+                            + _fmt_labels(key, {"le": _fmt_value(b)})
+                            + f" {cum}"
+                        )
+                    lines.append(f"{name}_sum{_fmt_labels(key)} "
+                                 f"{_fmt_value(s.sum)}")
+                    lines.append(f"{name}_count{_fmt_labels(key)} {s.count}")
+                else:
+                    lines.append(
+                        f"{name}{_fmt_labels(key)} {_fmt_value(s.value)}"
+                    )
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> List[dict]:
+        """One JSON-serializable record per series (the metrics.jsonl
+        lines): histograms carry sum/count plus p50/p95/p99 of the recent
+        reservoir."""
+        out: List[dict] = []
+        now = time.time()
+        with self._lock:
+            fams = {
+                name: (kind, dict(series))
+                for name, (kind, _h, series) in sorted(self._families.items())
+            }
+        for name, (kind, series) in fams.items():
+            for key, s in series.items():
+                rec = {"time": now, "name": name, "kind": kind,
+                       "labels": dict(key)}
+                if kind == "histogram":
+                    rec.update(sum=s.sum, count=s.count,
+                               p50=s.quantile(0.50), p95=s.quantile(0.95),
+                               p99=s.quantile(0.99))
+                else:
+                    rec["value"] = s.value
+                out.append(rec)
+        return out
+
+    def to_jsonl(self) -> str:
+        return "".join(json.dumps(r) + "\n" for r in self.snapshot())
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Minimal parser for the text exposition format (tests + the CLI's
+    `prom` round-trip check): returns {series-with-labels: value},
+    raising ValueError on malformed sample lines."""
+    out: Dict[str, float] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            series, value = line.rsplit(" ", 1)
+            out[series] = (float("inf") if value == "+Inf"
+                           else float(value))
+        except ValueError as e:
+            raise ValueError(f"line {i}: bad sample {line!r} ({e})") from e
+    return out

@@ -1,0 +1,281 @@
+"""Structured span/event tracer.
+
+The reference ships `-lg:prof` (Legion profiler logs rendered by
+legion_prof into a browsable timeline) plus per-op cudaEvent prints under
+--profiling (SURVEY §5); this is the TPU-native unification: a
+low-overhead in-process tracer emitting a structured JSONL event log that
+exports to Chrome-trace/Perfetto JSON, with the SAME schema used by the
+simulator's timeline export (runtime/profiler.py
+export_simulated_timeline) so simulated and measured timelines overlay in
+one Perfetto view.
+
+Event schema (one JSON object per events.jsonl line):
+
+    {"ts": <float, seconds since session start>,
+     "ph": "X" | "i",              # complete span | instant
+     "name": <str>,                # e.g. "step", "mcmc_iter"
+     "cat": <str>,                 # "compile" | "search" | "train" |
+                                   # "checkpoint" | "runtime" | "serving"
+                                   # | "simulated" | ...
+     "dur": <float, seconds>,      # spans only
+     "tid": <int>,                 # lane within the category (device id
+                                   # for simulated timelines, else 0)
+     "args": {...}}                # free-form structured payload
+
+Disabled-path cost is ~zero: when no telemetry session is active the
+module-level helpers in `flexflow_tpu.obs` hand out the shared
+`NULL_TRACER`, whose `span()` returns one preallocated no-op context
+manager and whose `instant()` is a constant `return` — no per-call
+allocation.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+EVENT_REQUIRED_KEYS = ("ts", "ph", "name", "cat")
+_PHASES = ("X", "i")
+
+
+def validate_event(obj) -> List[str]:
+    """Schema-check one decoded event; returns problem strings (empty =
+    valid). Used by tests and the CLI's summary command."""
+    problems = []
+    if not isinstance(obj, dict):
+        return [f"event is {type(obj).__name__}, not an object"]
+    for k in EVENT_REQUIRED_KEYS:
+        if k not in obj:
+            problems.append(f"missing key {k!r}")
+    ph = obj.get("ph")
+    if ph not in _PHASES:
+        problems.append(f"ph={ph!r} not in {_PHASES}")
+    if ph == "X" and not isinstance(obj.get("dur"), (int, float)):
+        problems.append("span (ph=X) without numeric dur")
+    if not isinstance(obj.get("ts", 0.0), (int, float)):
+        problems.append(f"ts={obj.get('ts')!r} not numeric")
+    if "args" in obj and not isinstance(obj["args"], dict):
+        problems.append("args is not an object")
+    return problems
+
+
+class _NullSpan:
+    """Shared do-nothing context manager (the disabled-tracer span)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **args):  # matches Span.set
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullTracer:
+    """The disabled tracer: every call is a no-op and `span()` returns a
+    single preallocated context manager, so the off path allocates
+    nothing per step."""
+
+    __slots__ = ()
+    enabled = False
+
+    def span(self, name, cat="runtime", **args):
+        return _NULL_SPAN
+
+    def instant(self, name, cat="runtime", **args):
+        return None
+
+    def emit(self, event):
+        return None
+
+
+NULL_TRACER = NullTracer()
+
+
+class Span:
+    """A completed-event ("X") recorder; use as a context manager or via
+    the explicit `done()` call."""
+
+    __slots__ = ("_tracer", "name", "cat", "args", "_t0", "tid")
+
+    def __init__(self, tracer, name, cat, args, tid=0):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.tid = tid
+        self._t0 = time.perf_counter()
+
+    def set(self, **args):
+        """Attach/overwrite args mid-span (e.g. the step's loss)."""
+        if self.args is None:
+            self.args = {}
+        self.args.update(args)
+        return self
+
+    def done(self):
+        t1 = time.perf_counter()
+        self._tracer.emit({
+            "ts": self._t0 - self._tracer.t0,
+            "ph": "X",
+            "name": self.name,
+            "cat": self.cat,
+            "dur": t1 - self._t0,
+            "tid": self.tid,
+            "args": self.args or {},
+        })
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.done()
+        return False
+
+
+class Tracer:
+    """Buffered JSONL event recorder.
+
+    Events accumulate in memory and flush to `path` (append) every
+    `flush_every` events and on `close()`. A `max_events` cap bounds both
+    memory and disk; overflow is counted in `dropped` and reported as one
+    final instant event at close."""
+
+    enabled = True
+
+    def __init__(self, path: Optional[str] = None, *, t0: Optional[float] = None,
+                 flush_every: int = 256, max_events: int = 200_000):
+        self.path = path
+        self.t0 = time.perf_counter() if t0 is None else t0
+        self.flush_every = max(1, flush_every)
+        self.max_events = max_events
+        self.events: List[dict] = []
+        self.dropped = 0
+        self._written = 0  # events already flushed to disk
+        self._emitted = 0
+        self._lock = threading.Lock()
+
+    # -- recording -------------------------------------------------------
+    def span(self, name, cat="runtime", tid=0, **args) -> Span:
+        return Span(self, name, cat, args or None, tid=tid)
+
+    def instant(self, name, cat="runtime", tid=0, **args) -> None:
+        self.emit({
+            "ts": time.perf_counter() - self.t0,
+            "ph": "i",
+            "name": name,
+            "cat": cat,
+            "tid": tid,
+            "args": args,
+        })
+
+    def emit(self, event: dict) -> None:
+        with self._lock:
+            if self._emitted >= self.max_events:
+                self.dropped += 1
+                return
+            self._emitted += 1
+            self.events.append(event)
+            if self.path and len(self.events) - self._written >= self.flush_every:
+                self._flush_locked()
+
+    # -- output ----------------------------------------------------------
+    def _flush_locked(self) -> None:
+        if not self.path:
+            return
+        chunk = self.events[self._written:]
+        if not chunk:
+            return
+        with open(self.path, "a") as f:
+            for e in chunk:
+                f.write(json.dumps(e) + "\n")
+        self._written = len(self.events)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def close(self) -> None:
+        with self._lock:
+            if self.dropped:
+                self._emitted += 1
+                self.events.append({
+                    "ts": time.perf_counter() - self.t0,
+                    "ph": "i", "name": "events_dropped", "cat": "obs",
+                    "tid": 0, "args": {"dropped": self.dropped},
+                })
+            self._flush_locked()
+
+
+# ----------------------------------------------------------------------
+# Chrome-trace / Perfetto export (the shared schema both the runtime
+# tracer and the simulator's timeline export emit)
+# ----------------------------------------------------------------------
+def to_chrome_trace(events: Iterable[dict]) -> dict:
+    """Internal events -> Chrome trace JSON (Perfetto-loadable).
+
+    Categories become processes (stable pid per cat, named via
+    process_name metadata) so a simulated timeline (cat "simulated") and
+    the measured runtime (cat "train" etc.) overlay as separate tracks in
+    one Perfetto view; `tid` is the lane within a category (device id for
+    per-device timelines). Seconds become microseconds and the whole
+    trace is shifted so the earliest timestamp is 0 (compile-time events
+    replayed into a later session may carry negative session-relative
+    ts)."""
+    events = [e for e in events if not validate_event(e)]
+    pids: Dict[str, int] = {}
+    out: List[dict] = []
+    min_ts = min((float(e["ts"]) for e in events), default=0.0)
+    for e in events:
+        cat = str(e.get("cat", "runtime"))
+        pid = pids.setdefault(cat, len(pids))
+        entry = {
+            "name": e["name"],
+            "cat": cat,
+            "ph": e["ph"],
+            "ts": (float(e["ts"]) - min_ts) * 1e6,
+            "pid": pid,
+            "tid": int(e.get("tid", 0)),
+            "args": e.get("args", {}),
+        }
+        if e["ph"] == "X":
+            entry["dur"] = float(e.get("dur", 0.0)) * 1e6
+        else:
+            entry["s"] = "t"  # instant scope: thread
+        out.append(entry)
+    meta = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": cat}}
+        for cat, pid in pids.items()
+    ]
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+def read_events_jsonl(path: str) -> Tuple[List[dict], List[str]]:
+    """Load an events.jsonl file; returns (events, problems) where
+    problems collects per-line schema violations."""
+    events: List[dict] = []
+    problems: List[str] = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError as e:
+                problems.append(f"line {i}: not JSON ({e})")
+                continue
+            bad = validate_event(obj)
+            if bad:
+                problems.append(f"line {i}: " + "; ".join(bad))
+            else:
+                events.append(obj)
+    return events, problems

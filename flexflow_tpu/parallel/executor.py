@@ -175,6 +175,9 @@ class PCGExecutor:
         # None = unguarded step (the default). Changing it invalidates
         # the cached train step (set_step_guard).
         self.step_guard = None
+        # extra per-step outputs folded into the metric partials
+        # (set_step_metrics; telemetry feed, e.g. "grad_norm")
+        self.step_metrics: tuple = ()
         self._train_step = None
         self._train_step_nodonate = None
         self._train_scan = None
@@ -654,6 +657,21 @@ class PCGExecutor:
             self._train_step_nodonate = None
             self._train_scan = None
 
+    def set_step_metrics(self, names) -> None:
+        """Request extra per-step outputs in the metric partials
+        (obs telemetry feed). Supported: ``"grad_norm"`` — the global
+        gradient norm, already present whenever the step guard is armed,
+        computed on demand otherwise. Traced into the step program, so a
+        change invalidates the cached steps like set_step_guard."""
+        names = tuple(names or ())
+        unknown = [n for n in names if n != "grad_norm"]
+        assert not unknown, f"unsupported step metrics: {unknown}"
+        if names != self.step_metrics:
+            self.step_metrics = names
+            self._train_step = None
+            self._train_step_nodonate = None
+            self._train_scan = None
+
     def init_guard_state(self) -> GuardState:
         assert self.step_guard is not None, "set_step_guard() first"
         cfg = self.step_guard
@@ -700,6 +718,10 @@ class PCGExecutor:
                 new_guard = state.guard
                 partials = self.metrics.compute(logits, labels)
                 partials["loss"] = loss
+                if "grad_norm" in self.step_metrics:
+                    # telemetry feed (set_step_metrics): the guard path
+                    # below always computes this; here it is opt-in
+                    partials["grad_norm"] = global_grad_norm(grads)
             else:
                 # -- NaN/Inf step guard (resilience.StepGuardConfig) ----
                 # fit()'s fault-injection seam: extra[0] is a grad poison

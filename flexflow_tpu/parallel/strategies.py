@@ -80,10 +80,14 @@ def assign_mesh_axes(graph: Graph, max_devices: int) -> Dict[str, int]:
     while data_deg * model_deg * pipe_deg > max_devices and model_deg > 1:
         model_deg //= 2
     if data_deg * model_deg * pipe_deg > max_devices:
-        print(
+        from .. import obs
+
+        obs.progress(
             f"[flexflow_tpu] warning: dropping pipeline degree {pipe_deg} "
             f"(needs {pipe_deg} devices, have {max_devices}); block-stack "
-            f"ops fall back to the sequential scan"
+            f"ops fall back to the sequential scan",
+            name="pipeline_degree_dropped", cat="compile",
+            requested=pipe_deg, devices=max_devices,
         )
         pipe_deg = 1  # ops degrade to the sequential scan path, still correct
     for t in tensors:

@@ -164,6 +164,14 @@ def save_checkpoint(model, path: str, *, step: Optional[int] = None,
         os.replace(tmp_meta, path + ".meta.json")
         if old is not None:
             shutil.rmtree(old, ignore_errors=True)
+        from .. import obs
+
+        obs.gauge_set(
+            "ff_checkpoint_bytes",
+            sum(int(np.asarray(v).nbytes)
+                for v in jax.tree_util.tree_leaves(host_state)),
+            help="serialized size of the last checkpoint's state tree",
+        )
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         if os.path.exists(tmp_meta):

@@ -169,6 +169,11 @@ def restore_elastic(model_fn: Callable[[], "FFModel"], ckpt_dir: str,
                 "%d-device topology (cost %.3g)",
                 len(result.views), ndev, result.cost,
             )
+            from .. import obs
+
+            obs.event("elastic_research", cat="runtime",
+                      views=len(result.views), devices=ndev,
+                      cost=result.cost)
             model.searched_views = result.views
             bad = []
     if bad:
@@ -389,6 +394,8 @@ class HealthMonitor:
 
     # -- internals -------------------------------------------------------
     def _escalate(self, kind: str, detail: dict) -> None:
+        from .. import obs
+
         with self._lock:
             if self.hang_detected:
                 return
@@ -397,6 +404,10 @@ class HealthMonitor:
                               "timeout_s": self.timeout_s, **detail}
         logger.error("health watchdog: %s detected (%s)", kind,
                      self.hang_info)
+        obs.event("watchdog_fired", cat="runtime", **self.hang_info)
+        obs.count("ff_watchdog_hangs_total",
+                  help="hangs/stragglers the health watchdog detected",
+                  kind=kind)
         if self.on_hang is not None:
             try:
                 self.on_hang(dict(self.hang_info))
@@ -429,7 +440,10 @@ class HealthMonitor:
                 return
 
     def _heartbeat_loop(self) -> None:
+        from .. import obs
+
         while not self._stop.is_set():
+            t0 = time.monotonic()
             try:
                 bad = self.heartbeat_fn()
             except Exception as e:
@@ -440,6 +454,13 @@ class HealthMonitor:
                 return
             with self._lock:
                 self._last_beat_ok = time.monotonic()
+            # telemetry feed: each good beat counts, and the beat's own
+            # duration is a cheap interconnect-health signal
+            obs.count("ff_heartbeats_total",
+                      help="successful health-monitor heartbeats")
+            obs.gauge_set("ff_heartbeat_seconds",
+                          time.monotonic() - t0,
+                          help="duration of the last heartbeat probe")
             self._stop.wait(self.heartbeat_interval_s)
 
 

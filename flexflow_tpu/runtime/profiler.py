@@ -7,16 +7,20 @@ TPU-native equivalents of the reference's profiling stack (SURVEY §5):
     from running each op un-jitted — same caveat the simulator had)
   * Legion begin/end_trace replay            -> jit cache (free)
   * `-lg:prof` Legion profiler               -> jax.profiler traces viewable
-    in TensorBoard/Perfetto
-  * simulator timeline export                -> search/mcmc.simulate_runtime
-    + export_simulated_timeline here
+    in TensorBoard/Perfetto, plus the obs/ structured tracer
+    (flexflow_tpu.obs) for framework-level spans
+  * simulator timeline export                -> export_simulated_timeline,
+    emitting the SAME Chrome-trace schema as the obs tracer
+    (obs/tracer.py to_chrome_trace) so a simulated schedule and a
+    measured run overlay in one Perfetto view
 """
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import json
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Union
 
 import jax
 
@@ -31,9 +35,33 @@ def trace(logdir: str):
         jax.profiler.stop_trace()
 
 
-def profile_ops(model, batch_inputs, *, repeats: int = 3) -> Dict[str, float]:
-    """Per-op forward wall-times in seconds (reference: per-op event timing
-    under FFConfig.profiling). Runs ops eagerly in topo order."""
+@dataclasses.dataclass
+class OpProfile:
+    """Measured per-op wall times, in SECONDS (the same unit the cost
+    model's CostMetrics and the simulated timeline use — keeping the
+    units consistent is what lets obs.explain_strategy subtract them)."""
+
+    forward_s: float
+    backward_s: float = 0.0
+
+    @property
+    def total_s(self) -> float:
+        return self.forward_s + self.backward_s
+
+
+def profile_ops(
+    model, batch_inputs, *, repeats: int = 3, warmup: int = 1,
+    backward: bool = False,
+) -> Union[Dict[str, float], Dict[str, "OpProfile"]]:
+    """Per-op wall-times (reference: per-op event timing under
+    FFConfig.profiling). Runs ops eagerly in topo order, `warmup`
+    untimed runs first (the first eager call pays compilation/layout),
+    then `repeats` timed runs averaged.
+
+    Default return: {op name: forward seconds} (back-compat).
+    `backward=True` additionally times each compute op's VJP (weights +
+    float inputs) and returns {op name: OpProfile} — parallel ops and
+    non-differentiable ops report backward_s=0.0."""
     ex = model.executor
     import jax.numpy as jnp
 
@@ -43,36 +71,84 @@ def profile_ops(model, batch_inputs, *, repeats: int = 3) -> Dict[str, float]:
     from ..ops.registry import FwdCtx, get_op_def
     from ..parallel import parallel_ops as par_ops
 
-    times: Dict[str, float] = {}
+    times: Dict[str, OpProfile] = {}
     for op in ex.topo:
         ins = [vals[t.guid] for t in op.inputs]
         if op.is_parallel_op:
             fn = lambda: par_ops.execute(op, ins, ex.mesh)  # noqa: E731
+            bwd_fn = None
         else:
             d = get_op_def(op.op_type)
             w = model.state.params.get(op.name, {})
             ctx = FwdCtx(training=False, rng=None)
             fn = lambda: d.forward(op.params, w, ins, ctx)  # noqa: E731
+            bwd_fn = None
+            if backward:
+                diffable = [
+                    i for i, a in enumerate(ins)
+                    if jnp.issubdtype(a.dtype, jnp.floating)
+                ]
+                w_diff = {k: v for k, v in w.items()
+                          if jnp.issubdtype(v.dtype, jnp.floating)}
+                if diffable or w_diff:
+                    def loss(ws, dins, _d=d, _op=op, _ins=ins,
+                             _w=w, _idx=diffable, _ctx=ctx):
+                        full = list(_ins)
+                        for i, v in zip(_idx, dins):
+                            full[i] = v
+                        wall = dict(_w)
+                        wall.update(ws)
+                        outs = _d.forward(_op.params, wall, full, _ctx)
+                        return sum(
+                            jnp.sum(o.astype(jnp.float32)) for o in outs
+                        )
+
+                    grad = jax.grad(loss, argnums=(0, 1))
+                    bwd_fn = (lambda _g=grad, _w=w_diff, _ins=ins,  # noqa: E731
+                              _idx=diffable:
+                              _g(_w, [_ins[i] for i in _idx]))
         outs = fn()
         jax.block_until_ready(outs)
+        for _ in range(max(0, warmup - 1)):
+            jax.block_until_ready(fn())
         t0 = time.perf_counter()
         for _ in range(repeats):
             outs = fn()
         jax.block_until_ready(outs)
-        times[op.name] = (time.perf_counter() - t0) / repeats
+        fwd_t = (time.perf_counter() - t0) / repeats
+        bwd_t = 0.0
+        if bwd_fn is not None:
+            try:
+                g = bwd_fn()
+                jax.block_until_ready(g)
+                for _ in range(max(0, warmup - 1)):
+                    jax.block_until_ready(bwd_fn())
+                t0 = time.perf_counter()
+                for _ in range(repeats):
+                    g = bwd_fn()
+                jax.block_until_ready(g)
+                # grad re-runs the forward: subtract it, floor at 10%
+                # like search/measure.py so noise can't go negative
+                total = (time.perf_counter() - t0) / repeats
+                bwd_t = max(total - fwd_t, 0.1 * fwd_t)
+            except (TypeError, ValueError, NotImplementedError):
+                bwd_t = 0.0  # not differentiable standalone (int paths)
+        times[op.name] = OpProfile(forward_s=fwd_t, backward_s=bwd_t)
         for t, o in zip(op.outputs, outs):
             vals[t.guid] = o
-    return times
+    if backward:
+        return times
+    return {name: p.forward_s for name, p in times.items()}
 
 
-def export_simulated_timeline(graph, views, cost_model, path: str) -> None:
-    """Export the simulated schedule as Chrome trace JSON (reference:
-    Simulator::simulate_runtime's export_file_name, simulator.h:724)."""
-    from ..search.mcmc import simulate_runtime  # noqa: F401  (cost semantics)
-
+def simulated_timeline_events(graph, views, cost_model,
+                              *, backward: bool = False) -> List[dict]:
+    """The simulated schedule as obs-tracer events (the schema
+    obs/tracer.py documents: ts/dur in seconds, cat "simulated", tid =
+    device id) — export with obs.to_chrome_trace, or merge with a
+    measured events.jsonl to overlay simulation against reality."""
     events: List[dict] = []
     dev_free: Dict[int, float] = {}
-    prod = graph.producers()
     ready: Dict[int, float] = {}
     for op in graph.topo_order():
         view = views[op.guid]
@@ -82,20 +158,40 @@ def export_simulated_timeline(graph, views, cost_model, path: str) -> None:
         )
         ids = view.device_ids()
         start = max([lb] + [dev_free.get(d, 0.0) for d in ids])
-        end = start + cm.forward_time
+        dur = cm.forward_time + (cm.backward_time if backward else 0.0)
+        end = start + dur
         for d in ids:
             dev_free[d] = end
-            events.append(
-                {
-                    "name": op.name,
-                    "ph": "X",
-                    "ts": start * 1e6,
-                    "dur": (end - start) * 1e6,
-                    "pid": 0,
-                    "tid": d,
-                }
-            )
+            events.append({
+                "ts": start,
+                "ph": "X",
+                "name": op.name,
+                "cat": "simulated",
+                "dur": dur,
+                "tid": d,
+                "args": {
+                    "op_type": op.op_type.name,
+                    "forward_s": cm.forward_time,
+                    "backward_s": cm.backward_time,
+                    "sync_s": cm.sync_time,
+                },
+            })
         for t in op.outputs:
             ready[t.guid] = end
+    return events
+
+
+def export_simulated_timeline(graph, views, cost_model, path: str) -> None:
+    """Export the simulated schedule as Chrome trace JSON (reference:
+    Simulator::simulate_runtime's export_file_name, simulator.h:724),
+    in the SAME schema as the runtime tracer's trace.json (categories as
+    named processes, devices as tids) so both load into one Perfetto
+    session and overlay."""
+    from ..obs.tracer import to_chrome_trace
+
     with open(path, "w") as f:
-        json.dump({"traceEvents": events}, f)
+        json.dump(
+            to_chrome_trace(simulated_timeline_events(graph, views,
+                                                      cost_model)),
+            f,
+        )

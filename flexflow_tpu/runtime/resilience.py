@@ -131,6 +131,8 @@ def retry(
     retried with exponential backoff + jitter, anything else (and the
     final exhausted attempt) propagates. `on_retry(attempt, exc, delay)`
     observes each retry; `sleep` is injectable so tests run at full speed."""
+    from .. import obs
+
     policy = policy or RetryPolicy()
     attempts = max(1, policy.max_attempts)
     for attempt in range(attempts):
@@ -140,6 +142,10 @@ def retry(
             if attempt == attempts - 1:
                 raise
             d = policy.delay(attempt)
+            obs.count("ff_retries_total",
+                      help="retried transient failures (runtime.retry)")
+            obs.event("retry", cat="runtime", attempt=attempt,
+                      error=type(e).__name__, delay_s=d)
             if on_retry is not None:
                 on_retry(attempt, e, d)
             sleep(d)
@@ -369,6 +375,7 @@ class CheckpointManager:
     def save(self, model, step: int, extra_meta: Optional[dict] = None) -> str:
         """Atomically write `model`'s full training state as step `step`,
         retrying transient I/O failures, then advance LATEST and GC."""
+        from .. import obs
         from .checkpoint import save_checkpoint
 
         path = self.step_path(step)
@@ -381,7 +388,11 @@ class CheckpointManager:
                                    extra_meta=extra_meta,
                                    _pre_rename_hook=hook)
 
-        retry(_write, self.retry_policy, sleep=self._sleep)
+        with obs.span("checkpoint_save", cat="checkpoint", step=step,
+                      path=path):
+            retry(_write, self.retry_policy, sleep=self._sleep)
+        obs.count("ff_checkpoint_saves_total",
+                  help="checkpoints written (CheckpointManager.save)")
         if self.fault_injector is not None:
             # SDC-on-disk simulation (runtime/verify.py): corrupt the
             # checkpoint AFTER its checksums were recorded, so the
@@ -409,6 +420,7 @@ class CheckpointManager:
         checkpoint written on a different device topology — whose
         re-searched PCG carries different parallel ops — still restores
         onto the live mesh (runtime/elastic.py)."""
+        from .. import obs
         from .checkpoint import load_checkpoint_meta, restore_checkpoint
 
         latest = self.latest_step()
@@ -419,11 +431,22 @@ class CheckpointManager:
         for s in candidates:
             path = self.step_path(s)
             try:
-                step = restore_checkpoint(model, path,
-                                          strict_topology=not elastic)
+                with obs.span("checkpoint_restore", cat="checkpoint",
+                              step=s, path=path, elastic=elastic):
+                    step = restore_checkpoint(model, path,
+                                              strict_topology=not elastic)
                 meta = load_checkpoint_meta(path) or {}
+                obs.count("ff_checkpoint_restores_total",
+                          help="successful checkpoint restores")
                 return RestoreResult(step=step, path=path, meta=meta)
             except Exception as e:  # corrupt/partial — try the next older
+                obs.count(
+                    "ff_checkpoint_restore_fallbacks_total",
+                    help="corrupt/partial checkpoints skipped on restore",
+                )
+                obs.event("checkpoint_restore_failed", cat="checkpoint",
+                          step=s, error=type(e).__name__,
+                          detail=str(e)[:500])
                 warnings.warn(
                     f"checkpoint {path} failed to restore ({e!r}); "
                     "falling back to an older checkpoint"

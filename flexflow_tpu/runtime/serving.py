@@ -532,6 +532,12 @@ class BatchScheduler:
             if time.monotonic() < self._next_restart_t:
                 return False  # still backing off: degraded for now
             self.stats["worker_restarts"] += 1
+            from .. import obs
+
+            obs.count("ff_serving_worker_restarts_total",
+                      help="serving worker threads restarted after crash")
+            obs.event("serving_worker_restart", cat="serving",
+                      restarts=self.stats["worker_restarts"])
             self._worker_error = None
             self._worker = threading.Thread(target=self._loop, daemon=True)
             self._worker.start()
@@ -548,7 +554,10 @@ class BatchScheduler:
         InferenceTimeout and are retried per `self.retry_policy`; a dead
         worker degrades to direct unbatched execution instead of hanging
         every caller until restart."""
+        from .. import obs
         from .resilience import InferenceTimeout, retry
+
+        t_start = time.perf_counter()
 
         def attempt():
             if not self._maybe_restart_worker():
@@ -570,7 +579,20 @@ class BatchScheduler:
                 return self._infer_direct(inputs)
             return req.result
 
-        return retry(attempt, self.retry_policy)
+        try:
+            out = retry(attempt, self.retry_policy)
+        except BaseException:
+            obs.count("ff_serving_errors_total",
+                      help="serving requests that failed after retries")
+            raise
+        # latency percentiles ride the histogram's reservoir
+        # (metrics.prom buckets + p50/p95/p99 in metrics.jsonl)
+        obs.observe("ff_serving_latency_seconds",
+                    time.perf_counter() - t_start,
+                    help="end-to-end serving request latency")
+        obs.count("ff_serving_requests_total",
+                  help="serving requests answered")
+        return out
 
     def _infer_direct(self, inputs: List[np.ndarray]) -> np.ndarray:
         """DEGRADED mode: run one request on the caller's thread, padded

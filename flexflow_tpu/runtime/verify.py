@@ -762,7 +762,13 @@ def verify_strategy(model, data, *, steps: int = 2,
             rtol=r, atol=t,
         )
     if verbose:
-        print("[verify] " + verdict.summary().replace("\n", "\n[verify] "))
+        from .. import obs
+
+        obs.progress(
+            "[verify] " + verdict.summary().replace("\n", "\n[verify] "),
+            name="verify_verdict", cat="runtime", ok=verdict.ok,
+            diverging_op=verdict.diverging_op,
+        )
     if raise_on_divergence and not verdict.ok:
         raise StrategyDivergenceError(
             "searched strategy is NOT equivalent to the serial reference:\n"
@@ -801,13 +807,13 @@ def _main(argv: List[str]) -> int:
     import json as _json
 
     if not argv:
-        print("usage: python -m flexflow_tpu.runtime.verify "
+        print("usage: python -m flexflow_tpu.runtime.verify "  # fflint: disable=FFL201
               "<checkpoint-path> [...]")
         return 2
     rc = 0
     for p in argv:
         rep = verify_checkpoint(p)
-        print(_json.dumps(rep, indent=2))
+        print(_json.dumps(rep, indent=2))  # fflint: disable=FFL201
         if not rep["ok"]:
             rc = 1
     return rc

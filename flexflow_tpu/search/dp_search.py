@@ -50,10 +50,16 @@ class SearchHelper:
         cost_model: CostModel,
         *,
         max_views_per_op: int = 32,
+        trajectory=None,
     ):
         self.cost_model = cost_model
         self.machine = cost_model.machine
         self.max_views_per_op = max_views_per_op
+        # obs.SearchTrajectory: records each DP subproblem decision
+        # (sequence/nonsequence/diamond splits with their best costs) —
+        # bounded by the trajectory's limit, so the hot memoized path
+        # stays cheap (obs/trajectory.py)
+        self.trajectory = trajectory
         self._memo: Dict[Tuple, GraphCostResult] = {}
         self._view_cache: Dict[Tuple, List[MachineView]] = {}
         self._node_cost_cache: Dict[Tuple, float] = {}
@@ -459,6 +465,11 @@ class SearchHelper:
                         views_map.update(r2.views)
                         best = GraphCostResult(total, views_map)
                 _rlog.info("best sequence cost %.4f", best.cost)
+                if self.trajectory is not None:
+                    self.trajectory.event(
+                        "dp_split", split="sequence", bottleneck=bn.name,
+                        pre=len(pre), post=len(post), cost=best.cost,
+                    )
                 return best
 
         # 2. sink-converging diamond (Inception modules: k independent
@@ -700,6 +711,7 @@ class SearchHelper:
         best_views = dict(ra.views)
         best_views.update(rb.views)
         best = GraphCostResult(ra.cost + rb.cost, best_views)
+        chosen = "sequential"
         # vertical machine split: halves run concurrently, times max
         if res.available_procs_per_node >= 2:
             half = dataclasses.replace(
@@ -718,6 +730,7 @@ class SearchHelper:
                 views = dict(ra2.views)
                 views.update(rb2.views)
                 best = GraphCostResult(cost2, views)
+                chosen = "concurrent_vertical"
         # horizontal (node) split for multi-node machines
         if res.num_nodes >= 2:
             top = dataclasses.replace(res, num_nodes=res.num_nodes // 2)
@@ -734,6 +747,12 @@ class SearchHelper:
                 views = dict(ra3.views)
                 views.update(rb3.views)
                 best = GraphCostResult(cost3, views)
+                chosen = "concurrent_horizontal"
+        if self.trajectory is not None:
+            self.trajectory.event(
+                "dp_split", split="nonsequence", a=len(a), b=len(b),
+                chosen=chosen, cost=best.cost,
+            )
         return best
 
     def _components(self, ops, graph) -> List[List[PCGOp]]:

@@ -138,10 +138,15 @@ class MCMCSearch:
         *,
         alpha: float = 0.05,
         seed: int = 0,
+        trajectory=None,
     ):
         self.cost_model = cost_model
         self.alpha = alpha
         self.rng = random.Random(seed)
+        # obs.SearchTrajectory: records one entry per proposal (proposed
+        # op + view, simulated cost, accept/reject) so the search is
+        # explainable after the fact (obs/trajectory.py)
+        self.trajectory = trajectory
 
     def _valid_views(self, op: PCGOp, machine) -> List[MachineView]:
         degree = op.outputs[0].get_total_degree() if op.outputs else 1
@@ -173,23 +178,41 @@ class MCMCSearch:
         if use_native:
             result = self._optimize_native(graph, budget, start)
             if result is not None:
+                if self.trajectory is not None:
+                    # the native annealer iterates in C++: no per-proposal
+                    # visibility, record the summary instead
+                    self.trajectory.event("mcmc_native", cost=result[1],
+                                          budget=budget)
                 return result
         views = dict(start) if start else self.data_parallel_start(graph)
         cur = simulate_runtime(graph, views, self.cost_model)
         best_views, best = dict(views), cur
+        traj = self.trajectory
+        if traj is not None:
+            traj.event("search_begin", engine="mcmc", cost=cur,
+                       budget=budget, ops=len(graph.ops))
         ops = list(graph.ops)
-        for _ in range(budget):
+        for i in range(budget):
             # rewrite: random op -> random valid view (model.cc:3260)
             op = self.rng.choice(ops)
             cands = self._valid_views(op, machine)
             nxt = dict(views)
-            nxt[op.guid] = self.rng.choice(cands)
+            proposed = self.rng.choice(cands)
+            nxt[op.guid] = proposed
             c = simulate_runtime(graph, nxt, self.cost_model)
             delta = c - cur
-            if delta < 0 or self.rng.random() < math.exp(-self.alpha * delta * 1e6):
+            accept = (delta < 0
+                      or self.rng.random() < math.exp(-self.alpha * delta * 1e6))
+            if traj is not None:
+                traj.event("mcmc_iter", iter=i, op=op.name,
+                           view=repr(proposed), cost=c, current=cur,
+                           best=best, delta=delta, accept=accept)
+            if accept:
                 views, cur = nxt, c
                 if cur < best:
                     best_views, best = dict(views), cur
+        if traj is not None:
+            traj.event("search_end", engine="mcmc", cost=best)
         return best_views, best
 
     def _optimize_native(self, graph, budget, start):

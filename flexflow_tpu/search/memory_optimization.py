@@ -136,6 +136,7 @@ def graph_optimize_with_memory(
     train: bool = False,
     optimizer=None,
     grad_bytes_ratio: float = 1.0,
+    trajectory=None,
 ) -> Tuple[Graph, GraphCostResult, MemoryUsage, float]:
     """Binary search over lambda (reference: graph.cc:2071-2128
     try_one_lambda loop): lambda=0 gives the fastest strategy; if it
@@ -149,13 +150,17 @@ def graph_optimize_with_memory(
     def run(lam: float):
         sh = MemorySearchHelper(cost_model, mem_lambda=lam,
                                 weight_mult=wmul)
-        gsh = GraphSearchHelper(sh, xfers, alpha=alpha, budget=budget)
+        gsh = GraphSearchHelper(sh, xfers, alpha=alpha, budget=budget,
+                                trajectory=trajectory)
         g, r = gsh.graph_optimize(graph, res)
         mem = measure_memory(g, r.views, cost_model, train=train,
                              optimizer=optimizer,
                              grad_bytes_ratio=grad_bytes_ratio)
         # r.cost is lambda-weighted — recompute the comparable pure runtime
         real = simulate_runtime(g, r.views, cost_model)
+        if trajectory is not None:
+            trajectory.event("memory_lambda", mem_lambda=lam,
+                             cost=real, max_bytes=mem.max_bytes)
         return g, GraphCostResult(real, r.views), mem
 
     best = run(0.0)

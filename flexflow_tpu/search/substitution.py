@@ -471,11 +471,17 @@ class GraphSearchHelper:
         *,
         alpha: float = 1.2,
         budget: int = 20,
+        trajectory=None,
     ):
         self.search = search
         self.xfers = xfers
         self.alpha = alpha
         self.budget = budget
+        # obs.SearchTrajectory: one entry per evaluated rewrite candidate
+        # (which substitution produced it, its DP cost, whether it became
+        # the best / was enqueued), so `explain_strategy` can show WHY
+        # the final graph was chosen (obs/trajectory.py)
+        self.trajectory = trajectory
 
     def graph_optimize(
         self, graph: Graph, res: MachineResource
@@ -484,6 +490,11 @@ class GraphSearchHelper:
         DP machine-view assignment."""
         best_graph = graph
         best_result = self.search.graph_cost(graph, res)
+        traj = self.trajectory
+        if traj is not None:
+            traj.event("search_begin", engine="best_first",
+                       cost=best_result.cost, budget=self.budget,
+                       xfers=len(self.xfers))
         counter = itertools.count()
         pq: List[Tuple[float, int, Graph]] = [(best_result.cost, next(counter), graph)]
         seen = {graph.hash()}
@@ -502,8 +513,19 @@ class GraphSearchHelper:
                     if not cand.check_correctness():
                         continue
                     r = self.search.graph_cost(cand, res)
-                    if r.cost < best_result.cost:
+                    improved = r.cost < best_result.cost
+                    if improved:
                         best_graph, best_result = cand, r
-                    if r.cost <= best_result.cost * self.alpha:
+                    enqueue = r.cost <= best_result.cost * self.alpha
+                    if traj is not None:
+                        traj.event("xfer_candidate", xfer=xfer.name,
+                                   cost=r.cost, best=improved,
+                                   enqueued=enqueue, ops=len(cand.ops),
+                                   expansion=expansions)
+                    if enqueue:
                         heapq.heappush(pq, (r.cost, next(counter), cand))
+        if traj is not None:
+            traj.event("search_end", engine="best_first",
+                       cost=best_result.cost, expansions=expansions,
+                       candidates_seen=len(seen) - 1)
         return best_graph, best_result

@@ -6,6 +6,9 @@ Our graph IR is Python, so these are plain functions usable from any REPL or
 debugger (`from flexflow_tpu.utils.debug import pp`), plus a tensor-value
 inspector that mirrors the reference's `print_tensor<T>` device helper
 (src/runtime/cuda_helper.cu) without a device round-trip per element.
+
+Printing is this module's purpose (REPL dump helpers):
+# fflint: disable-file=FFL201
 """
 from __future__ import annotations
 

@@ -1,0 +1,79 @@
+#!/usr/bin/env bash
+# Telemetry end-to-end check (docs/observability.md): train a small model
+# with telemetry on, then assert every artifact exists, parses, and
+# covers search + steps + at least one checkpoint event. Runs on the
+# virtual CPU mesh; CI wires it into the lint workflow.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export JAX_NUM_CPU_DEVICES="${JAX_NUM_CPU_DEVICES:-4}"
+TELDIR="$(mktemp -d)"
+trap 'rm -rf "$TELDIR"' EXIT
+
+python - "$TELDIR" <<'EOF'
+import os
+import sys
+
+import numpy as np
+
+from flexflow_tpu import (
+    ActiMode, DataType, FFConfig, FFModel, LossType, MetricsType,
+    SGDOptimizer, TelemetryConfig,
+)
+
+teldir = sys.argv[1]
+cfg = FFConfig()
+cfg.batch_size = 8
+cfg.search_budget = 3  # exercise the Unity search so its events show up
+m = FFModel(cfg)
+x = m.create_tensor((8, 8), DataType.DT_FLOAT)
+t = m.dense(x, 32, ActiMode.AC_MODE_RELU)
+t = m.softmax(m.dense(t, 3))
+m.compile(SGDOptimizer(lr=0.1),
+          LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+          [MetricsType.METRICS_ACCURACY])
+rng = np.random.RandomState(0)
+X = rng.randn(32, 8).astype(np.float32)
+Y = rng.randint(0, 3, (32, 1)).astype(np.int32)
+m.fit(X, Y, batch_size=8, epochs=2, verbose=False,
+      checkpoint_dir=os.path.join(teldir, "ckpt"),
+      telemetry=TelemetryConfig(dir=os.path.join(teldir, "tel"),
+                                sync_per_step=True))
+EOF
+
+TEL="$TELDIR/tel"
+for f in events.jsonl metrics.prom metrics.jsonl trace.json; do
+    [ -s "$TEL/$f" ] || { echo "obs_check: missing artifact $f"; exit 1; }
+done
+
+python - "$TEL" <<'EOF'
+import json
+import sys
+
+from flexflow_tpu.obs.metrics import parse_prometheus
+from flexflow_tpu.obs.tracer import read_events_jsonl
+
+tel = sys.argv[1]
+events, problems = read_events_jsonl(f"{tel}/events.jsonl")
+assert not problems, f"schema violations: {problems[:5]}"
+names = {e["name"] for e in events}
+cats = {e["cat"] for e in events}
+assert "search" in cats, f"no search events (cats={cats})"
+assert "step" in names, "no per-step events"
+assert "checkpoint_save" in names, "no checkpoint events"
+series = parse_prometheus(open(f"{tel}/metrics.prom").read())
+assert series["ff_steps_total"] == 8.0, series.get("ff_steps_total")
+assert series["ff_checkpoint_saves_total"] >= 1.0
+trace = json.load(open(f"{tel}/trace.json"))
+assert len(trace["traceEvents"]) > 10
+print(f"obs_check: {len(events)} events, "
+      f"{len(series)} metric series, "
+      f"{len(trace['traceEvents'])} trace entries — OK")
+EOF
+
+# the CLI must round-trip the same artifacts
+python -m flexflow_tpu.obs summary "$TEL/events.jsonl" >/dev/null
+python -m flexflow_tpu.obs trace "$TEL/events.jsonl" -o "$TELDIR/t.json"
+python -m flexflow_tpu.obs prom "$TEL/metrics.jsonl" >/dev/null
+echo "obs_check: CLI OK"

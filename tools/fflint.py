@@ -26,9 +26,20 @@ FFL102  reuse of a donated state after a donated step call
         `build_train_step()` callable (donating by default) is dead
         after the call; reading it again observes reused buffers.
         Rebind it from the step's return value first.
+FFL201  bare `print()` inside flexflow_tpu/ library code
+        Historical: fit/eval reported progress via bare print()s —
+        invisible to telemetry, unredirectable, and uncapturable. Route
+        output through the structured sink (flexflow_tpu.obs.progress:
+        same human-readable line, plus a structured event when a
+        telemetry session is active). Only applies to files under a
+        `flexflow_tpu` package directory; `__main__.py` CLI modules are
+        allowlisted automatically, other CLI entry points via the
+        file-level pragma below.
 
 Suppression: append `# fflint: disable=FFL002` (comma-list) to the
-offending line (for except-handlers: to the `except` line).
+offending line (for except-handlers: to the `except` line). A module
+whose job is terminal output (CLIs, debug dumpers) can opt out of a
+rule wholesale with `# fflint: disable-file=FFL201` on any line.
 
 Usage:  python tools/fflint.py [--list-rules] PATH [PATH...]
 Exit codes: 0 clean, 1 findings, 2 usage error.
@@ -49,9 +60,12 @@ RULES = {
     "FFL101": "np.asarray/np.array without copy=True on "
               "jax.device_get(...) output",
     "FFL102": "donated train-step input read again after the step call",
+    "FFL201": "bare print() in flexflow_tpu/ library code (use "
+              "flexflow_tpu.obs.progress; __main__ modules exempt)",
 }
 
 _PRAGMA = re.compile(r"#\s*fflint:\s*disable=([A-Z0-9,\s]+)")
+_FILE_PRAGMA = re.compile(r"#\s*fflint:\s*disable-file=([A-Z0-9,\s]+)")
 
 
 class Finding:
@@ -220,6 +234,33 @@ def _check_donated_reuse(tree: ast.AST, path: str,
 
 
 # ----------------------------------------------------------------------
+# FFL201 — bare print() in library code
+# ----------------------------------------------------------------------
+def _in_flexflow_tpu(path: str) -> bool:
+    parts = os.path.normpath(os.path.abspath(path)).split(os.sep)
+    return "flexflow_tpu" in parts[:-1]
+
+
+def _check_prints(tree: ast.AST, path: str,
+                  findings: List[Finding]) -> None:
+    if not _in_flexflow_tpu(path):
+        return  # tools/, tests/, examples/ may print freely
+    if os.path.basename(path) == "__main__.py":
+        return  # CLI entry points: printing is the job
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            findings.append(Finding(
+                path, node.lineno, node.col_offset, "FFL201",
+                "bare print() in library code bypasses the structured "
+                "logger/telemetry sink; use flexflow_tpu.obs.progress "
+                "(same human-readable line + an event when telemetry is "
+                "on), or pragma-allowlist genuine CLI/dump modules",
+            ))
+
+
+# ----------------------------------------------------------------------
 def lint_source(source: str, path: str) -> List[Finding]:
     try:
         tree = ast.parse(source, filename=path)
@@ -230,10 +271,15 @@ def lint_source(source: str, path: str) -> List[Finding]:
     _check_excepts(tree, path, findings)
     _check_asarray(tree, path, findings)
     _check_donated_reuse(tree, path, findings)
+    _check_prints(tree, path, findings)
     pragmas = _pragmas(source)
+    file_off: Set[str] = set()
+    for m in _FILE_PRAGMA.finditer(source):
+        file_off |= {c.strip() for c in m.group(1).split(",") if c.strip()}
     return [
         f for f in findings
         if f.code not in pragmas.get(f.line, set())
+        and f.code not in file_off
     ]
 
 
