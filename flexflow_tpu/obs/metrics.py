@@ -155,6 +155,18 @@ class MetricsRegistry:
                   buckets=DEFAULT_BUCKETS, **labels) -> Histogram:
         return self._series(Histogram, name, help, labels, buckets=buckets)
 
+    def find(self, name: str, **labels) -> Optional[object]:
+        """The existing series, or None — WITHOUT creating one. Readers
+        that merely inspect (the serving runtime's adaptive rate limiter
+        polls the latency p95) must not pollute the export with empty
+        series the way the get-or-create accessors would."""
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                return None
+            return fam[2].get(key)
+
     # -- export ----------------------------------------------------------
     def to_prometheus(self) -> str:
         """Prometheus text exposition format (v0.0.4)."""

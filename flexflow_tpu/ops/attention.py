@@ -335,7 +335,14 @@ def _forward_decode(params, weights, inputs, ctx, cache, t):
     no KV cache; triton/README.md calls it an incomplete prototype).
 
     Requires self-attention (q_in is k_in is v_in upstream) — the decode
-    builder rejects cross-attention graphs."""
+    builder rejects cross-attention graphs.
+
+    `t` may be a scalar (every row at the same position — the generate
+    APIs) or a (b,) vector of per-row positions (continuous batching,
+    runtime/serving.py: each slot of a running decode batch is mid-way
+    through its own sequence). The vector path appends each row's K/V at
+    its own offset (a vmapped per-row update) and masks each row's
+    attention against its own position."""
     q_in, k_in, v_in = inputs
     cdt = ctx.compute_dtype
     if cdt is not None:
@@ -352,23 +359,38 @@ def _forward_decode(params, weights, inputs, ctx, cache, t):
     v_new = jnp.einsum("bse,ehd->bshd", v_in, wv,
                        preferred_element_type=jnp.float32).astype(q_in.dtype)
     k_cache, v_cache = cache
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k_new.astype(k_cache.dtype), (0, t, 0, 0)
-    )
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v_new.astype(v_cache.dtype), (0, t, 0, 0)
-    )
+    per_row_t = getattr(t, "ndim", 0) == 1
+    if per_row_t:
+        row_update = jax.vmap(
+            lambda c, n, tt: jax.lax.dynamic_update_slice(c, n, (tt, 0, 0))
+        )
+        k_cache = row_update(k_cache, k_new.astype(k_cache.dtype), t)
+        v_cache = row_update(v_cache, v_new.astype(v_cache.dtype), t)
+    else:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k_new.astype(k_cache.dtype), (0, t, 0, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v_new.astype(v_cache.dtype), (0, t, 0, 0)
+        )
     scale = 1.0 / jnp.sqrt(jnp.asarray(params.qk_head_dim, jnp.float32))
     scores = jnp.einsum(
         "bshd,bthd->bhst", q, k_cache.astype(q.dtype),
         preferred_element_type=jnp.float32,
     ) * scale                          # (b, h, s0, max_len)
     pos = jnp.arange(k_cache.shape[1])          # cache positions
-    q_pos = t + jnp.arange(q.shape[1])          # this block's positions
-    scores = jnp.where(
-        pos[None, None, None, :] <= q_pos[None, None, :, None],
-        scores, jnp.finfo(jnp.float32).min,
-    )
+    if per_row_t:
+        q_pos = t[:, None] + jnp.arange(q.shape[1])[None, :]  # (b, s0)
+        scores = jnp.where(
+            pos[None, None, None, :] <= q_pos[:, None, :, None],
+            scores, jnp.finfo(jnp.float32).min,
+        )
+    else:
+        q_pos = t + jnp.arange(q.shape[1])      # this block's positions
+        scores = jnp.where(
+            pos[None, None, None, :] <= q_pos[None, None, :, None],
+            scores, jnp.finfo(jnp.float32).min,
+        )
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     attn = jnp.einsum(
         "bhst,bthd->bshd", probs, v_cache.astype(q.dtype),

@@ -646,15 +646,66 @@ def build_plan(topo, input_pts, constants, decode_input: Optional[int] = None,
     )
 
 
-def _slice_aligned(val, info_axis_map, t, s0, cap):
+def _slice_aligned(val, info_axis_map, t, s0, cap, out_rank=None):
     """Slice a static/full value per its alignment: live-aligned axes take
-    [t:t+s0], prefix-aligned axes take [0:cap]."""
+    [t:t+s0], prefix-aligned axes take [0:cap].
+
+    When `t` is a (b,) vector of per-row positions (continuous batching:
+    each decode slot is at its own position), live-aligned axes are sliced
+    per row — a vmapped dynamic slice that materializes a leading batch
+    axis. `out_rank` (the consuming op's output rank) is then required to
+    re-align the result so broadcasting still lines the batch axis up with
+    the live stream's axis 0."""
+    per_row_t = getattr(t, "ndim", 0) == 1
+    live_axes = [axis for axis, kind in info_axis_map if kind == "live"]
     for axis, kind in info_axis_map:
-        if kind == "live":
-            val = jax.lax.dynamic_slice_in_dim(val, t, s0, axis=axis)
-        else:  # prefix
+        if kind == "prefix":
             val = jax.lax.slice_in_dim(val, 0, cap, axis=axis)
-    return val
+    if not live_axes:
+        return val
+    if not per_row_t:
+        for axis in live_axes:
+            val = jax.lax.dynamic_slice_in_dim(val, t, s0, axis=axis)
+        return val
+    if out_rank is None:
+        raise NotImplementedError(
+            "per-row decode positions need the consuming op's output rank "
+            "to realign a sliced static operand"
+        )
+    b = t.shape[0]
+    offset = out_rank - val.ndim  # right-aligned broadcast offset
+    if any(axis + offset == 0 for axis in live_axes):
+        raise NotImplementedError(
+            "per-row decode positions: a static operand's live-aligned axis "
+            "coincides with the batch axis"
+        )
+    if offset == 0:
+        # the value's axis 0 occupies the batch position
+        if val.shape[0] == b:
+            def slice_row(v, tt):  # v: one row, axes shifted down by 1
+                for axis in live_axes:
+                    v = jax.lax.dynamic_slice_in_dim(v, tt, s0, axis=axis - 1)
+                return v
+            return jax.vmap(slice_row, in_axes=(0, 0))(val, t)
+        if val.shape[0] != 1:
+            raise NotImplementedError(
+                f"static operand batch axis {val.shape[0]} matches neither "
+                f"the decode batch {b} nor 1"
+            )
+
+    def slice_full(tt):  # closes over val at its original rank
+        v = val
+        for axis in live_axes:
+            v = jax.lax.dynamic_slice_in_dim(v, tt, s0, axis=axis)
+        return v
+
+    sliced = jax.vmap(slice_full)(t)  # (b,) + sliced val shape
+    if offset == 0:  # drop the original size-1 batch axis
+        return jnp.squeeze(sliced, axis=1)
+    # no batch axis on the static value: the new leading axis is the
+    # batch; pad interior size-1 axes so right-aligned broadcasting puts
+    # it at the output's axis 0
+    return jnp.reshape(sliced, (b,) + (1,) * (offset - 1) + sliced.shape[1:])
 
 
 def _static_alignment(shape, out_rank, out_info: AxisInfo, live_len):

@@ -968,6 +968,12 @@ class PCGExecutor:
         attends the precomputed encoder K/V, and static/constant operands
         (positional tables, masks) are sliced per step.
 
+        step's `t` may be a scalar (the generate APIs: every row at the
+        same position) or a (batch,) int vector of per-row positions —
+        the continuous-batching contract (runtime/serving.py): each slot
+        of a running decode batch advances through its own sequence, so
+        K/V appends and causality masks are applied per row.
+
         Build-time validation rejects graphs the scheme can't prove exact:
         ops mixing sequence positions without a decode rule, non-causal
         self-attention, softmax over the live axis."""
@@ -1151,6 +1157,16 @@ class PCGExecutor:
             (tok,) = batch_inputs
             tok = jnp.asarray(tok, plan.decode_pt.data_type.jnp_dtype)
             s0 = tok.shape[1]
+            # t may be a scalar (all rows at the same position) or a (b,)
+            # vector of per-row positions (continuous batching: each slot
+            # of a running decode batch is mid-way through its own
+            # sequence — runtime/serving.ContinuousBatcher)
+            per_row_t = getattr(t, "ndim", 0) == 1
+            if per_row_t and tok.shape[0] != t.shape[0]:
+                raise NotImplementedError(
+                    f"per-row positions: {t.shape[0]} positions for "
+                    f"{tok.shape[0]} rows"
+                )
             consts = _materialize_constants()
             statics = dict(caches["static"])
             vals = {plan.decode_pt.guid: tok}
@@ -1179,7 +1195,8 @@ class PCGExecutor:
                 amap = dec._static_alignment(
                     tuple(full.shape), out_rank, out_info, plan.live_len,
                 )
-                return dec._slice_aligned(full, amap, t, s0, max_len)
+                return dec._slice_aligned(full, amap, t, s0, max_len,
+                                          out_rank=out_rank)
 
             for op in plan.live_ops:
                 if op.is_parallel_op:
@@ -1240,9 +1257,24 @@ class PCGExecutor:
                             "prefix softmax without a live query axis"
                         )
                         kv = jax.lax.broadcasted_iota(jnp.int32, x.shape, dim)
-                        qp = t + jax.lax.broadcasted_iota(
-                            jnp.int32, x.shape, a_info.live
-                        )
+                        if per_row_t:
+                            if x.shape[0] != t.shape[0]:
+                                raise NotImplementedError(
+                                    f"per-row positions: attention scores "
+                                    f"fold batch with another axis "
+                                    f"(axis 0 is {x.shape[0]}, batch "
+                                    f"{t.shape[0]})"
+                                )
+                            t_rows = t.reshape(
+                                (t.shape[0],) + (1,) * (x.ndim - 1)
+                            )
+                            qp = t_rows + jax.lax.broadcasted_iota(
+                                jnp.int32, x.shape, a_info.live
+                            )
+                        else:
+                            qp = t + jax.lax.broadcasted_iota(
+                                jnp.int32, x.shape, a_info.live
+                            )
                         x = jnp.where(kv <= qp, x, dec.NEG_INF)
                     outs = [jax.nn.softmax(x, axis=dim)]
                 elif ot in (OperatorType.OP_RESHAPE, OperatorType.OP_FLAT):
@@ -1264,11 +1296,26 @@ class PCGExecutor:
                     if x.guid in cached_set:
                         ax = info[x.guid].live
                         cache = caches["prefix"][x.guid]
-                        new_caches["prefix"][x.guid] = (
-                            jax.lax.dynamic_update_slice_in_dim(
-                                cache, v.astype(cache.dtype), t, axis=ax
+                        if per_row_t:
+                            if ax == 0 or cache.shape[0] != t.shape[0]:
+                                raise NotImplementedError(
+                                    f"per-row positions: prefix cache guid "
+                                    f"{x.guid} has no batch-leading axis "
+                                    f"(live axis {ax}, axis 0 "
+                                    f"{cache.shape[0]})"
+                                )
+                            new_caches["prefix"][x.guid] = jax.vmap(
+                                lambda c, vv, tt, _ax=ax:
+                                jax.lax.dynamic_update_slice_in_dim(
+                                    c, vv, tt, axis=_ax - 1
+                                )
+                            )(cache, v.astype(cache.dtype), t)
+                        else:
+                            new_caches["prefix"][x.guid] = (
+                                jax.lax.dynamic_update_slice_in_dim(
+                                    cache, v.astype(cache.dtype), t, axis=ax
+                                )
                             )
-                        )
             return vals[self.logits_pt.guid], new_caches
 
         built = (init_caches, jax.jit(step))

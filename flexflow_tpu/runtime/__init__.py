@@ -27,6 +27,11 @@ from .strategy_io import (  # noqa: F401
     export_strategy,
     import_strategy,
 )
+from .kvcache import (  # noqa: F401
+    KVCacheConfig,
+    KVCacheExhaustedError,
+    PagePool,
+)
 from .verify import (  # noqa: F401
     CanaryConfig,
     CanaryMismatchError,

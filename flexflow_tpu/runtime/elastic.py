@@ -356,10 +356,18 @@ class HealthMonitor:
         return self
 
     def stop(self) -> None:
+        """Safe from any thread, including the monitor's own watchdog/
+        heartbeat threads — serving failover (runtime/serving.ReplicaSet)
+        stops the dead replica's monitor from inside its `on_hang`
+        callback, which runs ON the watchdog thread; joining yourself
+        raises, so the current thread is skipped (it exits on the next
+        `_stop` check anyway)."""
         self._stop.set()
         self._hang_release.set()
+        me = threading.current_thread()
         for t in self._threads:
-            t.join(timeout=2.0)
+            if t is not me:
+                t.join(timeout=2.0)
         self._threads = []
         self._started = False
 

@@ -1,0 +1,258 @@
+"""Paged KV-cache allocation for the serving runtime.
+
+Continuous batching (runtime/serving.py) admits requests into a running
+decode batch at token granularity, so the scarce resource is no longer
+"a batch slot" but KV-cache memory: each admitted sequence holds
+`2 * layers * heads * head_dim * position` cache entries that grow one
+token per step. This module is the accounting layer that turns that
+growth into an admission signal — the vLLM lesson (PagedAttention,
+SOSP'23) applied at the allocator level:
+
+  * memory is carved into fixed-size **pages** of `page_size` token
+    positions each;
+  * a sequence **reserves** its worst case (prompt + max_new_tokens,
+    rounded up to pages) at admission — reservations are the hard
+    budget, so an admitted request can never deadlock mid-decode
+    waiting for a page held by another admitted request;
+  * pages **materialize** lazily as the sequence actually grows
+    (`touch`), so `ff_kv_pages_in_use` reports real occupancy while
+    `reserved` drives backpressure;
+  * when a reservation cannot be satisfied the allocator raises a typed
+    `KVCacheExhaustedError` — the admission controller turns that into
+    queue backpressure or a shed, never a silent drop.
+
+The physical decode caches today are dense per-slot arrays managed by
+`executor.build_decode` (one `max_len`-wide strip per slot); the pool's
+page tables map logical (sequence, position) ranges onto page ids so the
+accounting is exact at token granularity and the layout can move to
+physically paged storage without touching the admission logic.
+
+CPU-testable: `FaultInjector` site ``kv_exhaustion`` makes any
+reservation fail as if the pool were full (tests/test_serving.py,
+scripts/load_check.py chaos legs).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional
+
+from .resilience import ResilienceError
+
+
+class KVCacheExhaustedError(ResilienceError):
+    """A KV-page reservation could not be satisfied: the pool is out of
+    pages (or the ``kv_exhaustion`` fault site simulated it). Carries
+    enough context for the admission controller to decide between
+    backpressure (wait for running sequences to retire) and a shed
+    (the request can NEVER fit)."""
+
+    def __init__(self, msg: str, *, pages_needed: int = 0,
+                 pages_free: int = 0, never_fits: bool = False):
+        super().__init__(msg)
+        self.pages_needed = pages_needed
+        self.pages_free = pages_free
+        self.never_fits = never_fits
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    """Sizing knobs for the page pool (docs/serving.md "KV-cache
+    sizing"). `num_pages * page_size` is the total token-position budget
+    across all in-flight sequences; `watermark` holds back a fraction of
+    pages from admission so in-flight growth plus a small burst never
+    hits the hard edge."""
+
+    num_pages: int
+    page_size: int = 16
+    watermark: float = 0.0
+
+    def __post_init__(self):
+        if self.num_pages <= 0:
+            raise ValueError(f"num_pages must be positive: {self.num_pages}")
+        if self.page_size <= 0:
+            raise ValueError(f"page_size must be positive: {self.page_size}")
+        if not 0.0 <= self.watermark < 1.0:
+            raise ValueError(f"watermark must be in [0, 1): {self.watermark}")
+
+    def pages_for(self, tokens: int) -> int:
+        return max(1, -(-int(tokens) // self.page_size))
+
+
+class PagePool:
+    """Thread-safe page allocator with per-sequence page tables.
+
+    Lifecycle per sequence: ``reserve(seq_id, max_tokens)`` at admission
+    (the hard budget check), ``touch(seq_id, tokens)`` as the sequence
+    grows (materializes pages out of the reservation), ``release(seq_id)``
+    at retirement/shed/failover. All three are O(pages) and safe to call
+    from the batcher, admission and failover threads concurrently."""
+
+    def __init__(self, config: KVCacheConfig, *, fault_injector=None):
+        self.config = config
+        self.fault_injector = fault_injector
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(config.num_pages))[::-1]
+        self._tables: Dict[str, List[int]] = {}
+        self._reserved: Dict[str, int] = {}
+        self.stats = {"reservations": 0, "exhaustions": 0, "released": 0}
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def num_pages(self) -> int:
+        return self.config.num_pages
+
+    @property
+    def pages_free(self) -> int:
+        """Pages not covered by any reservation (NOT merely untouched)."""
+        with self._lock:
+            return self.config.num_pages - sum(self._reserved.values())
+
+    @property
+    def pages_reserved(self) -> int:
+        with self._lock:
+            return sum(self._reserved.values())
+
+    @property
+    def pages_in_use(self) -> int:
+        """Materialized (touched) pages — what `ff_kv_pages_in_use`
+        reports; always <= pages_reserved."""
+        with self._lock:
+            return sum(len(t) for t in self._tables.values())
+
+    def page_table(self, seq_id: str) -> tuple:
+        with self._lock:
+            return tuple(self._tables.get(seq_id, ()))
+
+    def holds(self, seq_id: str) -> bool:
+        with self._lock:
+            return seq_id in self._reserved
+
+    def _admittable_pages(self) -> int:
+        # held-back watermark pages never count toward admission
+        held_back = int(self.config.num_pages * self.config.watermark)
+        return (self.config.num_pages - held_back
+                - sum(self._reserved.values()))
+
+    def can_reserve(self, max_tokens: int) -> bool:
+        need = self.config.pages_for(max_tokens)
+        with self._lock:
+            return need <= self._admittable_pages()
+
+    def never_fits(self, max_tokens: int) -> bool:
+        """True when the demand exceeds the WHOLE pool — waiting for
+        retirements can't help, so the request must be shed."""
+        held_back = int(self.config.num_pages * self.config.watermark)
+        return self.config.pages_for(max_tokens) > (
+            self.config.num_pages - held_back
+        )
+
+    # -- lifecycle -------------------------------------------------------
+    def reserve(self, seq_id: str, max_tokens: int) -> int:
+        """Commit `ceil(max_tokens / page_size)` pages to `seq_id`.
+        Raises KVCacheExhaustedError (never silently over-commits) when
+        the admittable budget can't cover it; `never_fits` on the error
+        distinguishes "wait" from "shed"."""
+        need = self.config.pages_for(max_tokens)
+        if self.fault_injector is not None:
+            plan = self.fault_injector.fire("kv_exhaustion")
+            if plan is not None:
+                self.stats["exhaustions"] += 1
+                raise KVCacheExhaustedError(
+                    f"kv page pool exhausted (fault injection): "
+                    f"{need} page(s) for {seq_id}",
+                    pages_needed=need, pages_free=0,
+                    never_fits=bool(plan.get("never_fits", False)),
+                )
+        with self._lock:
+            if seq_id in self._reserved:
+                raise ValueError(f"sequence {seq_id!r} already reserved")
+            avail = self._admittable_pages()
+            if need > avail:
+                self.stats["exhaustions"] += 1
+                raise KVCacheExhaustedError(
+                    f"kv page pool exhausted: {need} page(s) needed for "
+                    f"{seq_id}, {avail} admittable of {self.config.num_pages}",
+                    pages_needed=need, pages_free=max(0, avail),
+                    never_fits=self.never_fits(max_tokens),
+                )
+            self._reserved[seq_id] = need
+            self._tables[seq_id] = []
+            self.stats["reservations"] += 1
+        self._export()
+        return need
+
+    def touch(self, seq_id: str, tokens: int) -> List[int]:
+        """Materialize pages so positions [0, tokens) are backed; returns
+        the newly allocated page ids (empty when already covered).
+        Growth beyond the reservation is a caller bug and raises — the
+        admission-time worst case is the contract that makes mid-decode
+        deadlock impossible."""
+        with self._lock:
+            if seq_id not in self._reserved:
+                raise KeyError(f"sequence {seq_id!r} holds no reservation")
+            table = self._tables[seq_id]
+            need = self.config.pages_for(tokens)
+            if need > self._reserved[seq_id]:
+                raise ValueError(
+                    f"sequence {seq_id!r} grew to {need} page(s), beyond "
+                    f"its reservation of {self._reserved[seq_id]}"
+                )
+            new = []
+            while len(table) < need:
+                # free list can't underrun: every materialization is
+                # covered by a reservation counted out of num_pages
+                new.append(self._free.pop())
+                table.append(new[-1])
+        if new:
+            self._export()
+        return new
+
+    def release(self, seq_id: str) -> int:
+        """Return `seq_id`'s pages and reservation to the pool (idempotent
+        — failover and retirement may race). Returns pages freed."""
+        with self._lock:
+            if seq_id not in self._reserved:
+                return 0
+            pages = self._tables.pop(seq_id)
+            self._free.extend(reversed(pages))
+            del self._reserved[seq_id]
+            self.stats["released"] += 1
+            freed = len(pages)
+        self._export()
+        return freed
+
+    def _export(self) -> None:
+        from .. import obs
+
+        obs.gauge_set("ff_kv_pages_in_use", self.pages_in_use,
+                      help="materialized KV-cache pages across sequences")
+        obs.gauge_set("ff_kv_pages_reserved", self.pages_reserved,
+                      help="KV-cache pages committed to admitted sequences")
+
+
+def kv_page_bytes(model, page_size: int) -> Optional[int]:
+    """Bytes one page costs across the model's self-attention layers
+    (2 * page_size * heads * head_dim * itemsize per layer) — the
+    docs/serving.md sizing formula, computed from the compiled graph.
+    Returns None when the graph has no fused-MHA self-attention (e.g.
+    primitive-op imports, where the cache cost lives in prefix tensors)."""
+    import numpy as np
+
+    from ..ff_types import OperatorType
+
+    ex = getattr(model, "executor", None)
+    if ex is None:
+        return None
+    total = 0
+    itemsize = np.dtype(np.float32).itemsize
+    cdt = getattr(ex, "compute_dtype", None)
+    if cdt is not None:
+        itemsize = np.dtype(cdt).itemsize
+    for op in ex.topo:
+        if getattr(op, "op_type", None) != OperatorType.OP_MULTIHEAD_ATTENTION:
+            continue
+        p = op.params
+        total += page_size * p.num_heads * (p.qk_head_dim + p.v_head_dim) \
+            * itemsize
+    return total or None

@@ -242,6 +242,23 @@ class FaultInjector:
                                the elastic-restart test to rebuild on);
                                pair with elastic.shrunk_devices(N) to
                                shrink what jax.devices() reports.
+      * ``replica_death``    — raised inside a ContinuousBatcher serve
+                               loop (runtime/serving.py): the replica
+                               dies, the ReplicaSet requeues its
+                               in-flight requests onto siblings and
+                               restarts it (elastically when a
+                               checkpoint dir is configured). Extras:
+                               ``replica="replicaN"`` targets one.
+      * ``slow_worker``      — stalls one serving decode iteration for
+                               ``delay_s`` seconds INSIDE the health-
+                               monitored step window, so the PR-2
+                               HealthMonitor watchdog sees a hung step
+                               and failover fires.
+      * ``kv_exhaustion``    — makes a KV-page reservation fail as if
+                               the pool were full (runtime/kvcache.py):
+                               exercises admission backpressure; with
+                               ``never_fits=True`` the request is shed
+                               instead of waiting.
       * ``bitflip``          — silent-data-corruption simulation
                                (runtime/verify.py): the canary's consumer
                                flips one bit of one live weight tensor

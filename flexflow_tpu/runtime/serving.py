@@ -1,25 +1,57 @@
-"""Inference serving: a batching scheduler over a compiled model.
+"""Overload-robust inference serving over compiled models.
 
 TPU-native counterpart to the reference's Triton prototype (triton/src/,
-~8k LoC "incomplete prototype" serving ONNX models on Legion — SURVEY §2.6).
-Instead of a Triton backend we provide the piece that matters on TPU: a
-request queue + dynamic batcher that pads/packs incoming requests to the
-compiled batch size, runs the jitted forward, and fans results back out.
-Models arrive through any frontend (ONNX importer included, matching the
-prototype's ONNX surface).
+~8k LoC "incomplete prototype" serving ONNX models on Legion — SURVEY
+§2.6), grown into a production front end whose adversary is the offered
+load, not the strategy (the Orca OSDI'22 lesson: schedule at iteration
+granularity, shed at admission, never hang):
+
+  * **generation APIs** — greedy/beam/KV-cache decode over the compiled
+    graph (`greedy_generate`, `incremental_generate`, ...);
+  * **continuous batching** — `ContinuousBatcher` keeps a running decode
+    batch whose slots each advance through their OWN sequence
+    (per-slot positions, executor.build_decode), admitting new requests
+    and retiring finished ones every iteration, with KV memory governed
+    by the paged allocator (runtime/kvcache.py);
+  * **admission control** — bounded queue with end-to-end deadlines
+    (checked at enqueue, dequeue and every decode iteration), a token
+    bucket whose refill adapts to the p95 of `ff_serving_latency_seconds`,
+    and KV-page backpressure; every rejection is a typed
+    `RequestShedError` subclass counted in `ff_serving_shed_total` —
+    zero silent drops;
+  * **replica failover** — `ReplicaSet` runs N batcher replicas off one
+    shared queue, health-checked by the elastic runtime's
+    `HealthMonitor` (runtime/elastic.py); a dead/hung replica's
+    in-flight requests are requeued onto its siblings while it restarts
+    (via `restore_elastic` resharding when a checkpoint dir is given),
+    and replica count scales with queue depth;
+  * **dynamic batching** — the original `BatchScheduler` (pads/packs
+    single-shot forward requests to the compiled batch) stays for
+    non-generative and encoder-decoder models.
+
+Chaos-testable on CPU: FaultInjector sites ``replica_death``,
+``slow_worker``, ``kv_exhaustion`` and ``serving_worker``
+(tests/test_serving.py, scripts/load_check.py).
 """
 from __future__ import annotations
 
+import dataclasses
+import logging
 import queue
 import threading
 import time
 import uuid
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Callable, Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
+from .kvcache import KVCacheConfig, KVCacheExhaustedError, PagePool
+from .resilience import ResilienceError
 from .verify import NotCompiledError, ServingConfigError
+
+logger = logging.getLogger("flexflow_tpu.runtime.serving")
 
 
 def greedy_generate(
@@ -443,10 +475,1313 @@ def beam_generate(
     return np.stack(outs, axis=0)
 
 
+# ----------------------------------------------------------------------
+# typed admission failures — every non-admitted request gets one of these
+# (and a ff_serving_shed_total increment); silence is a bug
+# ----------------------------------------------------------------------
+class RequestShedError(ResilienceError):
+    """The serving runtime refused (or abandoned) a request on purpose —
+    load shedding, not a fault. NOT a TimeoutError subclass: the default
+    RetryPolicy must not hammer an overloaded service with retries."""
+
+    reason = "shed"
+
+    def __init__(self, msg: str, *, reason: Optional[str] = None):
+        super().__init__(msg)
+        if reason is not None:
+            self.reason = reason
+
+
+class DeadlineExceededError(RequestShedError):
+    """The request's deadline passed (or provably cannot be met) before
+    a result was produced — whether it was still queued, being admitted,
+    or mid-decode. `stage` says where along the pipeline it died."""
+
+    reason = "deadline"
+
+    def __init__(self, msg: str, *, stage: str = "queue"):
+        super().__init__(msg)
+        self.stage = stage
+
+
+class QueueFullError(RequestShedError):
+    """The bounded admission queue is at capacity — the canonical
+    overload signal. Clients should back off; the server stays live."""
+
+    reason = "queue_full"
+
+
+class RateLimitedError(RequestShedError):
+    """The token-bucket rate limiter is empty: offered load exceeds the
+    (possibly p95-adapted) sustainable rate."""
+
+    reason = "rate_limited"
+
+
+class ReplicaDeathError(ResilienceError):
+    """A serving replica crashed (or the ``replica_death`` fault site
+    simulated it). Raised inside the replica's serve loop; the
+    ReplicaSet requeues its in-flight work and restarts it."""
+
+
+def _shed(reason: str, n: float = 1.0) -> None:
+    from .. import obs
+
+    obs.count("ff_serving_shed_total", n,
+              help="requests shed by admission control/deadlines",
+              reason=reason)
+
+
+# ----------------------------------------------------------------------
+# serving configuration
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class ServingConfig:
+    """Knobs for the continuous-batching runtime (docs/serving.md).
+
+    `max_len` caps prompt+generated tokens per sequence (the decode
+    cache width); `slots` is the in-flight sequence count per replica
+    (the decode batch). KV paging defaults to exactly covering
+    `slots` full-length sequences — set `num_pages` smaller to exercise
+    admission backpressure, larger for headroom. `rate_limit` (req/s)
+    enables the token bucket; with `adaptive_rate` its refill follows
+    the p95 of `ff_serving_latency_seconds` via AIMD toward
+    `target_p95_s`."""
+
+    max_len: int
+    slots: int = 4
+    page_size: int = 16
+    num_pages: Optional[int] = None
+    watermark: float = 0.0
+    max_queue_depth: int = 64
+    default_deadline_s: float = 30.0
+    default_max_new_tokens: int = 16
+    rate_limit: Optional[float] = None
+    rate_burst: int = 8
+    adaptive_rate: bool = False
+    target_p95_s: float = 1.0
+    eos_token_id: Optional[int] = None
+    assume_causal: bool = False
+    idle_wait_s: float = 0.005
+    # compile every decode executable (all prefill buckets + the batched
+    # step) when the replica boots, BEFORE it takes traffic: a mid-run
+    # jit compile stalls the whole running batch (and on a shared-core
+    # CPU harness can starve sibling replicas into watchdog failovers)
+    precompile: bool = True
+
+    def __post_init__(self):
+        if self.max_len <= 1:
+            raise ServingConfigError(f"max_len must be > 1: {self.max_len}")
+        if self.slots <= 0:
+            raise ServingConfigError(f"slots must be positive: {self.slots}")
+        if self.max_queue_depth <= 0:
+            raise ServingConfigError(
+                f"max_queue_depth must be positive: {self.max_queue_depth}"
+            )
+
+    def kv_config(self) -> KVCacheConfig:
+        cfg = KVCacheConfig(num_pages=1, page_size=self.page_size)
+        pages = self.num_pages
+        if pages is None:
+            pages = self.slots * cfg.pages_for(self.max_len)
+        return KVCacheConfig(num_pages=pages, page_size=self.page_size,
+                             watermark=self.watermark)
+
+
+class GenerationRequest:
+    """One decode request: prompt ids in, prompt+generated ids out.
+
+    Completion is exactly-once and owner-checked: a failover requeue
+    bumps `generation`, so a stalled replica that later wakes up cannot
+    publish a result for work that was handed to a sibling. Callers
+    block on `result()`, which raises the request's TYPED error (shed /
+    deadline / abort) instead of returning garbage or hanging."""
+
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int, *,
+                 deadline_s: float = 30.0):
+        self.id = uuid.uuid4().hex[:12]
+        self.prompt = np.asarray(prompt)
+        if self.prompt.ndim != 1:
+            raise ServingConfigError(
+                f"prompt must be a 1-D token array, got shape "
+                f"{self.prompt.shape}"
+            )
+        self.max_new_tokens = int(max_new_tokens)
+        self.submitted_t = time.monotonic()
+        self.deadline = self.submitted_t + float(deadline_s)
+        self.first_token_t: Optional[float] = None
+        self.finished_t: Optional[float] = None
+        self.generation = 0  # bumped on failover requeue
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self.tokens: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+
+    # -- completion (exactly once, owner-checked) ------------------------
+    def _finish(self, *, tokens: Optional[np.ndarray] = None,
+                error: Optional[BaseException] = None,
+                generation: Optional[int] = None) -> bool:
+        with self._lock:
+            if self._event.is_set():
+                return False
+            if generation is not None and generation != self.generation:
+                return False  # requeued to another replica meanwhile
+            self.tokens = tokens
+            self.error = error
+            self.finished_t = time.monotonic()
+            self._event.set()
+            return True
+
+    def _requeue_bump(self) -> Optional[int]:
+        """Take ownership away from a dead replica; returns the new
+        generation, or None when the request already finished."""
+        with self._lock:
+            if self._event.is_set():
+                return None
+            self.generation += 1
+            return self.generation
+
+    # -- client API ------------------------------------------------------
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        from .resilience import InferenceTimeout
+
+        if not self._event.wait(timeout):
+            raise InferenceTimeout(
+                f"request {self.id} unanswered after {timeout}s"
+            )
+        if self.error is not None:
+            raise self.error
+        return self.tokens
+
+
+class TokenBucket:
+    """Classic token bucket with an AIMD-adaptable refill rate: the
+    additive-increase/multiplicative-decrease loop (`adapt`) follows the
+    serving p95 toward a latency target, so sustained overload tightens
+    admission instead of growing the queue without bound."""
+
+    def __init__(self, rate: float, burst: int, *,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        self.burst = max(1, int(burst))
+        self._clock = clock
+        self._tokens = float(self.burst)
+        self._last = clock()
+        self._lock = threading.Lock()
+        self.min_rate = max(0.1, self.rate / 64.0)
+        self.max_rate = self.rate * 16.0
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def adapt(self, p95_s: float, target_p95_s: float) -> float:
+        """One AIMD step: p95 over target multiplicatively cuts the
+        refill; under target additively grows it back. Returns the new
+        rate (also exported as ff_serving_admission_rate)."""
+        from .. import obs
+
+        with self._lock:
+            if p95_s == p95_s:  # NaN = no samples yet: leave the rate be
+                if p95_s > target_p95_s:
+                    self.rate = max(self.min_rate, self.rate * 0.7)
+                else:
+                    self.rate = min(self.max_rate, self.rate + 1.0)
+            rate = self.rate
+        obs.gauge_set("ff_serving_admission_rate", rate,
+                      help="token-bucket refill rate (requests/s)")
+        return rate
+
+
+class AdmissionQueue:
+    """Bounded FIFO shared by every replica's batcher. `offer` sheds at
+    enqueue (queue full / dead-on-arrival deadline); `poll` sheds
+    expired requests at dequeue so a blown deadline is never executed
+    on-device; `requeue` (failover) pushes to the FRONT and is exempt
+    from the bound — admitted work is never dropped by its own rescue."""
+
+    def __init__(self, max_depth: int):
+        self.max_depth = max_depth
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def _export_depth(self) -> None:
+        from .. import obs
+
+        obs.gauge_set("ff_serving_queue_depth", len(self),
+                      help="requests waiting for a decode slot")
+
+    def offer(self, req: GenerationRequest) -> None:
+        now = time.monotonic()
+        if now >= req.deadline:
+            err = DeadlineExceededError(
+                f"request {req.id} dead on arrival "
+                f"({now - req.deadline:.3f}s past deadline)", stage="enqueue",
+            )
+            _shed("deadline")
+            req._finish(error=err)
+            raise err
+        with self._lock:
+            if len(self._q) >= self.max_depth:
+                full = QueueFullError(
+                    f"admission queue at capacity ({self.max_depth})"
+                )
+                _shed("queue_full")
+                req._finish(error=full)
+                raise full
+            self._q.append(req)
+            self._nonempty.notify()
+        self._export_depth()
+
+    def requeue(self, req: GenerationRequest) -> None:
+        with self._lock:
+            self._q.appendleft(req)
+            self._nonempty.notify()
+        self._export_depth()
+
+    def poll(self, timeout: float = 0.0) -> Optional[GenerationRequest]:
+        """Next live request, shedding expired ones at dequeue (typed
+        error + counter — the satellite-fix semantics: a request that
+        blew its deadline while queued must not reach the device)."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                while self._q:
+                    req = self._q.popleft()
+                    if req.done():
+                        continue  # aborted/shed elsewhere
+                    now = time.monotonic()
+                    if now >= req.deadline:
+                        _shed("deadline")
+                        req._finish(error=DeadlineExceededError(
+                            f"request {req.id} expired in queue "
+                            f"({now - req.deadline:.3f}s past deadline)",
+                            stage="dequeue",
+                        ))
+                        continue
+                    self._export_depth_locked()
+                    return req
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._nonempty.wait(remaining)
+
+    def _export_depth_locked(self) -> None:
+        from .. import obs
+
+        obs.gauge_set("ff_serving_queue_depth", len(self._q),
+                      help="requests waiting for a decode slot")
+
+    def drain(self, error_factory) -> int:
+        """Fail every queued request with a typed error (shutdown path —
+        zero silent drops). Returns the number drained."""
+        with self._lock:
+            pending = list(self._q)
+            self._q.clear()
+        n = 0
+        for req in pending:
+            if req._finish(error=error_factory(req)):
+                _shed("aborted")
+                n += 1
+        self._export_depth()
+        return n
+
+
+# ----------------------------------------------------------------------
+# continuous (in-flight) batching
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class _Slot:
+    req: GenerationRequest
+    generation: int
+    seq_key: str
+    tokens: List[int]
+    prompt_len: int
+    pos: int  # cache positions written == len(tokens) - 1
+
+
+class ContinuousBatcher:
+    """Iteration-level decode scheduler for ONE replica (Orca-style): a
+    running batch of `config.slots` sequences, each at its own position
+    (the per-slot `t` vector of executor.build_decode). Every iteration:
+
+      1. retire finished slots (EOS / max_new_tokens / blown deadline)
+         and release their KV pages;
+      2. admit queued requests into free slots — deadline re-checked at
+         dequeue, KV pages reserved worst-case (backpressure when the
+         pool can't cover it; typed shed when it never could), prompt
+         prefilled through a batch-1 decode step bucketed to powers of
+         two (bounds recompilation), and the prefilled cache strip
+         inserted into the running batch;
+      3. run ONE batched decode step for every active slot.
+
+    Decoder-only models only (one graph input): encoder-decoder graphs
+    compute per-request encoder statics that a shared running batch
+    cannot represent — those go through BatchScheduler.
+
+    Faults: ``replica_death`` raises out of the loop (the ReplicaSet
+    requeues + restarts), ``slow_worker`` stalls an iteration inside the
+    health-monitored step window so the watchdog sees a hung step,
+    ``kv_exhaustion`` fires in the page pool."""
+
+    def __init__(self, model, config: ServingConfig,
+                 queue_: AdmissionQueue, *,
+                 name: str = "replica0",
+                 pool: Optional[PagePool] = None,
+                 fault_injector=None,
+                 monitor=None,
+                 on_dead: Optional[Callable] = None,
+                 device_lock: Optional[threading.RLock] = None):
+        if model.executor is None:
+            raise NotCompiledError("compile() the model first")
+        if len(model._fit_input_tensors) != 1:
+            raise ServingConfigError(
+                "continuous batching serves decoder-only models (one graph "
+                "input); use BatchScheduler/incremental_seq2seq_generate "
+                "for encoder-decoder graphs"
+            )
+        self.model = model
+        self.config = config
+        self.queue = queue_
+        self.name = name
+        self.fault_injector = fault_injector
+        self.monitor = monitor
+        self.on_dead = on_dead
+        self.pool = pool or PagePool(config.kv_config(),
+                                     fault_injector=fault_injector)
+        # ALL in-process replicas must funnel device work through one
+        # lock: concurrent jitted executions + compiles from sibling
+        # threads can wedge the single-process CPU backend (and on a
+        # shared core buy nothing anyway) — production replicas live in
+        # separate processes and never contend here
+        self._device_lock = device_lock or threading.RLock()
+        ex = model.executor
+        self._initB, self._stepB = ex.build_decode(
+            config.slots, config.max_len, assume_causal=config.assume_causal
+        )
+        self._init1, self._step1 = ex.build_decode(
+            1, config.max_len, assume_causal=config.assume_causal
+        )
+        in_t = model._fit_input_tensors[-1]
+        self._id_dt = in_t.data_type.np_dtype
+        self._caches = None
+        self.slots: List[Optional[_Slot]] = [None] * config.slots
+        self._stop = threading.Event()
+        self.dead = False
+        self.death_cause: Optional[BaseException] = None
+        self.draining = False
+        self._thread: Optional[threading.Thread] = None
+        self._iteration = 0
+        self._admit_seq = 0  # per-admission nonce: pool keys stay unique
+        # even if a request is ever double-admitted across a failover race
+        # per-token service-time EWMA drives the "cannot meet deadline"
+        # early shed; warms up after the first measured iterations
+        self._token_ewma_s: Optional[float] = None
+        self.stats = {"admitted": 0, "finished": 0, "iterations": 0,
+                      "prefills": 0, "retired_eos": 0, "shed_decode": 0,
+                      "stranded_requeued": 0}
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ContinuousBatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._serve_loop, daemon=True,
+                name=f"ff-serve-{self.name}",
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    def thread_alive(self) -> bool:
+        return (self._thread is not None and self._thread.is_alive()
+                and not self.dead)
+
+    @property
+    def active_slots(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def in_flight(self) -> List[_Slot]:
+        return [s for s in self.slots if s is not None]
+
+    # -- admission -------------------------------------------------------
+    def _bucket(self, plen: int) -> int:
+        b = 1
+        while b < plen:
+            b *= 2
+        return min(b, self.config.max_len)
+
+    def _reserve_tokens(self, plen: int, max_new: int) -> int:
+        # prefill touches the whole padded bucket; decode grows to
+        # plen + max_new - 1 written positions (the last sampled token's
+        # K/V is never appended). Reserve the max so growth can't stall.
+        return min(self.config.max_len,
+                   max(self._bucket(plen), plen + max_new))
+
+    def _try_admit_one(self) -> bool:
+        req = self.queue.poll(timeout=0.0)
+        if req is None:
+            return False
+        from .. import obs
+
+        now = time.monotonic()
+        plen = len(req.prompt)
+        total = plen + req.max_new_tokens
+        if plen < 1 or total > self.config.max_len:
+            err = RequestShedError(
+                f"request {req.id}: prompt {plen} + max_new "
+                f"{req.max_new_tokens} exceeds max_len "
+                f"{self.config.max_len}", reason="too_long",
+            )
+            _shed("too_long")
+            req._finish(error=err)
+            return True
+        # early shed: with a warmed service-time estimate, a request
+        # whose decode provably outlives its deadline never runs
+        if self._token_ewma_s is not None:
+            eta = now + req.max_new_tokens * self._token_ewma_s
+            if eta > req.deadline:
+                _shed("deadline")
+                req._finish(error=DeadlineExceededError(
+                    f"request {req.id} cannot meet its deadline: needs "
+                    f"~{req.max_new_tokens * self._token_ewma_s:.3f}s, has "
+                    f"{max(0.0, req.deadline - now):.3f}s", stage="admit",
+                ))
+                return True
+        generation = req.generation
+        self._admit_seq += 1
+        seq_key = f"{req.id}:{generation}:{self.name}:{self._admit_seq}"
+        try:
+            self.pool.reserve(seq_key, self._reserve_tokens(
+                plen, req.max_new_tokens))
+        except KVCacheExhaustedError as e:
+            if e.never_fits:
+                _shed("kv_exhausted")
+                req._finish(error=RequestShedError(
+                    f"request {req.id} can never fit the KV page pool: "
+                    f"{e}", reason="kv_exhausted",
+                ))
+                return True
+            # backpressure: put it back and wait for retirements
+            self.queue.requeue(req)
+            obs.event("serving_kv_backpressure", cat="serving",
+                      replica=self.name, request=req.id,
+                      pages_needed=e.pages_needed, pages_free=e.pages_free)
+            return False
+        slot_idx = self.slots.index(None)
+        try:
+            first, caches1 = self._prefill(req, plen)
+        except BaseException:
+            self.pool.release(seq_key)
+            raise
+        self._insert_slot(slot_idx, caches1)
+        req.first_token_t = time.monotonic()
+        obs.observe("ff_serving_ttft_seconds",
+                    req.first_token_t - req.submitted_t,
+                    help="time from submit to first generated token")
+        slot = _Slot(req=req, generation=generation, seq_key=seq_key,
+                     tokens=list(req.prompt.tolist()) + [first],
+                     prompt_len=plen, pos=plen)
+        self.pool.touch(seq_key, self._bucket(plen))
+        self.slots[slot_idx] = slot
+        self.stats["admitted"] += 1
+        self.stats["prefills"] += 1
+        self._maybe_retire(slot_idx)
+        return True
+
+    def _prefill(self, req: GenerationRequest, plen: int):
+        """Run the prompt through the batch-1 decode step, padded to a
+        power-of-two bucket (bounds distinct jit shapes to log2(max_len)).
+        The padded tail's garbage K/V sits at positions >= plen, which
+        decode overwrites position-by-position before the causal mask
+        ever exposes them."""
+        bucket = self._bucket(plen)
+        padded = np.zeros((1, bucket), self._id_dt)
+        padded[0, :plen] = req.prompt.astype(self._id_dt)
+        with self._device_lock:
+            caches1 = self._init1(self.model.state.params, ())
+            logits, caches1 = self._step1(
+                self.model.state.params, caches1, jnp.int32(0),
+                [jnp.asarray(padded)],
+            )
+            first = int(np.asarray(logits)[0, plen - 1].argmax(-1))
+        return first, caches1
+
+    def _insert_slot(self, slot_idx: int, caches1) -> None:
+        """Swap a prefilled batch-1 cache strip into the running batch:
+        every per-slot cache leaf is written wholesale at `slot_idx`, so
+        whatever a previous occupant left there is fully replaced."""
+        import jax
+
+        with self._device_lock:
+            self._insert_slot_locked(jax, slot_idx, caches1)
+
+    def _insert_slot_locked(self, jax, slot_idx: int, caches1) -> None:
+        if self._caches is None:
+            self._caches = self._initB(self.model.state.params, ())
+        caches = self._caches
+        out = {"static": caches["static"], "mha_static": caches["mha_static"],
+               "prefix": {}, "mha": {}}
+        for g, c in caches["prefix"].items():
+            row = caches1["prefix"][g]
+            if tuple(c.shape) != (self.config.slots,) + tuple(row.shape[1:]):
+                raise ServingConfigError(
+                    f"prefix cache guid {g} has no per-slot leading axis "
+                    f"(batch shape {tuple(c.shape)} vs row "
+                    f"{tuple(row.shape)}) — this graph folds batch with "
+                    "another axis and cannot be continuously batched"
+                )
+            out["prefix"][g] = jax.lax.dynamic_update_slice_in_dim(
+                c, row.astype(c.dtype), slot_idx, axis=0
+            )
+        for opname, kv in caches["mha"].items():
+            k1, v1 = caches1["mha"][opname]
+            kB, vB = kv
+            out["mha"][opname] = (
+                jax.lax.dynamic_update_slice_in_dim(
+                    kB, k1.astype(kB.dtype), slot_idx, axis=0),
+                jax.lax.dynamic_update_slice_in_dim(
+                    vB, v1.astype(vB.dtype), slot_idx, axis=0),
+            )
+        self._caches = out
+
+    # -- retirement ------------------------------------------------------
+    def _release(self, slot_idx: int) -> None:
+        slot = self.slots[slot_idx]
+        if slot is not None:
+            self.pool.release(slot.seq_key)
+        self.slots[slot_idx] = None
+
+    def _finish_slot(self, slot_idx: int) -> None:
+        from .. import obs
+
+        slot = self.slots[slot_idx]
+        ok = slot.req._finish(tokens=np.asarray(slot.tokens, self._id_dt),
+                              generation=slot.generation)
+        if ok:
+            latency = time.monotonic() - slot.req.submitted_t
+            obs.observe("ff_serving_latency_seconds", latency,
+                        help="end-to-end serving request latency")
+            obs.count("ff_serving_requests_total",
+                      help="serving requests answered")
+            obs.count("ff_serving_tokens_total",
+                      len(slot.tokens) - slot.prompt_len,
+                      help="tokens generated by the serving runtime")
+            self.stats["finished"] += 1
+        self._release(slot_idx)
+
+    def _maybe_retire(self, slot_idx: int) -> None:
+        slot = self.slots[slot_idx]
+        if slot is None:
+            return
+        if slot.req.done():  # aborted / requeued elsewhere
+            self._release(slot_idx)
+            return
+        now = time.monotonic()
+        if now > slot.req.deadline:
+            _shed("deadline")
+            self.stats["shed_decode"] += 1
+            slot.req._finish(error=DeadlineExceededError(
+                f"request {slot.req.id} blew its deadline mid-decode "
+                f"after {len(slot.tokens) - slot.prompt_len} token(s)",
+                stage="decode",
+            ), generation=slot.generation)
+            self._release(slot_idx)
+            return
+        generated = len(slot.tokens) - slot.prompt_len
+        eos = self.config.eos_token_id
+        if generated >= slot.req.max_new_tokens or (
+            eos is not None and slot.tokens[-1] == eos
+        ):
+            if eos is not None and slot.tokens[-1] == eos:
+                self.stats["retired_eos"] += 1
+            self._finish_slot(slot_idx)
+
+    # -- the iteration loop ---------------------------------------------
+    def _decode_iteration(self) -> None:
+        t_vec = np.zeros(self.config.slots, np.int32)
+        toks = np.zeros((self.config.slots, 1), self._id_dt)
+        active = []
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            active.append(i)
+            t_vec[i] = slot.pos
+            toks[i, 0] = slot.tokens[slot.pos]
+        with self._device_lock:
+            logits, self._caches = self._stepB(
+                self.model.state.params, self._caches, jnp.asarray(t_vec),
+                [jnp.asarray(toks)],
+            )
+            logits = np.asarray(logits)
+        for i in active:
+            slot = self.slots[i]
+            slot.tokens.append(int(logits[i, 0].argmax(-1)))
+            slot.pos += 1
+            self.pool.touch(slot.seq_key,
+                            max(self._bucket(slot.prompt_len), slot.pos))
+            self._maybe_retire(i)
+
+    def _warmup_compiles(self) -> None:
+        """Compile the batched decode step and every prefill bucket on
+        throwaway caches before taking traffic. Runs on the serve thread
+        under the HealthMonitor's compile grace window; the running batch
+        then never waits on XLA mid-request."""
+        params = self.model.state.params
+        with self._device_lock:
+            caches = self._initB(params, ())
+            t_vec = jnp.zeros((self.config.slots,), jnp.int32)
+            toks = jnp.zeros((self.config.slots, 1), self._id_dt)
+            self._stepB(params, caches, t_vec, [toks])
+            b = 1
+            while True:
+                caches1 = self._init1(params, ())
+                self._step1(params, caches1,
+                            jnp.int32(0), [jnp.zeros((1, b), self._id_dt)])
+                if b >= self.config.max_len:
+                    break
+                b = min(2 * b, self.config.max_len)
+
+    def _strand_slots(self) -> int:
+        """Hand every occupied slot back to the shared queue (or shed it
+        typed when its deadline is gone) — the dying replica's half of
+        failover. The serve thread calls this on ANY dead-exit, so a
+        request admitted in the very race window where the ReplicaSet
+        declared the replica dead still gets rescued; pool keys carry a
+        per-admission nonce, so even a double-handled request can never
+        collide in a page pool. Safe to call from the ReplicaSet too
+        (stuck-thread steal): slot writes are atomic item stores and
+        completion stays exactly-once via the generation check."""
+        from .. import obs
+
+        requeued = 0
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            self.slots[i] = None
+            self.pool.release(slot.seq_key)
+            gen = slot.req._requeue_bump()
+            if gen is None:
+                continue  # finished meanwhile
+            if time.monotonic() >= slot.req.deadline:
+                _shed("deadline")
+                slot.req._finish(error=DeadlineExceededError(
+                    f"request {slot.req.id} expired during replica "
+                    "failover", stage="failover",
+                ))
+                continue
+            self.queue.requeue(slot.req)
+            requeued += 1
+        if requeued:
+            self.stats["stranded_requeued"] += requeued
+            obs.count("ff_serving_requeues_total", requeued,
+                      help="in-flight requests requeued by failover")
+        return requeued
+
+    def _serve_loop(self) -> None:
+        from .. import obs
+
+        try:
+            if self.config.precompile:
+                with obs.span("serving_warmup", cat="serving",
+                              replica=self.name):
+                    self._warmup_compiles()
+            while not self._stop.is_set() and not self.dead:
+                while (not self.draining and None in self.slots
+                       and self._try_admit_one()):
+                    pass
+                if self.fault_injector is not None:
+                    if self.fault_injector.fire(
+                        "replica_death", self._iteration, replica=self.name
+                    ) is not None:
+                        raise ReplicaDeathError(
+                            f"replica {self.name} death injected at "
+                            f"iteration {self._iteration}"
+                        )
+                if self.active_slots == 0:
+                    if self.draining:
+                        return
+                    time.sleep(self.config.idle_wait_s)
+                    continue
+                it = self._iteration
+                if self.monitor is not None:
+                    self.monitor.step_started(it)
+                t0 = time.monotonic()
+                if self.fault_injector is not None:
+                    plan = self.fault_injector.fire("slow_worker", it,
+                                                    replica=self.name)
+                    if plan is not None:
+                        # a wedged device/interconnect: the iteration
+                        # stalls INSIDE the monitored step window so the
+                        # HealthMonitor watchdog sees a hung step
+                        time.sleep(float(plan.get("delay_s", 1.0)))
+                self._decode_iteration()
+                dt = time.monotonic() - t0
+                if self.monitor is not None:
+                    self.monitor.step_finished(it)
+                # each active sequence gains one token per iteration, so
+                # the iteration wall time IS the per-token service time
+                self._token_ewma_s = (
+                    dt if self._token_ewma_s is None
+                    else 0.8 * self._token_ewma_s + 0.2 * dt
+                )
+                self._iteration += 1
+                self.stats["iterations"] += 1
+                obs.gauge_set("ff_serving_batch_occupancy",
+                              self.active_slots,
+                              help="occupied decode slots", replica=self.name)
+        except BaseException as e:  # replica died: hand off and stop
+            self.dead = True
+            self.death_cause = e
+            logger.exception("serving replica %s died", self.name)
+            obs.event("replica_died", cat="serving", replica=self.name,
+                      error=type(e).__name__, detail=str(e)[:300])
+            self._strand_slots()
+            if self.on_dead is not None:
+                self.on_dead(self, e)
+        else:
+            # marked dead externally (watchdog/heartbeat failover) while
+            # we were mid-iteration: whatever we still hold goes back to
+            # the queue — the ReplicaSet's snapshot may have raced an
+            # admission and seen these slots empty
+            if self.dead and not self._stop.is_set():
+                self._strand_slots()
+
+
+# ----------------------------------------------------------------------
+# multi-replica failover + autoscaling
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class _Replica:
+    name: str
+    model: object
+    batcher: ContinuousBatcher
+    monitor: object  # runtime.elastic.HealthMonitor
+
+
+class ReplicaSet:
+    """N continuous-batching replicas off ONE shared admission queue.
+
+    * **admission** happens once, at `submit`: rate limiting (token
+      bucket, optionally p95-adaptive), then the bounded queue — every
+      rejection is typed and counted.
+    * **health**: each replica gets a HealthMonitor (runtime/elastic.py)
+      watching per-iteration step progress plus a heartbeat probing the
+      serve thread; a hung or dead replica triggers failover.
+    * **failover**: the dead replica's in-flight requests are requeued
+      at the queue FRONT (generation-bumped so the corpse can't publish
+      stale results; blown deadlines are shed typed), siblings keep
+      draining the queue meanwhile, and a restart thread brings a
+      replacement up — from the **warm-spare pool** when one is
+      available (`warm_spares`: models built AND decode-precompiled at
+      startup, so activation is just a checkpoint restore — an
+      in-process rebuild's strategy search would steal the CPU from
+      live replicas mid-overload), else a full rebuild through
+      ``restore_elastic`` resharding when `ckpt_dir` is given — with
+      exponential backoff and a bounded budget.
+    * **autoscaling** (optional): queue depth above
+      `scale_up_queue_depth` adds replicas up to `max_replicas`; a
+      sustained-idle queue retires them down to `min_replicas`."""
+
+    def __init__(self, model_fn: Callable[[], object],
+                 config: ServingConfig, *,
+                 replicas: int = 1,
+                 max_replicas: Optional[int] = None,
+                 ckpt_dir: Optional[str] = None,
+                 fault_injector=None,
+                 health_timeout_s: float = 30.0,
+                 compile_grace_s: Optional[float] = None,
+                 max_replica_restarts: int = 3,
+                 restart_backoff_s: float = 0.2,
+                 warm_spares: int = 0,
+                 scale_up_queue_depth: Optional[int] = None,
+                 scale_down_idle_s: float = 10.0,
+                 autoscale_interval_s: float = 0.25):
+        self.model_fn = model_fn
+        self.config = config
+        self.min_replicas = max(1, replicas)
+        self.max_replicas = max(self.min_replicas, max_replicas or replicas)
+        self.ckpt_dir = ckpt_dir
+        self.fault_injector = fault_injector
+        self.health_timeout_s = health_timeout_s
+        self.compile_grace_s = compile_grace_s
+        self.max_replica_restarts = max(0, max_replica_restarts)
+        self.restart_backoff_s = restart_backoff_s
+        self.warm_spares = max(0, warm_spares)
+        self._spares: List[ContinuousBatcher] = []
+        # one device lock across every replica (and restart/restore work)
+        # in this process — see ContinuousBatcher.__init__
+        self._device_lock = threading.RLock()
+        self.scale_up_queue_depth = (scale_up_queue_depth
+                                     or 2 * config.slots)
+        self.scale_down_idle_s = scale_down_idle_s
+        self.autoscale_interval_s = autoscale_interval_s
+        self.queue = AdmissionQueue(config.max_queue_depth)
+        self.bucket: Optional[TokenBucket] = None
+        if config.rate_limit is not None:
+            self.bucket = TokenBucket(config.rate_limit, config.rate_burst)
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, _Replica] = {}
+        self._counter = 0
+        self._restarts = 0
+        self._pending_restarts = 0
+        self._closed = False
+        self._started = False
+        self._scaler: Optional[threading.Thread] = None
+        self._scaler_stop = threading.Event()
+        self._idle_since: Optional[float] = None
+        self._rate_check = 0
+        # local latency reservoir: the adaptive bucket and the load
+        # harness read p95 without needing a telemetry session
+        from ..obs.metrics import Histogram
+
+        self.latency = Histogram(threading.Lock())
+        self.stats = {"submitted": 0, "requeued": 0, "restarts": 0,
+                      "spares_used": 0, "scale_ups": 0, "scale_downs": 0}
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ReplicaSet":
+        if self._started:
+            return self
+        self._started = True
+        # spares FIRST: built and decode-precompiled while nothing is
+        # serving, so a failover — even one in the very first serving
+        # iteration — finds them ready and activation costs only a
+        # checkpoint restore
+        for i in range(self.warm_spares):
+            batcher = self._new_batcher(self.model_fn(), name=f"spare{i}")
+            batcher._warmup_compiles()
+            with self._lock:
+                self._spares.append(batcher)
+        for _ in range(self.min_replicas):
+            self._add_replica()
+        if self.ckpt_dir is not None:
+            self._ensure_checkpoint()
+        self._scaler = threading.Thread(target=self._autoscale_loop,
+                                        daemon=True,
+                                        name="ff-serve-autoscaler")
+        self._scaler.start()
+        return self
+
+    def stop(self, timeout: float = 15.0, abort_pending: bool = True) -> None:
+        self._closed = True
+        self._scaler_stop.set()
+        if self._scaler is not None:
+            self._scaler.join(timeout=2.0)
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            rep.batcher.draining = True
+        while time.monotonic() < deadline:
+            if len(self.queue) == 0 and all(
+                r.batcher.active_slots == 0 for r in reps
+            ):
+                break
+            time.sleep(0.02)
+        if abort_pending:
+            self.queue.drain(lambda req: RequestShedError(
+                f"request {req.id} aborted: serving shut down",
+                reason="aborted",
+            ))
+        for rep in reps:
+            rep.batcher.stop(timeout=5.0)
+            for slot_idx, slot in enumerate(rep.batcher.slots):
+                if slot is not None and abort_pending:
+                    if slot.req._finish(error=RequestShedError(
+                        f"request {slot.req.id} aborted: serving shut "
+                        "down", reason="aborted",
+                    ), generation=slot.generation):
+                        _shed("aborted")
+                    rep.batcher._release(slot_idx)
+            rep.monitor.stop()
+
+    # -- replica management ---------------------------------------------
+    def _build_model(self, *, elastic: bool):
+        with self._device_lock:
+            if elastic and self.ckpt_dir is not None:
+                from .elastic import ElasticRestoreError, restore_elastic
+
+                try:
+                    model, _info = restore_elastic(self.model_fn,
+                                                   self.ckpt_dir,
+                                                   verbose=False)
+                    return model
+                except ElasticRestoreError:
+                    pass  # no restorable checkpoint: fresh build below
+            return self.model_fn()
+
+    def _new_batcher(self, model,
+                     name: Optional[str] = None) -> ContinuousBatcher:
+        if name is None:
+            with self._lock:
+                name = f"replica{self._counter}"
+                self._counter += 1
+        return ContinuousBatcher(
+            model, self.config, self.queue, name=name,
+            fault_injector=self.fault_injector,
+            on_dead=self._on_batcher_dead,
+            device_lock=self._device_lock,
+        )
+
+    def _activate(self, batcher: ContinuousBatcher) -> _Replica:
+        from . import elastic as el
+        from .. import obs
+
+        monitor = el.HealthMonitor(
+            timeout_s=self.health_timeout_s,
+            compile_grace_s=self.compile_grace_s,
+            heartbeat_fn=self._thread_heartbeat(batcher),
+            heartbeat_interval_s=max(0.05, self.health_timeout_s / 4.0),
+            on_hang=lambda info, b=batcher: self._on_hang(b, info),
+        )
+        batcher.monitor = monitor
+        rep = _Replica(name=batcher.name, model=batcher.model,
+                       batcher=batcher, monitor=monitor)
+        with self._lock:
+            self._replicas[rep.name] = rep
+        monitor.start()
+        batcher.start()
+        obs.event("replica_started", cat="serving", replica=rep.name)
+        obs.gauge_set("ff_serving_replicas", self.replica_count(),
+                      help="live serving replicas")
+        return rep
+
+    def _take_spare(self) -> Optional[ContinuousBatcher]:
+        """A warm spare whose mesh still matches the live topology —
+        activation only needs the latest checkpoint restored onto it.
+        A stale spare (topology changed underneath it) is discarded."""
+        while True:
+            with self._lock:
+                if not self._spares:
+                    return None
+                batcher = self._spares.pop()
+            if batcher.model.executor.mesh_is_live():
+                if self.ckpt_dir is not None:
+                    from .resilience import CheckpointManager
+
+                    with self._device_lock:
+                        CheckpointManager(self.ckpt_dir).restore_latest(
+                            batcher.model, elastic=True
+                        )
+                return batcher
+
+    def _add_replica(self, *, elastic: bool = False,
+                     allow_spare: bool = False) -> _Replica:
+        if allow_spare:
+            spare = self._take_spare()
+            if spare is not None:
+                self.stats["spares_used"] += 1
+                return self._activate(spare)
+        return self._activate(self._new_batcher(
+            self._build_model(elastic=elastic)))
+
+    def _thread_heartbeat(self, batcher: ContinuousBatcher):
+        """PR-2 heartbeat transport probing the serve thread: a beat
+        that finds the thread dead (crashed outside the step window)
+        names it as a straggler, which escalates through on_hang."""
+
+        def beat() -> Optional[list]:
+            if batcher.dead or (
+                batcher._thread is not None
+                and not batcher._thread.is_alive()
+                and not batcher._stop.is_set()
+            ):
+                return [batcher.name]
+            return None
+
+        return beat
+
+    def _ensure_checkpoint(self) -> None:
+        from .resilience import CheckpointManager
+
+        mgr = CheckpointManager(self.ckpt_dir)
+        if mgr.latest_step() is None:
+            with self._lock:
+                rep = next(iter(self._replicas.values()), None)
+            if rep is not None:
+                mgr.save(rep.model, step=0,
+                         extra_meta={"serving": {"replica": rep.name}})
+
+    def replica_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas.values()
+                       if r.batcher.thread_alive())
+
+    def replica_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    # -- failover --------------------------------------------------------
+    def _on_hang(self, batcher: ContinuousBatcher, info: dict) -> None:
+        from .. import obs
+
+        obs.event("replica_hang", cat="serving", replica=batcher.name,
+                  **{k: v for k, v in info.items() if k != "step"})
+        self._fail_replica(batcher, ReplicaDeathError(
+            f"replica {batcher.name} hung: {info.get('kind', 'unknown')}"
+        ))
+
+    def _on_batcher_dead(self, batcher: ContinuousBatcher,
+                         exc: BaseException) -> None:
+        self._fail_replica(batcher, exc)
+
+    def _fail_replica(self, batcher: ContinuousBatcher,
+                      exc: BaseException) -> None:
+        """Take a replica out of rotation and restart it in the
+        background. Idempotent — the watchdog and the serve loop may
+        both report the same death.
+
+        Slot rescue is the SERVE THREAD's job (_strand_slots on its
+        dead-exit): snapshotting its slots from here would race its
+        admission loop — the snapshot can miss a request admitted in
+        that instant, which would then hang forever. Only when the
+        thread is genuinely wedged (a real hung collective — it will
+        never reach its exit path) does this thread steal the slots
+        after a grace join."""
+        from .. import obs
+
+        with self._lock:
+            rep = self._replicas.pop(batcher.name, None)
+        if rep is None:
+            return  # already handled
+        batcher.dead = True
+        rep.monitor.stop()
+        if batcher._thread is not None and (
+            batcher._thread is not threading.current_thread()
+        ):
+            batcher._thread.join(timeout=5.0)
+            if batcher._thread.is_alive():
+                # truly wedged: it cannot run its own exit stranding
+                logger.warning("replica %s thread is wedged; stealing its "
+                               "in-flight slots", batcher.name)
+                batcher._strand_slots()
+        requeued = batcher.stats["stranded_requeued"]
+        self.stats["requeued"] += requeued
+        logger.warning("replica %s failed (%s: %s); requeued %d in-flight "
+                       "request(s)", batcher.name, type(exc).__name__, exc,
+                       requeued)
+        obs.event("replica_failover", cat="serving", replica=batcher.name,
+                  requeued=requeued, error=type(exc).__name__,
+                  detail=str(exc)[:300])
+        obs.gauge_set("ff_serving_replicas", self.replica_count(),
+                      help="live serving replicas")
+        if self._closed:
+            return
+        with self._lock:
+            if self._restarts >= self.max_replica_restarts:
+                obs.event("replica_restart_budget_exhausted", cat="serving",
+                          replica=batcher.name,
+                          restarts=self._restarts)
+                return
+            self._restarts += 1
+            self._pending_restarts += 1
+            restarts = self._restarts
+        threading.Thread(
+            target=self._restart_replica, args=(batcher.name, restarts),
+            daemon=True, name=f"ff-serve-restart-{batcher.name}",
+        ).start()
+
+    @staticmethod
+    def pool_release_quiet(batcher: ContinuousBatcher, slot: _Slot) -> None:
+        try:
+            batcher.pool.release(slot.seq_key)
+        except Exception:  # fflint: disable=FFL002 — best-effort cleanup
+            pass
+
+    def _restart_replica(self, dead_name: str, attempt: int) -> None:
+        from .. import obs
+
+        time.sleep(self.restart_backoff_s * (2.0 ** (attempt - 1)))
+        try:
+            rep = self._add_replica(elastic=True, allow_spare=True)
+        except BaseException as e:
+            logger.exception("restart of dead replica %s failed", dead_name)
+            obs.event("replica_restart_failed", cat="serving",
+                      replica=dead_name, error=type(e).__name__,
+                      detail=str(e)[:300])
+            return
+        finally:
+            with self._lock:
+                self._pending_restarts -= 1
+        self.stats["restarts"] += 1
+        obs.count("ff_replica_restarts_total",
+                  help="serving replicas restarted after death/hang")
+        obs.event("replica_restarted", cat="serving", dead=dead_name,
+                  replacement=rep.name, attempt=attempt,
+                  elastic=self.ckpt_dir is not None)
+
+    # -- autoscaling -----------------------------------------------------
+    def _autoscale_loop(self) -> None:
+        from .. import obs
+
+        while not self._scaler_stop.wait(self.autoscale_interval_s):
+            depth = len(self.queue)
+            with self._lock:
+                pending = self._pending_restarts
+            # replicas mid-restart count toward capacity: scaling up to
+            # "replace" one that failover is already replacing would
+            # over-provision, and the later idle scale-down would drain
+            # a replica that real traffic still needs
+            n = self.replica_count() + pending
+            if depth >= self.scale_up_queue_depth and n < self.max_replicas:
+                try:
+                    rep = self._add_replica(allow_spare=True)
+                except BaseException as e:
+                    obs.event("replica_scale_up_failed", cat="serving",
+                              error=type(e).__name__, detail=str(e)[:300])
+                    continue
+                self.stats["scale_ups"] += 1
+                obs.event("replica_scale_up", cat="serving",
+                          replica=rep.name, queue_depth=depth)
+                self._idle_since = None
+                continue
+            busy = depth > 0 or any(
+                r.batcher.active_slots for r in self._replicas.values()
+            )
+            if busy:
+                self._idle_since = None
+                continue
+            if n <= self.min_replicas:
+                continue
+            now = time.monotonic()
+            if self._idle_since is None:
+                self._idle_since = now
+                continue
+            if now - self._idle_since >= self.scale_down_idle_s:
+                self._scale_down_one()
+                self._idle_since = None
+
+    def _scale_down_one(self) -> None:
+        from .. import obs
+
+        with self._lock:
+            victims = [r for r in self._replicas.values()
+                       if r.batcher.thread_alive()]
+            if len(victims) <= self.min_replicas:
+                return
+            rep = victims[-1]
+            del self._replicas[rep.name]
+        # drain, don't kill: draining stops admissions and the loop exits
+        # on its own once the last slot retires; a hard stop here would
+        # orphan in-flight requests (a silent drop). Stragglers past the
+        # grace window are requeued exactly like failover.
+        rep.batcher.draining = True
+        grace = time.monotonic() + 30.0
+        while rep.batcher.active_slots and time.monotonic() < grace:
+            time.sleep(0.02)
+        for slot in rep.batcher.in_flight():
+            gen = slot.req._requeue_bump()
+            self.pool_release_quiet(rep.batcher, slot)
+            if gen is not None:
+                self.queue.requeue(slot.req)
+                self.stats["requeued"] += 1
+        rep.batcher.stop(timeout=5.0)
+        rep.monitor.stop()
+        self.stats["scale_downs"] += 1
+        obs.event("replica_scale_down", cat="serving", replica=rep.name)
+        obs.gauge_set("ff_serving_replicas", self.replica_count(),
+                      help="live serving replicas")
+
+    # -- client API ------------------------------------------------------
+    def _latency_p95(self) -> float:
+        from .. import obs
+
+        tel = obs.active()
+        if tel is not None:
+            h = tel.metrics.find("ff_serving_latency_seconds")
+            if h is not None and getattr(h, "count", 0):
+                return h.quantile(0.95)
+        return self.latency.quantile(0.95)
+
+    def submit(self, prompt: np.ndarray, *,
+               max_new_tokens: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> GenerationRequest:
+        """Admission-controlled enqueue. Raises (typed, counted):
+        RateLimitedError / QueueFullError / DeadlineExceededError. A
+        returned request is ADMITTED: it will end in a result or a typed
+        error — never silence."""
+        if self._closed or not self._started:
+            raise ServingConfigError(
+                "ReplicaSet is not accepting requests (call start(); "
+                "not after stop())"
+            )
+        req = GenerationRequest(
+            prompt,
+            max_new_tokens if max_new_tokens is not None
+            else self.config.default_max_new_tokens,
+            deadline_s=(deadline_s if deadline_s is not None
+                        else self.config.default_deadline_s),
+        )
+        if self.bucket is not None:
+            if self.config.adaptive_rate:
+                self._rate_check += 1
+                if self._rate_check % 16 == 0:
+                    self.bucket.adapt(self._latency_p95(),
+                                      self.config.target_p95_s)
+            if not self.bucket.try_acquire():
+                err = RateLimitedError(
+                    f"request {req.id} rate-limited "
+                    f"({self.bucket.rate:.1f} req/s)"
+                )
+                _shed("rate_limited")
+                req._finish(error=err)
+                raise err
+        self.queue.offer(req)  # sheds typed on full/dead-on-arrival
+        self.stats["submitted"] += 1
+        return req
+
+    def generate(self, prompt: np.ndarray, *,
+                 max_new_tokens: Optional[int] = None,
+                 deadline_s: Optional[float] = None,
+                 timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking submit+result; observes the local latency reservoir
+        the adaptive rate limiter reads."""
+        req = self.submit(prompt, max_new_tokens=max_new_tokens,
+                          deadline_s=deadline_s)
+        out = req.result(timeout)
+        self.latency.observe(time.monotonic() - req.submitted_t)
+        return out
+
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    def aggregate_stats(self) -> dict:
+        with self._lock:
+            reps = list(self._replicas.values())
+        agg = dict(self.stats)
+        agg["replicas"] = {r.name: dict(r.batcher.stats) for r in reps}
+        agg["queue_depth"] = len(self.queue)
+        return agg
+
+
 class InferenceRequest:
-    def __init__(self, inputs: List[np.ndarray]):
+    def __init__(self, inputs: List[np.ndarray],
+                 deadline: Optional[float] = None):
         self.id = uuid.uuid4().hex
         self.inputs = inputs
+        self.deadline = deadline  # absolute monotonic; None = no deadline
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
@@ -468,12 +1803,20 @@ class BatchScheduler:
     budget is spent the scheduler stays degraded until the operator
     intervenes. Restart counts surface in `stats["worker_restarts"]`.
     `fault_injector` site ``serving_worker`` kills the worker
-    deterministically in tests."""
+    deterministically in tests.
+
+    Deadlines propagate INTO the queue: `infer(timeout=...)` stamps the
+    request, and the worker sheds expired requests at dequeue with a
+    typed DeadlineExceededError (counted in ff_serving_shed_total)
+    instead of burning device time on an answer nobody is waiting for.
+    `max_queue_depth` bounds the queue; beyond it `submit` sheds with
+    QueueFullError."""
 
     def __init__(self, model, *, max_delay_s: float = 0.005,
                  retry_policy=None, fault_injector=None,
                  max_worker_restarts: int = 3,
-                 restart_backoff_s: float = 0.25):
+                 restart_backoff_s: float = 0.25,
+                 max_queue_depth: Optional[int] = None):
         if model.executor is None:
             raise NotCompiledError("compile() the model first")
         from .resilience import RetryPolicy
@@ -487,16 +1830,21 @@ class BatchScheduler:
         self.fault_injector = fault_injector
         self.max_worker_restarts = max(0, max_worker_restarts)
         self.restart_backoff_s = restart_backoff_s
+        self.max_queue_depth = max_queue_depth
         self._q: "queue.Queue[InferenceRequest]" = queue.Queue()
         self._fwd = model.executor.build_forward()
         self._stop = threading.Event()
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._started = False
         self._worker_error: Optional[BaseException] = None
+        # guards ALL restart/backoff state: _worker_error, _next_restart_t
+        # and the worker_restarts stat — the worker thread and any number
+        # of infer() callers race on these
         self._restart_lock = threading.Lock()
         self._next_restart_t = 0.0
         self.stats = {"requests": 0, "batches": 0, "padded_slots": 0,
-                      "degraded": 0, "timeouts": 0, "worker_restarts": 0}
+                      "degraded": 0, "timeouts": 0, "worker_restarts": 0,
+                      "shed": 0, "degraded_retries": 0}
 
     # -- client API ------------------------------------------------------
     def start(self):
@@ -543,9 +1891,20 @@ class BatchScheduler:
             self._worker.start()
             return True
 
-    def submit(self, inputs: List[np.ndarray]) -> InferenceRequest:
-        """Each request carries ONE sample per model input (no batch dim)."""
-        req = InferenceRequest([np.asarray(a) for a in inputs])
+    def submit(self, inputs: List[np.ndarray],
+               deadline: Optional[float] = None) -> InferenceRequest:
+        """Each request carries ONE sample per model input (no batch dim).
+        `deadline` is absolute time.monotonic(); the worker sheds the
+        request (typed) if it is still queued past it."""
+        if (self.max_queue_depth is not None
+                and self._q.qsize() >= self.max_queue_depth):
+            self.stats["shed"] += 1
+            _shed("queue_full")
+            raise QueueFullError(
+                f"BatchScheduler queue at capacity ({self.max_queue_depth})"
+            )
+        req = InferenceRequest([np.asarray(a) for a in inputs],
+                               deadline=deadline)
         self._q.put(req)
         return req
 
@@ -553,30 +1912,35 @@ class BatchScheduler:
         """Blocking single-sample inference. Timeouts raise
         InferenceTimeout and are retried per `self.retry_policy`; a dead
         worker degrades to direct unbatched execution instead of hanging
-        every caller until restart."""
+        every caller until restart. A request whose deadline passes
+        while still queued is shed with DeadlineExceededError (not
+        retried, not executed)."""
         from .. import obs
         from .resilience import InferenceTimeout, retry
 
         t_start = time.perf_counter()
+        deadline = time.monotonic() + timeout
 
         def attempt():
             if not self._maybe_restart_worker():
                 return self._infer_direct(inputs)
-            req = self.submit(inputs)
+            req = self.submit(inputs, deadline=deadline)
             if not req.event.wait(timeout):
                 self.stats["timeouts"] += 1
                 if not self.worker_alive():
                     # died while we waited — the request will never be
                     # answered from the queue
-                    return self._infer_direct(inputs)
+                    return self._degraded_retry(req, inputs)
                 raise InferenceTimeout(
                     f"request {req.id} unanswered after {timeout}s "
                     f"(queue depth {self._q.qsize()})"
                 )
             if req.error is not None:
+                if isinstance(req.error, RequestShedError):
+                    raise req.error  # shed on purpose: never re-executed
                 # the worker failed ON this batch; answer from the
                 # degraded path rather than bubbling its crash to callers
-                return self._infer_direct(inputs)
+                return self._degraded_retry(req, inputs)
             return req.result
 
         try:
@@ -594,6 +1958,22 @@ class BatchScheduler:
                   help="serving requests answered")
         return out
 
+    def _degraded_retry(self, req: InferenceRequest,
+                        inputs: List[np.ndarray]) -> np.ndarray:
+        """An in-flight request was orphaned by a worker death and is
+        being re-run on the degraded path — surfaced as a structured
+        event (the satellite fix: this used to happen silently)."""
+        from .. import obs
+
+        self.stats["degraded_retries"] += 1
+        obs.count("ff_serving_degraded_retries_total",
+                  help="in-flight requests re-run unbatched after a "
+                       "worker death")
+        obs.event("serving_degraded_retry", cat="serving",
+                  request=req.id,
+                  error=type(req.error).__name__ if req.error else "orphaned")
+        return self._infer_direct(inputs)
+
     def _infer_direct(self, inputs: List[np.ndarray]) -> np.ndarray:
         """DEGRADED mode: run one request on the caller's thread, padded
         to the compiled batch (same jitted executable, no queue)."""
@@ -609,6 +1989,20 @@ class BatchScheduler:
         return out[0]
 
     # -- batching loop ---------------------------------------------------
+    def _shed_if_expired(self, req: InferenceRequest) -> bool:
+        """Dequeue-time deadline check (satellite fix): a request whose
+        caller already gave up must not reach the device — shed it with
+        a typed error the caller sees instead of a silent late answer."""
+        if req.deadline is None or time.monotonic() < req.deadline:
+            return False
+        self.stats["shed"] += 1
+        _shed("deadline")
+        req.error = DeadlineExceededError(
+            f"request {req.id} expired while queued", stage="dequeue",
+        )
+        req.event.set()
+        return True
+
     def _loop(self):
         import jax.numpy as jnp
 
@@ -616,18 +2010,24 @@ class BatchScheduler:
         while not self._stop.is_set():
             batch: List[InferenceRequest] = []
             try:
-                batch.append(self._q.get(timeout=0.05))
+                got = self._q.get(timeout=0.05)
             except queue.Empty:
                 continue
-            deadline = time.monotonic() + self.max_delay_s
+            if not self._shed_if_expired(got):
+                batch.append(got)
+            fill_by = time.monotonic() + self.max_delay_s
             while len(batch) < self.batch_size:
-                remaining = deadline - time.monotonic()
+                remaining = fill_by - time.monotonic()
                 if remaining <= 0:
                     break
                 try:
-                    batch.append(self._q.get(timeout=remaining))
+                    got = self._q.get(timeout=remaining)
                 except queue.Empty:
                     break
+                if not self._shed_if_expired(got):
+                    batch.append(got)
+            if not batch:
+                continue
             try:
                 if self.fault_injector is not None:
                     self.fault_injector.fire("serving_worker",
@@ -644,12 +2044,18 @@ class BatchScheduler:
                 # worker is no longer trustworthy: fail the in-flight
                 # requests (their callers re-run degraded) and exit so
                 # worker_alive() routes future traffic around the queue
-                # until _maybe_restart_worker's backoff window opens
-                self._worker_error = e
-                self._next_restart_t = time.monotonic() + (
-                    self.restart_backoff_s
-                    * (2.0 ** self.stats["worker_restarts"])
-                )
+                # until _maybe_restart_worker's backoff window opens.
+                # Backoff state is written under the restart lock
+                # (satellite fix): infer() callers racing through
+                # _maybe_restart_worker read these fields, and an
+                # unlocked write could let a restart slip in before the
+                # backoff window was published.
+                with self._restart_lock:
+                    self._worker_error = e
+                    self._next_restart_t = time.monotonic() + (
+                        self.restart_backoff_s
+                        * (2.0 ** self.stats["worker_restarts"])
+                    )
                 for r in batch:
                     r.error = e
                     r.event.set()

@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Overload-robust serving check (docs/serving.md): run the sustained-load
+# harness on short CPU-mesh configurations — a 10x offered-load ramp with
+# a replica killed mid-ramp must keep admitted p99 bounded, shed every
+# non-admitted request with a TYPED error, and end with the replica
+# restored through the elastic-restore path. Two legs:
+#   1. 8-device mesh, standard pool — failover under load;
+#   2. 4-device mesh, starved KV pool + tight deadlines — admission
+#      backpressure and deadline shedding paths (typed accounting is the
+#      assertion; shed counts land in the JSON summary).
+# CI wires this into the lint workflow alongside the other *_check.sh.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+OUT="$(mktemp -d)"
+trap 'rm -rf "$OUT"' EXIT
+
+echo "=== serving_check leg 1: 8-device mesh, replica kill mid-ramp ==="
+JAX_NUM_CPU_DEVICES=8 python scripts/load_check.py \
+    --warm-s 3 --ramp-s 5 --post-s 2 --base-rate 5 \
+    --json "$OUT/leg1.json"
+
+echo "=== serving_check leg 2: 4-device mesh, starved KV pool ==="
+JAX_NUM_CPU_DEVICES=4 python scripts/load_check.py \
+    --warm-s 3 --ramp-s 4 --post-s 2 --base-rate 8 \
+    --slots 2 --num-pages 6 --deadline-s 2.5 --queue-depth 12 \
+    --json "$OUT/leg2.json"
+
+python - "$OUT" <<'EOF'
+import json
+import sys
+
+leg1 = json.load(open(f"{sys.argv[1]}/leg1.json"))
+leg2 = json.load(open(f"{sys.argv[1]}/leg2.json"))
+assert leg1["failover"]["restarts"] >= 1, leg1["failover"]
+assert leg1["counts"]["hung_or_silent"] == 0
+assert leg2["counts"]["hung_or_silent"] == 0
+print("serving_check: leg1 failover restarts =",
+      leg1["failover"]["restarts"],
+      "| leg2 shed(typed) =",
+      leg2["counts"]["shed_submit"] + leg2["counts"]["shed_typed"],
+      dict(leg2["shed_reasons"]), "— OK")
+EOF
