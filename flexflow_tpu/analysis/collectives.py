@@ -22,7 +22,11 @@ up as deadlocks or silently-wrong numbers on device:
   * FFA205 — a MachineView addressing devices outside the live device
     range;
   * FFA206 — a view whose part count disagrees with the op's output
-    degree (warning: lowering demotes it to replication).
+    degree (warning: lowering demotes it to replication);
+  * FFA207 — a WeightShard (FSDP) op whose target carries no shardable
+    weights, or whose target's weight-dim degrees disagree with the
+    declared shard degree (the implied all-gather/reduce-scatter pair
+    would move the wrong bytes, or nothing at all).
 """
 from __future__ import annotations
 
@@ -37,6 +41,13 @@ _COLLECTIVE_OF = {
     OperatorType.OP_REPLICATE: "broadcast",
     OperatorType.OP_REDUCTION: "all-reduce",
     OperatorType.OP_ALL_TO_ALL: "all-to-all",
+    # FSDP/ZeRO weight sharding implies a PAIR per step: all-gather the
+    # sharded params on use (fwd + bwd) and reduce-scatter the weight
+    # grads (parallel/weight_sharding.py). estimate_collective_bytes
+    # reports the two legs separately under the `all_gather` /
+    # `reduce_scatter` kinds; the ordering lint treats the op as one
+    # collective participant.
+    OperatorType.OP_WEIGHT_SHARD: "all-gather/reduce-scatter",
 }
 
 
@@ -54,15 +65,33 @@ def estimate_collective_bytes(graph, views: Optional[Dict] = None
 
     For each parallel op, the wire bytes its implied collective moves
     per step under the standard ring algorithms (all-reduce 2(p-1)/p of
-    the buffer, all-gather/scatter/all-to-all/broadcast (p-1)/p), where
-    p is the participant count (the view's parts, falling back to the
-    tensor's parallel degree). Feeds the telemetry gauge
+    the buffer, all-gather/scatter/all-to-all/broadcast (p-1)/p,
+    reduce-scatter (p-1)/p), where p is the participant count (the
+    view's parts, falling back to the tensor's parallel degree). A
+    WeightShard (FSDP) op contributes TWO records over its target's full
+    weight bytes: kind ``all_gather`` (the params are gathered on use in
+    the forward AND the backward, so 2x(p-1)/p) and kind
+    ``reduce_scatter`` (the weight-grad half of the replicated
+    strategy's all-reduce). Feeds the telemetry gauge
     ``ff_pcg_collective_bytes`` so a strategy's communication footprint
     is visible without running it."""
+    from ..parallel.weight_sharding import shard_target_weight_bytes
+
     out = []
     for op in graph.topo_order():
         kind = _COLLECTIVE_OF.get(op.op_type)
         if kind is None:
+            continue
+        if op.op_type == OperatorType.OP_WEIGHT_SHARD:
+            p = max(1, op.params.shard_degree)
+            wfull = shard_target_weight_bytes(op)
+            ring = (p - 1) / p if p > 1 else 0.0
+            out.append({"op": op.name, "guid": op.guid,
+                        "kind": "all_gather",
+                        "bytes": int(2 * wfull * ring), "parts": p})
+            out.append({"op": op.name, "guid": op.guid,
+                        "kind": "reduce_scatter",
+                        "bytes": int(wfull * ring), "parts": p})
             continue
         t = op.inputs[0] if op.inputs else (
             op.outputs[0] if op.outputs else None
@@ -118,6 +147,8 @@ def collective_diagnostics(graph, views: Optional[Dict] = None,
             _check_reduction_axis(op, rep)
         elif op.op_type == OperatorType.OP_SOFTMAX:
             _check_softmax_axis(op, rep)
+        elif op.op_type == OperatorType.OP_WEIGHT_SHARD:
+            _check_weight_shard(op, rep)
 
     # -- machine-view transitions -----------------------------------------
     for op in ops:
@@ -234,6 +265,61 @@ def _check_reduction_axis(op, rep: AnalysisReport) -> None:
             Severity.ERROR, "FFA202",
             f"reduction_degree {op.params.reduction_degree} != the partial "
             f"dim's degree {deg}", op=op,
+        )
+
+
+def _check_weight_shard(op, rep: AnalysisReport) -> None:
+    """FFA207: a WeightShard op's implied all-gather/reduce-scatter pair
+    must have real sharded weights behind it (parallel/weight_sharding.py):
+    the target (the op producing its input) must carry weights, and every
+    sharded weight dim's degree must equal the declared shard degree —
+    a mismatched degree means the gathered bytes and the stored shards
+    disagree (wrong-result-on-device territory, not a style issue)."""
+    from ..parallel.weight_sharding import weight_shard_target
+
+    deg = op.params.shard_degree
+    if deg < 2:
+        rep.add(
+            Severity.ERROR, "FFA207",
+            f"WeightShard with shard_degree {deg}: nothing to shard "
+            "(degree must be >= 2)", op=op,
+        )
+        return
+    target = weight_shard_target(op)
+    if target is None:
+        rep.add(
+            Severity.ERROR, "FFA207",
+            "WeightShard's input is not produced by a weight-carrying op — "
+            "there are no parameters to shard, gather, or reduce-scatter",
+            op=op,
+            fix_hint="insert the WeightShard node directly after the op "
+                     "whose weights it shards (insert_weight_shard)",
+        )
+        return
+    any_sharded = False
+    for wi, w in enumerate(target.weights):
+        for di, d in enumerate(w.dims):
+            if d.degree <= 1 or d.is_replica_dim:
+                continue
+            any_sharded = True
+            if d.degree != deg:
+                rep.add(
+                    Severity.ERROR, "FFA207",
+                    f"target {target.name} weight {wi} dim {di} is sharded "
+                    f"{d.degree}-way but the WeightShard declares degree "
+                    f"{deg} — the all-gather would reassemble the wrong "
+                    "number of shards", op=op,
+                    fix_hint="make the weight-dim degrees match "
+                             "shard_degree (shard_op_weights does)",
+                )
+    if not any_sharded:
+        rep.add(
+            Severity.ERROR, "FFA207",
+            f"WeightShard declares degree {deg} but no weight dim of "
+            f"target {target.name} is sharded — the node is inert and the "
+            "memory accounting would be wrong", op=op,
+            fix_hint="shard the target's weights (shard_op_weights) or "
+                     "drop the node (fsdp_unshard_weights)",
         )
 
 

@@ -45,16 +45,32 @@ def estimate_per_device_bytes(
     optimizer=None,
     grad_bytes_ratio: float = 1.0,
 ) -> Dict[int, int]:
-    """device id -> estimated peak bytes for the placed strategy."""
+    """device id -> estimated peak bytes for the placed strategy.
+
+    The training multiplier (grads + optimizer slots) is resolved lazily,
+    only when an op actually carries weights: weight-less ops (parallel
+    ops in particular) contribute zero state bytes silently — resolving
+    it eagerly made the PR-1 missing-``state_slots_per_weight``-hook
+    warning fire spuriously on graphs with nothing to charge.
+
+    Sharded weights divide by their degree via ``_shard_bytes``: an
+    FSDP/ZeRO weight (parallel/weight_sharding.py) therefore charges
+    ``bytes/degree x (1 + grad + slots)`` per device — the gradient
+    buffer and the optimizer state shard with the parameter."""
     views = views or {}
-    wmul = (training_weight_multiplier(optimizer, grad_bytes_ratio)
-            if train else 1.0)
+    wmul: Optional[float] = None
     per_dev: Dict[int, int] = {}
     all_devs = list(range(max(1, num_devices)))
     for op in graph.ops:
         act = sum(_shard_bytes(t) for t in op.inputs)
         act += sum(_shard_bytes(t) for t in op.outputs)
-        wb = int(sum(_shard_bytes(w) for w in op.weights) * wmul)
+        wb = 0
+        if op.weights:
+            if wmul is None:
+                wmul = (training_weight_multiplier(optimizer,
+                                                   grad_bytes_ratio)
+                        if train else 1.0)
+            wb = int(sum(_shard_bytes(w) for w in op.weights) * wmul)
         view = views.get(op.guid) or op.machine_view
         devs = view.device_ids() if view is not None else all_devs
         share = act + wb

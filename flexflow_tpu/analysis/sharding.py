@@ -92,6 +92,11 @@ def _expected_parallel_dims(op) -> Optional[List]:
         dims[g].degree = 1
         dims[s].degree = p.degree
         return dims
+    if t == OperatorType.OP_WEIGHT_SHARD:
+        # identity on the activation path: WeightShard reshards parameter
+        # STORAGE (the target op's weight dims), never the flowing tensor
+        # (parallel/weight_sharding.py)
+        return dims
     return None  # REPLICATE / PIPELINE / FUSED_PARALLEL: checked loosely
 
 

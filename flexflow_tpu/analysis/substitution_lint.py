@@ -36,6 +36,7 @@ _PARALLEL_TYPES = {
     OperatorType.OP_REPLICATE,
     OperatorType.OP_REDUCTION,
     OperatorType.OP_ALL_TO_ALL,
+    OperatorType.OP_WEIGHT_SHARD,
 }
 
 # symbolic degree of external input k's dim d
@@ -128,6 +129,17 @@ def _transform(pat, in_states: List[_ShardState], ctx: _RuleCtx,
                                     f"carries replica degree {st.replica}")
             else:
                 st.replica //= g
+        return st
+    if t == OperatorType.OP_WEIGHT_SHARD:
+        # identity on the activation's sharding state: WeightShard moves
+        # parameter STORAGE onto the fsdp axis (weight_sharding.py) and
+        # never reshards the flowing tensor. Requires an explicit degree
+        # >= 2 — a degree-less rule would silently build a 2-way default.
+        deg = p.get("PM_PARALLEL_DEGREE")
+        if not isinstance(deg, int) or deg < 2:
+            ctx.error("FFA404", "WeightShard needs PM_PARALLEL_DEGREE >= 2",
+                      fix_hint="add PM_PARALLEL_DEGREE to the dst op's "
+                               "para list")
         return st
     if t == OperatorType.OP_ALL_TO_ALL:
         s, g = p.get("PM_SCATTER_DIM"), p.get("PM_GATHER_DIM")

@@ -53,6 +53,13 @@ class FFConfig:
     # per pipeline flush (0 = one per stage).
     pipeline_parallel_degree: int = 1
     num_microbatches: int = 0
+    # FSDP/ZeRO weight sharding (parallel/weight_sharding.py): shard
+    # parameters + optimizer state this many ways over the "fsdp" mesh
+    # axis, carved out of the data-parallel workers (must divide the data
+    # degree; clamped otherwise). 1 = fully replicated weights (the old
+    # behavior). The Unity memory-lambda search can also introduce weight
+    # sharding on its own (search/substitution.py fsdp_shard_weights).
+    fsdp_degree: int = 1
     # Recompute memory-heavy op internals (attention scores/probs) in the
     # backward instead of saving them (jax.checkpoint). Exact math; trades
     # FLOPs for HBM. Off by default — at benchmark shapes the stored-probs
@@ -174,6 +181,8 @@ class FFConfig:
                     self.import_strategy_file = take(); i += 1
                 elif a == "--memory-search":
                     self.perform_memory_search = True
+                elif a == "--fsdp-degree":
+                    self.fsdp_degree = int(take()); i += 1
                 elif a == "--machine-model-version":
                     self.machine_model_version = int(take()); i += 1
                 elif a == "--machine-model-file":

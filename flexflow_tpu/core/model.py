@@ -778,17 +778,35 @@ class FFModel:
             ep = max(1, self.config.expert_parallel_degree)
             pp = max(1, self.config.pipeline_parallel_degree)
             dp = max(1, ndev // (tp * sp * ep * pp))
-            mesh = build_mesh(
-                {"data": dp, "model": tp, "seq": sp, "expert": ep, "pipe": pp}
-            )
+            # FSDP/ZeRO (config.fsdp_degree): the fsdp axis is carved out
+            # of the data-parallel workers — weights shard over it, the
+            # batch shards over ("data", "fsdp") jointly — so it must
+            # divide the data degree (clamped down to the largest
+            # power-of-two-ish divisor otherwise)
+            fsdp = max(1, self.config.fsdp_degree)
+            while fsdp > 1 and (fsdp > dp or dp % fsdp != 0):
+                fsdp //= 2
+            if fsdp != max(1, self.config.fsdp_degree):
+                warnings.warn(
+                    f"fsdp_degree {self.config.fsdp_degree} does not divide "
+                    f"the data-parallel degree {dp}; clamped to {fsdp}"
+                )
+            axes = {"data": dp // fsdp if fsdp > 1 else dp, "model": tp,
+                    "seq": sp, "expert": ep, "pipe": pp}
+            if fsdp > 1:
+                axes["fsdp"] = fsdp
+            mesh = build_mesh(axes)
             strategies.apply_data_parallel(self.graph, dp, axis_idx=0)
             strategies.apply_tensor_parallel(self.graph, tp, axis_idx=1)
             strategies.apply_sequence_parallel(self.graph, sp, axis_idx=2)
             strategies.apply_expert_parallel(self.graph, ep, axis_idx=3)
             strategies.apply_pipeline_parallel(self.graph, pp, axis_idx=4)
+            if fsdp > 1:
+                strategies.apply_weight_sharding(self.graph, fsdp,
+                                                 axis_idx=5)
             self.search_trajectory.phase(
                 "manual_lowering", _t_phase, devices=ndev,
-                data=dp, model=tp, seq=sp, expert=ep, pipe=pp,
+                data=dp, model=tp, seq=sp, expert=ep, pipe=pp, fsdp=fsdp,
             )
 
         # 3. Label tensor matched to final op's sharding (model.cc:3054)
@@ -803,8 +821,6 @@ class FFModel:
             if final_ops:
                 tail_type, tail_params = _resolve_value_tail(final_ops[0])
                 if not _probability_like_tail(tail_type, tail_params):
-                    import warnings
-
                     warnings.warn(
                         "cross-entropy losses expect probability outputs "
                         "(the reference's loss kernels take them; "
@@ -1157,7 +1173,9 @@ class FFModel:
             return 1, None
         train = self._is_training_compile()
         gratio = self._grad_bytes_ratio()
-        wmul = (weight_bytes_multiplier(self.optimizer, gratio)
+        wmul = (weight_bytes_multiplier(
+                    self.optimizer, gratio,
+                    warn=any(op.weights for op in self.graph.ops))
                 if train else 1.0)
         mem = measure_memory(
             self.graph, result.views, cost_model,

@@ -242,6 +242,11 @@ class OperatorType(enum.IntEnum):
     OP_FUSED_PARALLEL = 1115
     # TPU-native additions (first-class sequence/context parallelism, SURVEY §7)
     OP_ALL_TO_ALL = 1120
+    # FSDP/ZeRO weight sharding (parallel/weight_sharding.py): parameters +
+    # optimizer state sharded over the "fsdp" mesh axis, all-gather-on-use,
+    # reduce-scatter grads. No reference equivalent (the reference always
+    # replicates weights within a model-parallel group).
+    OP_WEIGHT_SHARD = 1121
     # recurrence (reference implements LSTM only in the standalone nmt/)
     OP_LSTM = 1130
 
@@ -255,6 +260,7 @@ PARALLEL_OP_TYPES = frozenset(
         OperatorType.OP_PIPELINE,
         OperatorType.OP_FUSED_PARALLEL,
         OperatorType.OP_ALL_TO_ALL,
+        OperatorType.OP_WEIGHT_SHARD,
     }
 )
 

@@ -24,8 +24,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 # Canonical axis names in priority order. data = sample dim, model = tensor
 # parallel, seq = sequence/context parallel, expert = MoE experts,
-# pipe = pipeline stages.
-AXIS_NAMES = ("data", "model", "seq", "expert", "pipe")
+# pipe = pipeline stages, fsdp = FSDP/ZeRO weight sharding (weights shard
+# over it; the batch shards over data AND fsdp jointly, so the fsdp group
+# is a subdivision of the data-parallel workers — SpecLayout's convention).
+AXIS_NAMES = ("data", "model", "seq", "expert", "pipe", "fsdp")
 
 
 def build_mesh(
@@ -97,8 +99,15 @@ def pspec_for_parallel_tensor(pt, mesh: Mesh) -> PartitionSpec:
     """Lower ParallelTensor dims to a PartitionSpec over `mesh`.
 
     Partitioned material dims map to their axis; replica dims are dropped
-    (replication is PartitionSpec's default for unmentioned axes)."""
+    (replication is PartitionSpec's default for unmentioned axes).
+
+    ZeRO/FSDP batch rule: under weight sharding the batch spans the data
+    AND fsdp axes jointly (the fsdp group IS a subdivision of the
+    data-parallel workers), so a "data"-assigned dim whose degree equals
+    data_size x fsdp_size lowers to the tuple ("data", "fsdp") — the
+    SpecLayout convention (parallel/weight_sharding.py)."""
     names = mesh.axis_names
+    sizes = dict(zip(names, mesh.devices.shape))
     spec = []
     used = set()
     for d in pt.dims:
@@ -111,8 +120,15 @@ def pspec_for_parallel_tensor(pt, mesh: Mesh) -> PartitionSpec:
             # row- AND column-parallel on one Linear), the first dim keeps
             # the axis and later dims stay replicated — a valid (weaker)
             # lowering of the strategy
-            used.add(names[d.parallel_idx])
-            spec.append(names[d.parallel_idx])
+            name = names[d.parallel_idx]
+            entry = name
+            if (name == "data" and "fsdp" in names and "fsdp" not in used
+                    and d.degree != sizes["data"]
+                    and d.degree == sizes["data"] * sizes.get("fsdp", 1)):
+                entry = ("data", "fsdp")
+                used.add("fsdp")
+            used.add(name)
+            spec.append(entry)
         else:
             spec.append(None)
     # trim trailing Nones

@@ -99,6 +99,12 @@ def execute(op: PCGOp, inputs: List[jax.Array], mesh: Mesh) -> List[jax.Array]:
         OperatorType.OP_REPLICATE,
         OperatorType.OP_ALL_TO_ALL,
         OperatorType.OP_FUSED_PARALLEL,
+        # WeightShard is an identity on the activation path: the storage
+        # semantics (params + optimizer state sharded over the fsdp axis,
+        # all-gather-on-use, reduce-scatter grads) live in the target op's
+        # weight ParallelDims, lowered at init_params — GSPMD inserts the
+        # collectives (parallel/weight_sharding.py).
+        OperatorType.OP_WEIGHT_SHARD,
     ):
         spec = _out_spec(op, mesh)
         return [jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))]

@@ -44,25 +44,39 @@ def assign_mesh_axes(graph: Graph, max_devices: int) -> Dict[str, int]:
 
     The reference executes heterogeneous per-op MachineViews via Legion task
     placement; under one SPMD program we map degrees onto named mesh axes:
-    sample-dim degrees -> "data", channel/head/weight degrees -> "model".
-    A dim whose degree doesn't equal its axis size can't shard evenly under
-    NamedSharding and is demoted to replicated (round-1 lowering limit; the
-    reference's fully heterogeneous placements would need per-segment
-    programs). Block-stack (pipeline) ops keep their stage axis: their
-    num_stages params were fixed at graph build from config, so the mesh
-    must carry a matching "pipe" axis or the GPipe path silently degrades
-    to the sequential scan."""
+    sample-dim degrees -> "data", channel/head/weight degrees -> "model",
+    WeightShard-targeted weight degrees -> "fsdp". A dim whose degree
+    doesn't equal its axis size can't shard evenly under NamedSharding and
+    is demoted to replicated (round-1 lowering limit; the reference's
+    fully heterogeneous placements would need per-segment programs).
+    Block-stack (pipeline) ops keep their stage axis: their num_stages
+    params were fixed at graph build from config, so the mesh must carry a
+    matching "pipe" axis or the GPipe path silently degrades to the
+    sequential scan.
+
+    FSDP: when the fsdp degree divides the batch degree (the ZeRO case
+    the fsdp substitutions construct — batch and weights sharded over the
+    SAME workers), the fsdp axis is carved out of the data axis: mesh
+    data size becomes data_deg/fsdp_deg and the batch dim lowers to the
+    ("data", "fsdp") tuple (parallel/mesh.py). Otherwise fsdp is its own
+    device factor (weights sharded, batch replicated over the group —
+    memory-only sharding, still exact)."""
+    from .weight_sharding import fsdp_degree_of, sharded_weight_records
+
     pipe_deg = 1
     for op in graph.ops:
         stages = getattr(op.params, "num_stages", 1)
         if stages > 1:
             pipe_deg = max(pipe_deg, stages)
+    fsdp_deg = fsdp_degree_of(graph)
+    fsdp_weights = sharded_weight_records(graph) if fsdp_deg > 1 else {}
     data_deg, model_deg = 1, 1
     tensors = list(graph.input_tensors())
     for op in graph.ops:
         tensors.extend(op.outputs)
         tensors.extend(op.weights)
-    # classify: activation dim0 = data; everything else = model
+    # classify: activation dim0 = data; fsdp-targeted weight dims = fsdp;
+    # everything else = model
     weight_guids = {w.guid for op in graph.ops for w in op.weights}
     for t in tensors:
         is_weight = t.guid in weight_guids
@@ -71,15 +85,29 @@ def assign_mesh_axes(graph: Graph, max_devices: int) -> Dict[str, int]:
                 continue
             if i == 0 and not is_weight:
                 data_deg = max(data_deg, d.degree)
+            elif is_weight and t.guid in fsdp_weights \
+                    and d.degree == fsdp_deg:
+                pass  # owned by the fsdp axis, not model
             else:
                 model_deg = max(model_deg, d.degree)
-    # shrink data, then model, before sacrificing the user's requested
-    # pipeline degree; dropping pipe is last resort and is announced
-    while data_deg * model_deg * pipe_deg > max_devices and data_deg > 1:
+
+    def devices_needed(dd: int, fd: int) -> int:
+        # fsdp rides the data workers when it divides the batch degree
+        # (ZeRO); otherwise it's an extra device factor
+        if fd > 1 and dd % fd == 0:
+            return dd * model_deg * pipe_deg
+        return dd * fd * model_deg * pipe_deg
+
+    # shrink data, then model, then drop fsdp, before sacrificing the
+    # user's requested pipeline degree; dropping pipe is last resort
+    while devices_needed(data_deg, fsdp_deg) > max_devices and data_deg > 1:
         data_deg //= 2
-    while data_deg * model_deg * pipe_deg > max_devices and model_deg > 1:
+    while devices_needed(data_deg, fsdp_deg) > max_devices and model_deg > 1:
         model_deg //= 2
-    if data_deg * model_deg * pipe_deg > max_devices:
+    if devices_needed(data_deg, fsdp_deg) > max_devices and fsdp_deg > 1:
+        fsdp_deg = 1  # weight dims demote to replicated below
+        fsdp_weights = {}
+    if devices_needed(data_deg, fsdp_deg) > max_devices:
         from .. import obs
 
         obs.progress(
@@ -90,6 +118,13 @@ def assign_mesh_axes(graph: Graph, max_devices: int) -> Dict[str, int]:
             requested=pipe_deg, devices=max_devices,
         )
         pipe_deg = 1  # ops degrade to the sequential scan path, still correct
+    joint = fsdp_deg > 1 and data_deg % fsdp_deg == 0
+    axes = {"data": data_deg // fsdp_deg if joint else data_deg,
+            "model": model_deg}
+    fsdp_idx = None
+    if fsdp_deg > 1:
+        axes["fsdp"] = fsdp_deg
+        fsdp_idx = len(axes) - 1
     for t in tensors:
         is_weight = t.guid in weight_guids
         for i, d in enumerate(t.dims):
@@ -103,12 +138,14 @@ def assign_mesh_axes(graph: Graph, max_devices: int) -> Dict[str, int]:
                     d.parallel_idx = 0
                 else:
                     d.degree, d.parallel_idx = 1, -1
+            elif is_weight and fsdp_idx is not None \
+                    and t.guid in fsdp_weights and d.degree == fsdp_deg:
+                d.parallel_idx = fsdp_idx
             else:
                 if d.degree == model_deg and model_deg > 1:
                     d.parallel_idx = 1
                 else:
                     d.degree, d.parallel_idx = 1, -1
-    axes = {"data": data_deg, "model": model_deg}
     if pipe_deg > 1:
         axes["pipe"] = pipe_deg
         apply_pipeline_parallel(graph, pipe_deg, axis_idx=len(axes) - 1)
@@ -180,6 +217,18 @@ def apply_pipeline_parallel(graph: Graph, degree: int, axis_idx: int) -> None:
                     wpt.dims[i].degree = degree
                     wpt.dims[i].parallel_idx = axis_idx
                     break
+
+
+def apply_weight_sharding(graph: Graph, degree: int, axis_idx: int) -> int:
+    """FSDP/ZeRO weight sharding as a manual strategy (config.fsdp_degree;
+    no reference equivalent — the reference always replicates weights
+    within a model-parallel group): shard every eligible op's parameters
+    (and thereby gradient buffers + optimizer-state slots, which inherit
+    the sharding) over the ``fsdp`` mesh axis and insert the WeightShard
+    bookkeeping nodes. See parallel/weight_sharding.py for semantics."""
+    from .weight_sharding import apply_weight_sharding as _apply
+
+    return _apply(graph, degree, axis_idx)
 
 
 def apply_sequence_parallel(

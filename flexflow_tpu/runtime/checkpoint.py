@@ -368,13 +368,25 @@ def _merge_restore(live, saved):
             "state", e,
         )
         return live
+    from jax.sharding import NamedSharding
+
     out = []
     for lv, sv in zip(flat_live, flat_saved):
         if lv is None or sv is None:
             out.append(lv)
         else:
             arr = np.asarray(sv)
-            out.append(
-                _put_resharded(arr, lv) if hasattr(lv, "sharding") else arr
-            )
+            if hasattr(lv, "sharding") and isinstance(lv.sharding,
+                                                      NamedSharding):
+                out.append(_put_resharded(arr, lv))
+            elif hasattr(lv, "sharding"):
+                # single-device leaves (Adam's beta_t scalars, built by
+                # plain jnp.asarray) must come back UNCOMMITTED: a
+                # device_put onto their SingleDeviceSharding pins them to
+                # device 0, and the next jitted step then sees state
+                # leaves committed to conflicting device sets and refuses
+                # to run ("incompatible devices") on any multi-device mesh
+                out.append(jnp.asarray(arr.astype(lv.dtype)))
+            else:
+                out.append(arr)
     return jax.tree_util.tree_unflatten(treedef, out)

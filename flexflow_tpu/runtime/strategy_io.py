@@ -26,11 +26,28 @@ logger = logging.getLogger("flexflow_tpu.runtime.strategy_io")
 # Bump when the on-disk record shape changes. Files declaring a NEWER
 # version than we know are rejected (we can't guess fields we've never
 # seen); older versions we still read.
-SCHEMA_VERSION = 1
+# v2: records carry a per-op "weight_shard" field ({axis, degree} or
+# null) for FSDP/ZeRO weight sharding (parallel/weight_sharding.py). A
+# version-1 file that nonetheless contains sharded state (an
+# OP_WEIGHT_SHARD record, or a weight_shard entry with degree > 1) is
+# rejected — a pre-FSDP reader applying it would silently replicate
+# state the strategy expects sharded. Replicated-only v1 files load
+# unchanged.
+SCHEMA_VERSION = 2
 
 
 class StrategyImportError(ValueError):
     """A strategy file failed schema/feasibility validation on import."""
+
+
+def _weight_shard_of(op) -> Optional[dict]:
+    """The op's weight-shard (FSDP) record: the shard axis/degree for an
+    OP_WEIGHT_SHARD node, None for everything else (a target op's sharded
+    weight dims already ride in weight_degrees)."""
+    if getattr(op, "op_type", None) is not None \
+            and op.op_type.name == "OP_WEIGHT_SHARD":
+        return {"axis": "fsdp", "degree": int(op.params.shard_degree)}
+    return None
 
 
 def op_strategy_record(op, view: Optional[MachineView]) -> dict:
@@ -40,6 +57,7 @@ def op_strategy_record(op, view: Optional[MachineView]) -> dict:
         "name": op.name,
         "op_type": op.op_type.name,
         "layer_guid": op.layer_guid,
+        "weight_shard": _weight_shard_of(op),
         "machine_view": (
             {
                 "start_device_id": view.start_device_id,
@@ -102,6 +120,14 @@ def _validate_record(rec, idx: int) -> None:
             raise StrategyImportError(
                 f"op {name!r}: {key} must be lists of positive ints"
             )
+    ws = rec.get("weight_shard")
+    if ws is not None:
+        if not isinstance(ws, dict) or not isinstance(ws.get("degree"), int) \
+                or ws["degree"] < 1 or not isinstance(ws.get("axis"), str):
+            raise StrategyImportError(
+                f"op {name!r}: weight_shard must be null or "
+                "{{axis: str, degree: int >= 1}}"
+            )
 
 
 def import_strategy(path: str) -> Dict[str, dict]:
@@ -130,11 +156,32 @@ def import_strategy(path: str) -> Dict[str, dict]:
     out: Dict[str, dict] = {}
     for i, rec in enumerate(blob["ops"]):
         _validate_record(rec, i)
+        if version < 2 and _record_has_sharded_state(rec):
+            # a pre-v2 file has no schema slot for weight sharding, so a
+            # sharded-state record in one is either hand-edited or written
+            # by a broken exporter — applying it under v1 semantics would
+            # silently replicate state the strategy expects sharded
+            raise StrategyImportError(
+                f"{path}: schema version {version} predates weight "
+                f"sharding but op {rec.get('name')!r} carries sharded "
+                "state (an OP_WEIGHT_SHARD record or a weight_shard "
+                "degree > 1) — re-export the strategy with this build "
+                f"(schema {SCHEMA_VERSION})"
+            )
         if rec["name"] in out:
             logger.warning("strategy %s: duplicate op record %r (last wins)",
                            path, rec["name"])
         out[rec["name"]] = rec
     return out
+
+
+def _record_has_sharded_state(rec: dict) -> bool:
+    """Whether a record describes FSDP-sharded parameters/optimizer
+    state: an OP_WEIGHT_SHARD op, or a weight_shard entry of degree > 1."""
+    if rec.get("op_type") == "OP_WEIGHT_SHARD":
+        return True
+    ws = rec.get("weight_shard")
+    return isinstance(ws, dict) and ws.get("degree", 1) > 1
 
 
 def _check_feasible(rec: dict, num_devices: int) -> None:
@@ -154,6 +201,16 @@ def _check_feasible(rec: dict, num_devices: int) -> None:
                     "searched for a different machine (re-search or import "
                     "a matching file)"
                 )
+    ws = rec.get("weight_shard")
+    if ws and ws.get("degree", 1) > 1:
+        deg = ws["degree"]
+        if deg > num_devices or num_devices % deg != 0:
+            raise StrategyImportError(
+                f"op {name!r}: weight_shard degree {deg} does not divide "
+                f"the {num_devices} available devices — the sharded "
+                "optimizer state cannot be laid out (re-search or import "
+                "a matching file)"
+            )
     mv = rec.get("machine_view")
     if mv:
         last = mv["start_device_id"] + sum(

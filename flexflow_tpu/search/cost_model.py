@@ -377,6 +377,21 @@ class CostModel:
                 if replicas > 1:
                     group = ids[::w_deg][:replicas]
                     sync += self.machine.allreduce_cost(w_bytes / w_deg, group)
+        # Per-device weight bytes divide by the weight's OWN shard degree,
+        # never by the view's part count: a replicated weight under a
+        # data-parallel view lives in FULL on every replica (dividing by
+        # `parts`, as rounds 3-6 did, made the memory search believe DP
+        # already shards state — so the lambda loop admitted strategies
+        # the static analyzer correctly rejects with FFA301, and weight
+        # sharding looked pointless). A dim-sharded weight (tensor-
+        # parallel channel/head splits, FSDP/ZeRO weight sharding) holds
+        # bytes/degree per device regardless of how the view tiles the
+        # activations — the same rule analysis/memory._shard_bytes uses,
+        # so the search and the static HBM gate price the same bytes.
+        wmem = 0
+        for w in op.weights:
+            w_b = _vol(w.material_shape()) * w.data_type.size
+            wmem += int(w_b / max(1, w.get_total_degree()))
         cm = CostMetrics(
             forward_time=fwd,
             backward_time=bwd,
@@ -389,7 +404,7 @@ class CostModel:
                 sum(_vol(t.material_shape()) * t.data_type.size for t in op.outputs)
                 / parts
             ),
-            weights_memory=int(wbytes / parts) if parts > 1 else wbytes,
+            weights_memory=wmem,
         )
         self._cache[key] = cm
         return cm
@@ -484,6 +499,22 @@ class CostModel:
                     return ids[:deg]
             return range(deg)
 
+        if t == OperatorType.OP_WEIGHT_SHARD:
+            # FSDP/ZeRO per-step collectives over the TARGET op's full
+            # weight bytes (parallel/weight_sharding.py): all-gather the
+            # sharded params on use in the forward AND the backward, plus
+            # one reduce-scatter of the weight gradients — 3(p-1)/p wire
+            # bytes vs the replicated strategy's 2(p-1)/p all-reduce
+            # (which measure_operator_cost's sync term stops charging once
+            # the weight is sharded). Strictly slower on runtime, so only
+            # the memory-lambda loop picks it.
+            from ..parallel.weight_sharding import shard_target_weight_bytes
+
+            deg = op.params.shard_degree
+            wbytes = shard_target_weight_bytes(op)
+            g = group(deg)
+            return (2.0 * m.all_gather_cost(wbytes, g)
+                    + m.reduce_scatter_cost(wbytes, g))
         if t == OperatorType.OP_REPLICATE:
             deg = op.params.replicate_degree
             return m.replicate_cost(total, group(deg))

@@ -121,6 +121,31 @@ class MachineModel:
             return 0.0
         return num_bytes / self.ici_bandwidth
 
+    def all_gather_cost(self, num_bytes: float, device_ids) -> float:
+        """Ring all-gather of a `num_bytes` buffer sharded over the group:
+        each device receives (n-1)/n of the full buffer over n-1 ring
+        steps (the FSDP weight-gather-on-use collective,
+        parallel/weight_sharding.py). The latency term matters: it is
+        what keeps half an all-reduce from pricing CHEAPER than the full
+        all-reduce at small sizes (allreduce_cost carries 2(n-1) hops)."""
+        ids = list(device_ids)
+        n = len(ids)
+        if n <= 1 or num_bytes <= 0:
+            return 0.0
+        return (num_bytes * (n - 1) / n / self.ici_bandwidth
+                + (n - 1) * self.ici_latency)
+
+    def reduce_scatter_cost(self, num_bytes: float, device_ids) -> float:
+        """Ring reduce-scatter of a `num_bytes` buffer onto per-device
+        shards: (n-1)/n of the buffer crosses the wire over n-1 ring
+        steps (half an all-reduce — the FSDP gradient collective)."""
+        ids = list(device_ids)
+        n = len(ids)
+        if n <= 1 or num_bytes <= 0:
+            return 0.0
+        return (num_bytes * (n - 1) / n / self.ici_bandwidth
+                + (n - 1) * self.ici_latency)
+
     def compute_cost(
         self, flops: float, mem_bytes: float, dtype_is_bf16: bool = True,
         *, mxu_eff: Optional[float] = None, hbm_eff: Optional[float] = None,

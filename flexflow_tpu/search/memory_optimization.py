@@ -33,7 +33,7 @@ class MemoryUsage:
 
 
 def weight_bytes_multiplier(
-    optimizer=None, grad_bytes_ratio: float = 1.0
+    optimizer=None, grad_bytes_ratio: float = 1.0, *, warn: bool = True
 ) -> float:
     """How many weight-sized allocations training holds per parameter:
     the master weight itself, one gradient buffer (possibly half-width
@@ -41,7 +41,12 @@ def weight_bytes_multiplier(
     optimizer's state slots (SGD-momentum 1, Adam 2 — optimizer.h:36-117;
     ours report via Optimizer.state_slots_per_weight). Round 3's memory
     search counted only the bare weight and so reasoned over roughly half
-    (SGD) to a third (Adam) of real per-chip bytes (VERDICT r3 §Missing 4)."""
+    (SGD) to a third (Adam) of real per-chip bytes (VERDICT r3 §Missing 4).
+
+    `warn=False` silences the missing-hook warning — callers pass it when
+    the graph being priced carries NO weights at all (parallel-op-only
+    subgraphs), where the multiplier multiplies zero bytes and the
+    warning was pure noise."""
     slots = 0
     if optimizer is not None:
         get = getattr(optimizer, "state_slots_per_weight", None)
@@ -51,8 +56,9 @@ def weight_bytes_multiplier(
         # and under-charges an Adam-like one either way. The 0 default is
         # NOT fail-safe for Adam-likes (2 uncounted weight-sized slots =
         # strategies admitted that OOM at runtime), so make the silent
-        # under-accounting loud.
-        if get is None:
+        # under-accounting loud — but only when there are actual weight
+        # bytes to under-account (warn flag above).
+        if get is None and warn:
             warnings.warn(
                 f"optimizer {type(optimizer).__name__!r} does not report "
                 "state_slots_per_weight(); assuming 0 optimizer state "
@@ -83,7 +89,10 @@ def measure_memory(
     and optimizer slots — which live for the whole step on the same
     devices as the weight shard — are visible to the budget check
     (reference: memory_optimization.h:45-100 MemoryUsage)."""
-    wmul = weight_bytes_multiplier(optimizer, grad_bytes_ratio) if train else 1.0
+    has_weights = any(op.weights for op in graph.ops)
+    wmul = (weight_bytes_multiplier(optimizer, grad_bytes_ratio,
+                                    warn=has_weights)
+            if train else 1.0)
     per_dev: Dict[int, int] = {}
     for op in graph.ops:
         view = views.get(op.guid)
@@ -144,7 +153,8 @@ def graph_optimize_with_memory(
 
     from .mcmc import simulate_runtime
 
-    wmul = (weight_bytes_multiplier(optimizer, grad_bytes_ratio)
+    wmul = (weight_bytes_multiplier(optimizer, grad_bytes_ratio,
+                                    warn=any(op.weights for op in graph.ops))
             if train else 1.0)
 
     def run(lam: float):

@@ -152,6 +152,11 @@ def _partition_channel_combine(name: str, op_type, degree: int,
             out_dim = op.outputs[0].dims[channel_axis]
             if out_dim.degree > 1 or out_dim.size % degree != 0:
                 continue
+            if any(d.degree > 1 for w in op.weights for d in w.dims):
+                # the weights are already sharded — by FSDP (a WeightShard
+                # node targets this op) or another weight rewrite; channel
+                # sharding on top would double-shard one dim
+                continue
             g2, _ = copy_graph(graph)
             op2 = next(o for o in g2.ops if o.layer_guid == op.layer_guid
                        and o.name == op.name)
@@ -207,6 +212,8 @@ def reduce_linear_partition(degree: int) -> Substitution:
             in_t = op.inputs[0]
             if in_t.dims[-1].size % degree != 0 or in_t.dims[-1].degree > 1:
                 continue
+            if any(d.degree > 1 for w in op.weights for d in w.dims):
+                continue  # FSDP/TP already owns these weight shards
             g2, tmap = copy_graph(graph)
             op2 = next(o for o in g2.ops if o.layer_guid == op.layer_guid
                        and o.name == op.name)
@@ -305,7 +312,12 @@ def partition_batch(degree: int) -> Substitution:
             if t.dims and t.dims[0].size % degree == 0:
                 t.dims[0].degree = degree
         for op in g2.ops:
-            if op.is_parallel_op:
+            # WeightShard is an identity pass-through on the activation:
+            # its output must carry the batch degree its input gets, or
+            # the two fall out of sync (FFA104). Other parallel ops keep
+            # their own degree bookkeeping.
+            if op.is_parallel_op and \
+                    op.op_type != OperatorType.OP_WEIGHT_SHARD:
                 continue
             for t in op.outputs:
                 if (
@@ -347,6 +359,121 @@ def partition_seq_allgather(degree: int) -> Substitution:
         yield g2
 
     return Substitution(f"partition_seq_allgather_{degree}", apply)
+
+
+def fsdp_shard_weights(degree: int) -> Substitution:
+    """FSDP/ZeRO weight sharding per layer (parallel/weight_sharding.py;
+    SNIPPETS [2]'s fsdp mesh axis, ZeRO SC'20 — no reference equivalent:
+    the reference always replicates weights within a model-parallel
+    group). Applies to one weight-carrying op at a time whose batch dim is
+    already partitioned by `degree` (compose with partition_batch — ZeRO
+    shards state over the SAME workers that shard the batch): shard the
+    op's weight dims and insert the WeightShard bookkeeping node after its
+    output. Strictly slower on pure runtime (all-gather x2 +
+    reduce-scatter = 3(p-1)/p wire bytes vs the replicated all-reduce's
+    2(p-1)/p), so the plain search never picks it; the memory-lambda loop
+    (graph_optimize_with_memory) does, per layer, when replicated
+    params+grads+optimizer slots overflow the HBM budget."""
+
+    def apply(graph: Graph) -> Iterator[Graph]:
+        from ..parallel.weight_sharding import insert_weight_shard, shardable_dim
+
+        for op in graph.ops:
+            if op.is_parallel_op or not op.weights or not op.outputs:
+                continue
+            out0 = op.outputs[0]
+            if not out0.dims or out0.dims[0].is_replica_dim \
+                    or out0.dims[0].degree != degree:
+                continue
+            if any(d.degree > 1 for w in op.weights for d in w.dims):
+                continue  # TP owns these shards (or FSDP already applied)
+            if all(shardable_dim(w, degree) is None for w in op.weights):
+                continue
+            g2, _ = copy_graph(graph)
+            op2 = next(o for o in g2.ops if o.layer_guid == op.layer_guid
+                       and o.name == op.name)
+            insert_weight_shard(g2, op2, degree)
+            yield g2
+
+    return Substitution(f"fsdp_shard_weights_{degree}", apply)
+
+
+def fsdp_zero_shard(degree: int) -> Substitution:
+    """One-shot ZeRO rewrite: partition the batch by `degree` (when it
+    isn't already) AND weight-shard every eligible op in a single
+    candidate. The per-layer fsdp_shard_weights rule needs the
+    batch-partitioned graph on the best-first frontier, but under a high
+    memory lambda that intermediate (batch sharded, weights still
+    replicated) prices far worse than e.g. a column-parallel chain and
+    gets alpha-pruned — a search valley the one-shot rewrite jumps
+    directly, the same reason partition_batch itself is a whole-graph
+    xfer. The search can then back individual layers out via
+    fsdp_unshard_weights."""
+
+    def apply(graph: Graph) -> Iterator[Graph]:
+        from ..parallel.weight_sharding import insert_weight_shard, shardable_dim
+
+        def eligible(op) -> bool:
+            return (not op.is_parallel_op and bool(op.weights)
+                    and bool(op.outputs) and bool(op.outputs[0].dims)
+                    and not op.outputs[0].dims[0].is_replica_dim
+                    and op.outputs[0].dims[0].degree in (1, degree)
+                    and op.outputs[0].dims[0].size % degree == 0
+                    and not any(d.degree > 1
+                                for w in op.weights for d in w.dims)
+                    and any(shardable_dim(w, degree) is not None
+                            for w in op.weights))
+
+        targets = [op for op in graph.ops if eligible(op)]
+        if not targets:
+            return
+        needs_dp = any(op.outputs[0].dims[0].degree == 1 for op in targets)
+        base = graph
+        if needs_dp:
+            base = next(iter(partition_batch(degree).apply(graph)), None)
+            if base is None:
+                return
+        g2, _ = copy_graph(base)
+        sharded = 0
+        for op in list(g2.ops):
+            if eligible(op) and op.outputs[0].dims[0].degree == degree:
+                insert_weight_shard(g2, op, degree)
+                sharded += 1
+        if sharded:
+            yield g2
+
+    return Substitution(f"fsdp_zero_shard_{degree}", apply)
+
+
+def fsdp_unshard_weights() -> Substitution:
+    """Inverse of fsdp_shard_weights: drop one WeightShard node and
+    restore its target's replicated weights, so the search can back out
+    of weight sharding it no longer needs (e.g. after a cheaper layout
+    appeared under a lower lambda)."""
+
+    def apply(graph: Graph) -> Iterator[Graph]:
+        from ..parallel.weight_sharding import (
+            unshard_op_weights,
+            weight_shard_target,
+        )
+
+        for op in _find_ops(graph, OperatorType.OP_WEIGHT_SHARD):
+            g2, _ = copy_graph(graph)
+            ws2 = next(o for o in g2.ops if o.name == op.name)
+            target = weight_shard_target(ws2)
+            if target is not None:
+                unshard_op_weights(target)
+            out_t, in_t = ws2.outputs[0], ws2.inputs[0]
+            for o in g2.ops:
+                for i, t in enumerate(o.inputs):
+                    if t.guid == out_t.guid:
+                        o.inputs[i] = in_t
+            g2.ops = [o for o in g2.ops if o.guid != ws2.guid]
+            g2._producer_cache = None
+            if g2.check_correctness():
+                yield g2
+
+    return Substitution("fsdp_unshard_weights", apply)
 
 
 def merge_parallel_linears() -> Substitution:
@@ -445,7 +572,8 @@ def merge_parallel_linears() -> Substitution:
 def generate_all_pcg_xfers(degrees: List[int], config=None) -> List[Substitution]:
     """reference: GraphSearchHelper::generate_all_pcg_xfers
     (substitution.cc:1726) — one xfer per (kind, degree)."""
-    xfers: List[Substitution] = [merge_parallel_linears()]
+    xfers: List[Substitution] = [merge_parallel_linears(),
+                                 fsdp_unshard_weights()]
     for d in degrees:
         xfers.append(partition_batch(d))
         xfers.append(partition_linear_combine(d))
@@ -453,6 +581,8 @@ def generate_all_pcg_xfers(degrees: List[int], config=None) -> List[Substitution
         xfers.append(partition_attention_combine(d))
         xfers.append(partition_conv2d_combine(d))
         xfers.append(partition_embedding_combine(d))
+        xfers.append(fsdp_shard_weights(d))
+        xfers.append(fsdp_zero_shard(d))
         if config is None or getattr(config, "enable_sequence_parallel", False):
             xfers.append(partition_seq_allgather(d))
     return xfers

@@ -67,6 +67,7 @@ _TYPE_MAP = {
     "OP_NOOP": OperatorType.OP_NOOP,
     "OP_ALLTOALL": OperatorType.OP_ALL_TO_ALL,
     "OP_ALL_TO_ALL": OperatorType.OP_ALL_TO_ALL,
+    "OP_WEIGHT_SHARD": OperatorType.OP_WEIGHT_SHARD,
 }
 
 _PARALLEL_TYPES = {
@@ -75,6 +76,7 @@ _PARALLEL_TYPES = {
     OperatorType.OP_REPLICATE,
     OperatorType.OP_REDUCTION,
     OperatorType.OP_ALL_TO_ALL,
+    OperatorType.OP_WEIGHT_SHARD,
 }
 
 # Ops whose params carry a fusable `activation` field (reference: cuDNN
@@ -242,6 +244,7 @@ _PARALLEL_DEGREE_ATTR = {
     OperatorType.OP_REPLICATE: "replicate_degree",
     OperatorType.OP_REDUCTION: "reduction_degree",
     OperatorType.OP_ALL_TO_ALL: "degree",
+    OperatorType.OP_WEIGHT_SHARD: "shard_degree",
 }
 _PARALLEL_DIM_ATTR = {
     OperatorType.OP_REPARTITION: "repartition_dim",
@@ -249,6 +252,8 @@ _PARALLEL_DIM_ATTR = {
     OperatorType.OP_REPLICATE: "replicate_dim",
     OperatorType.OP_REDUCTION: "reduction_dim",
     OperatorType.OP_ALL_TO_ALL: "scatter_dim",
+    # OP_WEIGHT_SHARD has no dim attribute: it shards weight storage,
+    # not an activation dim (a PM_PARALLEL_DIM constraint never matches)
 }
 
 
@@ -265,8 +270,9 @@ def _op_matches(op: PCGOp, pat: OpPattern) -> bool:
                 op.params, _PARALLEL_DEGREE_ATTR[op.op_type]) != deg:
             return False
         dim = pat.params.get("PM_PARALLEL_DIM")
-        if dim is not None and getattr(
-                op.params, _PARALLEL_DIM_ATTR[op.op_type]) != dim:
+        dim_attr = _PARALLEL_DIM_ATTR.get(op.op_type)
+        if dim is not None and (
+                dim_attr is None or getattr(op.params, dim_attr) != dim):
             return False
     acti = pat.params.get("PM_ACTI")
     if acti is not None:
@@ -337,6 +343,10 @@ def _build_parallel_params(op_type: OperatorType, para: Dict[str, int]):
             gather_dim=para["PM_GATHER_DIM"],
             degree=deg,
         )
+    if op_type == OperatorType.OP_WEIGHT_SHARD:
+        from ..parallel.weight_sharding import WeightShardParams
+
+        return WeightShardParams(shard_degree=deg)
     raise ValueError(op_type)
 
 
@@ -510,6 +520,20 @@ def apply_rule(graph: Graph, rule: Rule) -> Iterator[Graph]:
                             "PM_PARALLEL_DEGREE on a compute op needs a "
                             "divisible, unsharded head-tagged weight dim"
                         )
+                if nop.op_type == OperatorType.OP_WEIGHT_SHARD:
+                    # a dst WeightShard shards its PRODUCER's weights
+                    # (FSDP/ZeRO — parallel/weight_sharding.py); a site
+                    # whose producer carries no shardable weights is
+                    # inapplicable, like any other failed constraint
+                    from ..parallel.weight_sharding import shard_op_weights
+
+                    target = ins[0].owner_op if ins else None
+                    if target is None or not getattr(target, "weights", None):
+                        raise ValueError(
+                            "weight_shard dst: input has no weight-carrying "
+                            "producer"
+                        )
+                    shard_op_weights(target, nop.params.shard_degree)
                 new_ops.append(nop)
         except MergeAfterMaterializationError:
             raise  # a caller bug, not an inapplicable site — surface it

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# FSDP/ZeRO weight-sharding sweep (ISSUE 7): the WeightShard parallel op
+# over 8- and 4-device CPU meshes (docs/fsdp.md). Three legs per device
+# count, all inside tests/test_weight_sharding.py:
+#
+#   * search-under-budget — a model whose replicated strategy statically
+#     fails FFA301 compiles after graph_optimize_with_memory chooses
+#     weight sharding, with zero FFA errors;
+#   * verify — FSDP training matches the replicated/serial reference
+#     (op lowering exactness + verify_strategy);
+#   * elastic reshard — an 8-way FSDP checkpoint restores as 4-way with
+#     the sharded optimizer state preserved bit-exactly (8-device leg
+#     only; the 4-device leg covers manual sharding + analysis).
+#
+# Use before touching parallel/weight_sharding.py, the fsdp_* rewrites,
+# the cost model's memory accounting, or the mesh lowering:
+#
+#   scripts/fsdp_check.sh                 # full sweep (8, 4-device meshes)
+#   FF_FSDP_DEVICES=8 scripts/fsdp_check.sh -k memory_lambda
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+devices="${FF_FSDP_DEVICES:-8 4}"
+for n in $devices; do
+    echo "=== fsdp sweep: ${n}-device CPU mesh ==="
+    env JAX_PLATFORMS=cpu \
+        JAX_NUM_CPU_DEVICES="$n" \
+        XLA_FLAGS="--xla_force_host_platform_device_count=$n" \
+        python -m pytest tests/test_weight_sharding.py -v \
+        -p no:cacheprovider "$@"
+done

@@ -568,6 +568,26 @@ def test_verify_strategy_zoo_sweep():
     cases.append((m, rng.randn(16, 32, 64).astype(np.float32),
                   rng.randint(0, 10, (16, 32, 1)).astype(np.int32)))
 
+    # FSDP/ZeRO weight sharding (parallel/weight_sharding.py): params +
+    # optimizer state sharded over the fsdp axis, all-gather-on-use,
+    # reduce-scatter grads — must be numerically equivalent to serial
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    cfg.fsdp_degree = len(jax.devices())
+    m = FFModel(cfg)
+    x = m.create_tensor((16, 64), DataType.DT_FLOAT)
+    t = m.dense(x, 128, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, 64, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, 10)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.05, momentum=0.9),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    from flexflow_tpu.ff_types import OperatorType as _OT
+
+    assert any(op.op_type == _OT.OP_WEIGHT_SHARD for op in m.graph.ops)
+    cases.append((m, rng.randn(32, 64).astype(np.float32),
+                  rng.randint(0, 10, (32, 1)).astype(np.int32)))
+
     for model, xd, yd in cases:
         v = verify_strategy(model, (xd, yd), steps=3)
         assert v.ok, v.summary()
