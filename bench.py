@@ -49,6 +49,88 @@ def wait_for_backend(max_wait_s: float = 600.0) -> None:
         time.sleep(20)
 
 
+def read_baseline(metric: str):
+    """The throughput this round is compared against (the vs_baseline
+    field): a published number in BASELINE.json if the driver recorded
+    one, else the first measured round (BENCH_r01.json) — the north-star
+    file documents configurations, not numbers, so round 1 is the
+    de-facto baseline of this build."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with open(os.path.join(here, "BASELINE.json")) as f:
+            published = json.load(f).get("published", {}) or {}
+        for key in (metric, "transformer_train_throughput"):
+            v = published.get(key)
+            if isinstance(v, (int, float)) and v > 0:
+                return float(v)
+    except (OSError, ValueError):
+        pass
+    try:
+        with open(os.path.join(here, "BENCH_r01.json")) as f:
+            v = json.load(f).get("parsed", {}).get("value")
+        if isinstance(v, (int, float)) and v > 0:
+            return float(v)
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def phase_breakdown(model, x, y, key, *, repeats: int, fetch):
+    """Per-phase seconds per step: fwd (forward only), bwd (grad step
+    minus forward), opt+sync (full train step minus grad step). Measured
+    through separately jitted programs over the same batch — the split
+    is approximate (XLA fuses differently per program) but stable enough
+    to see which phase a perf round moved."""
+    import numpy as np
+
+    ex = model.executor
+
+    def timed(fn, *args):
+        out = fn(*args)
+        fetch(out)
+        out = fn(*args)  # second warmup absorbs relayout recompiles
+        fetch(out)
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            out = fn(*args)
+        fetch(out)
+        return (time.perf_counter() - t0) / repeats
+
+    fwd = ex.build_forward()
+    grad = ex.build_grad_step()
+    step = ex.build_train_step(donate=False)
+    state = model.state
+    fwd_s = timed(lambda: fwd(state.params, [x]))
+    grad_s = timed(lambda: grad(state.params, [x], y))
+    step_s = timed(lambda: step(state, [x], y, key))
+    # the implicit data-parallel grad collectives are the sync phase; on
+    # one chip they are zero and the remainder is the optimizer update.
+    # Multi-chip: estimated statically (ring all-reduce wire bytes of
+    # every replicated weight gradient over ICI) — the jitted step fuses
+    # the collectives, so they can't be timed separately.
+    sync_s = 0.0
+    d = ex.mesh.shape.get("data", 1) if ex.mesh is not None else 1
+    if d > 1:
+        try:
+            from flexflow_tpu.search.cost_model import op_weight_bytes
+
+            machine = model._build_cost_model().machine
+            wire = sum(
+                2.0 * (d - 1) / d * op_weight_bytes(op)
+                for op in model.graph.topo_order()
+                if op.weights and not op.is_parallel_op
+            )
+            sync_s = wire / machine.ici_bandwidth
+        except Exception:
+            sync_s = 0.0
+    return {
+        "fwd": round(fwd_s, 6),
+        "bwd": round(max(0.0, grad_s - fwd_s), 6),
+        "opt": round(max(0.0, step_s - grad_s - sync_s), 6),
+        "sync": round(sync_s, 6),
+    }
+
+
 def main():
     wait_for_backend()
     import jax
@@ -137,13 +219,36 @@ def main():
 
     n_chips = max(1, len(jax.devices()))
     samples_per_sec_per_chip = batch * iters / elapsed / n_chips
+
+    # per-phase breakdown (fwd/bwd/opt/sync) — measured AFTER the headline
+    # number so its extra compiles can't perturb the timed loop; never
+    # allowed to fail the bench
+    try:
+        def fetch(out):
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            return float(np.asarray(leaf.reshape(-1)[0]))
+
+        phases = phase_breakdown(
+            model, x, y, jax.random.PRNGKey(1),
+            repeats=2 if smoke else 10, fetch=fetch,
+        )
+    except Exception as e:  # pragma: no cover - diagnostics only
+        print(f"bench: phase breakdown failed: {e}", file=sys.stderr)
+        phases = None
+
+    baseline = read_baseline("transformer_train_throughput")
     print(
         json.dumps(
             {
                 "metric": "transformer_train_throughput",
                 "value": round(samples_per_sec_per_chip, 3),
                 "unit": "samples/s/chip",
-                "vs_baseline": None,
+                "vs_baseline": (
+                    round(samples_per_sec_per_chip / baseline, 3)
+                    if baseline else None
+                ),
+                "baseline": baseline,
+                "phases_s_per_step": phases,
             }
         )
     )

@@ -113,6 +113,58 @@ def estimate_collective_bytes(graph, views: Optional[Dict] = None
     return out
 
 
+def overlappable_grad_syncs(graph) -> set:
+    """Guids of ops whose implicit weight-gradient collective is
+    statically PROVABLY independent of the backward critical path — the
+    set the overlap discount (search/cost_model.py) and the overlapped
+    simulator schedule (search/mcmc.simulate_runtime) are allowed to
+    hide behind backward compute.
+
+    The proof is structural: in this IR a compute op's weights are read
+    only by that op, and the weight gradient the sync reduces is
+    consumed only by the optimizer update — no other op's backward can
+    observe it, so the collective commutes with every backward task
+    scheduled after the producing op's. Excluded: ops governed by an
+    OP_WEIGHT_SHARD node (FSDP already owns their reduce-scatter — its
+    cost lives on the parallel op, not the sync term) and parallel ops
+    (activation-path collectives are dependency-ordered by the graph)."""
+    from ..parallel.weight_sharding import weight_shard_target
+
+    covered = set()
+    for op in graph.topo_order():
+        if op.op_type == OperatorType.OP_WEIGHT_SHARD:
+            t = weight_shard_target(op)
+            if t is not None:
+                covered.add(t.guid)
+    return {
+        op.guid
+        for op in graph.topo_order()
+        if op.weights and not op.is_parallel_op and op.guid not in covered
+    }
+
+
+def hideable_backward_compute(graph, views: Optional[Dict] = None,
+                              cost_model=None) -> Dict[int, float]:
+    """guid -> seconds of backward compute statically independent of
+    that op's weight-grad collective: the backward of every
+    topologically-EARLIER op runs after this op's backward produces its
+    gradient, and none of it reads the synced gradient
+    (overlappable_grad_syncs), so all of it can hide the collective.
+    Ops whose sync is not overlappable map to 0.0."""
+    from ..pcg.machine_view import MachineView
+
+    ov = overlappable_grad_syncs(graph)
+    v1 = MachineView(start_device_id=0, dim=(1,), stride=(1,))
+    out: Dict[int, float] = {}
+    prefix = 0.0
+    for op in graph.topo_order():
+        out[op.guid] = prefix if op.guid in ov else 0.0
+        if cost_model is not None:
+            v = _view_of(op, views or {}) or v1
+            prefix += cost_model.measure_operator_cost(op, v).backward_time
+    return out
+
+
 def collective_diagnostics(graph, views: Optional[Dict] = None,
                            num_devices: Optional[int] = None
                            ) -> AnalysisReport:

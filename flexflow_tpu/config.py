@@ -35,7 +35,25 @@ class FFConfig:
     # Strategy-search knobs (reference config.h:128-160)
     search_budget: int = -1
     search_alpha: float = 1.2
+    # Cost-model side of comm/compute overlap: when True the search costs
+    # overlappable collectives (weight-grad syncs that are statically
+    # independent of the backward critical path) at
+    # max(0, comm - hideable_compute) instead of additively, so it
+    # PREFERS strategies whose collectives hide
+    # (search/cost_model.py; analysis/collectives.overlappable_grad_syncs
+    # is the static proof). Off by default so searched strategies stay
+    # reproducible against earlier rounds; --overlap-backward-update
+    # turns both sides on.
     search_overlap_backward_update: bool = False
+    # Executed-step side (reference config.h:133 overlap_backward_update):
+    # decompose the data-parallel gradient all-reduce into per-weight
+    # reduce-scatter + sharded optimizer update + all-gather of updated
+    # params, so each layer's collective overlaps earlier layers'
+    # backward matmuls and optimizer state shards ZeRO-1 style
+    # (parallel/executor.py set_overlap_grad_sync). Numerically
+    # equivalent to the all-reduce step; on by default (inert on a
+    # single chip / data degree 1).
+    overlap_backward_update: bool = True
     computationMode: CompMode = CompMode.COMP_MODE_TRAINING
     only_data_parallel: bool = False
     enable_sample_parallel: bool = True
@@ -181,6 +199,12 @@ class FFConfig:
                     self.import_strategy_file = take(); i += 1
                 elif a == "--memory-search":
                     self.perform_memory_search = True
+                elif a == "--overlap-backward-update":
+                    self.overlap_backward_update = True
+                    self.search_overlap_backward_update = True
+                elif a == "--no-overlap-backward-update":
+                    self.overlap_backward_update = False
+                    self.search_overlap_backward_update = False
                 elif a == "--fsdp-degree":
                     self.fsdp_degree = int(take()); i += 1
                 elif a == "--machine-model-version":

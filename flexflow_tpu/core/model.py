@@ -877,6 +877,7 @@ class FFModel:
             remat=self.config.remat,
             constants=constants,
             plan_cost_model=self._build_cost_model(),
+            overlap_grad_sync=self.config.overlap_backward_update,
         )
         self.search_trajectory.phase("executor_build", _t_phase)
         _t_phase = time.perf_counter()
@@ -915,7 +916,10 @@ class FFModel:
                 dcn_bandwidth=machine.dcn_bandwidth,
                 chip=machine.chip,
             )
-        cm = CostModel(machine, bf16=cfg.allow_mixed_precision)
+        cm = CostModel(
+            machine, bf16=cfg.allow_mixed_precision,
+            overlap_backward_update=cfg.search_overlap_backward_update,
+        )
         profiled = getattr(self, "_profiled_op_costs", None)
         if profiled:
             # explain_strategy(...).apply(model) fed real on-device op

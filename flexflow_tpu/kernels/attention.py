@@ -26,7 +26,6 @@ from __future__ import annotations
 import functools
 import math
 import os
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -94,6 +93,82 @@ def chunked_attention(q, k, v, *, causal: bool = False, chunk_size: int = 256):
 
 
 # ---------------------------------------------------------------------------
+# Counter-based dropout bits (shared by the Pallas kernels and the dense
+# reference path)
+# ---------------------------------------------------------------------------
+# The mask is a pure function of (seeds, element index): each score element
+# (row, q, k) hashes its flat index with two 32-bit seeds drawn from the op's
+# PRNG key, and keeps the probability iff the hash clears the drop threshold.
+# Because the bits are counter-based, the flash kernels regenerate the exact
+# same mask per block (forward AND backward) from the block offsets alone —
+# no O(s^2) mask tensor ever touches HBM — and the dense path can materialize
+# the identical mask for parity tests. Index arithmetic is uint32 with
+# wraparound on both sides, so the two paths can never disagree.
+
+def _mix32(h):
+    """murmur3-style 32-bit finalizer (jnp uint32, wraps)."""
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x7FEB352D)
+    h = h ^ (h >> 15)
+    h = h * jnp.uint32(0x846CA68B)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _keep_bits(idx, s0, s1):
+    """uint32 hash of a flat element index under two uint32 seeds."""
+    h = (idx * jnp.uint32(0x9E3779B1)) ^ s0
+    h = _mix32(h)
+    h = h ^ s1
+    return _mix32(h)
+
+
+def _drop_threshold(rate: float) -> int:
+    """Keep an element iff hash >= threshold: P(drop) == rate."""
+    return min(0xFFFFFFFF, int(round(float(rate) * 4294967296.0)))
+
+
+def dropout_seeds(rng):
+    """Two uint32 seeds for the counter-based mask, drawn from a jax
+    PRNG key (deterministic per key; works for both old uint32[2] keys
+    and new-style typed keys)."""
+    return jax.random.bits(rng, (2,), jnp.uint32)
+
+
+def attention_dropout_mask(seeds, rate: float, bh: int, sq: int, sk: int):
+    """The FULL (bh, sq, sk) keep-mask the flash kernels apply blockwise.
+
+    `bh` rows follow the folded (batch*heads, b-major) layout; the dense
+    path reshapes its (b, h, sq, sk) probs tensor to match. This is the
+    parity oracle: flash-with-dropout under `seeds` equals dense attention
+    masked with exactly this array."""
+    if rate <= 0.0:
+        return jnp.ones((bh, sq, sk), bool)
+    s0 = seeds[0].astype(jnp.uint32)
+    s1 = seeds[1].astype(jnp.uint32)
+    row = lax.broadcasted_iota(jnp.uint32, (bh, sq, sk), 0)
+    qp = lax.broadcasted_iota(jnp.uint32, (bh, sq, sk), 1)
+    kp = lax.broadcasted_iota(jnp.uint32, (bh, sq, sk), 2)
+    idx = (row * jnp.uint32(sq) + qp) * jnp.uint32(sk) + kp
+    return _keep_bits(idx, s0, s1) >= jnp.uint32(_drop_threshold(rate))
+
+
+def _keep_tile(seed_ref, row_u, sq: int, sk: int, kv_off, tile_q: int,
+               tile_k: int, rate: float):
+    """In-kernel keep-mask for one (tile_q, tile_k) score tile of row
+    `row_u` (uint32 scalar), with the kv axis offset by `kv_off` — the
+    blockwise view of attention_dropout_mask."""
+    s0 = seed_ref[0]
+    s1 = seed_ref[1]
+    qp = lax.broadcasted_iota(jnp.uint32, (tile_q, tile_k), 0)
+    kp = jnp.uint32(kv_off) + lax.broadcasted_iota(
+        jnp.uint32, (tile_q, tile_k), 1
+    )
+    idx = (row_u * jnp.uint32(sq) + qp) * jnp.uint32(sk) + kp
+    return _keep_bits(idx, s0, s1) >= jnp.uint32(_drop_threshold(rate))
+
+
+# ---------------------------------------------------------------------------
 # Pallas flash-attention forward
 # ---------------------------------------------------------------------------
 
@@ -105,8 +180,8 @@ def _causal_mask(s, *, q_axis: int, kv_axis: int, kv_offset=0):
     return jnp.where(kv_pos <= q_pos, s, NEG_INF)
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
-                      scale: float, g: int):
+def _flash_fwd_kernel(*refs, causal: bool, scale: float, g: int,
+                      dropout: float = 0.0):
     """One program = g (batch*head) rows (g unrolled — measured 206→131 us
     at the bench shape by amortizing per-program overhead). Q/K/V for the
     whole row are VMEM resident (the fused path is capped to shapes where
@@ -114,10 +189,23 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
     softmax — no online accumulation. Dots take the inputs' dtype (bf16
     on the mixed-precision path = native MXU rate) and accumulate f32;
     scores/probs never touch HBM, which is what makes this beat the XLA
-    dense path (134 MB of f32 scores per layer at the bench shape)."""
+    dense path (134 MB of f32 scores per layer at the bench shape).
+
+    dropout > 0 threads the counter-based keep-mask (_keep_tile) into the
+    prob tile after the softmax statistics: l and the saved lse stay
+    UNdropped (the standard flash-dropout scheme), only the p @ v
+    contraction sees the masked/rescaled probs — so the mask never exists
+    outside VMEM and the backward regenerates it bit-identically."""
+    if dropout > 0.0:
+        q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        seed_ref = None
+    inv_keep = 1.0 / (1.0 - dropout) if dropout > 0.0 else 1.0
     for i in range(g):
         q = q_ref[i]                      # (seq_q, d), input dtype
         k = k_ref[i]                      # (seq_k, d)
+        sq, sk = q.shape[0], k.shape[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -127,6 +215,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
         m = jnp.max(s, axis=-1, keepdims=True)
         p = jnp.exp(s - m)
         l = jnp.sum(p, axis=-1, keepdims=True)
+        if dropout > 0.0:
+            row_u = (pl.program_id(0) * g + i).astype(jnp.uint32)
+            keep = _keep_tile(seed_ref, row_u, sq, sk, 0, sq, sk, dropout)
+            p = jnp.where(keep, p * inv_keep, 0.0)
         o = jnp.dot(p.astype(q.dtype), v_ref[i],
                     preferred_element_type=jnp.float32)
         o_ref[i] = (o / jnp.maximum(l, 1e-30).astype(jnp.float32)).astype(
@@ -138,9 +230,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
         lse_ref[i] = (m + jnp.log(jnp.maximum(l, 1e-30))).T
 
 
-def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                      dq_ref, dk_ref, dv_ref, *, causal: bool, scale: float,
-                      g: int, bk: int):
+def _flash_bwd_kernel(*refs, causal: bool, scale: float,
+                      g: int, bk: int, dropout: float = 0.0):
     """Fused dq/dk/dv for g (batch*head) rows in ONE program: the prob
     tile is recomputed from q/k and the saved lse exactly once (the old
     split dq/dkv kernels each recomputed it), delta = rowsum(do*o) is
@@ -150,8 +241,24 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
     The kv axis is tiled at `bk` (unrolled — shapes are static): only a
     (seq_q, bk) slab of the score/prob/ds tiles is live at a time, which
     is what lets g=4 fit VMEM (full seq_k tiles capped g at 2; round-2
-    measured the full-tile g=4 variant REGRESSING on VMEM pressure)."""
+    measured the full-tile g=4 variant REGRESSING on VMEM pressure).
+
+    dropout > 0 regenerates the forward's counter-based keep-mask per
+    (row, kv-block) — same seeds, same indices, so bit-identical — and
+    applies it where the chain rule puts it: dP = D ∘ (dO Vᵀ) before the
+    softmax backward, and dV = (P ∘ D)ᵀ dO. delta = rowsum(dO ∘ O)
+    already equals rowsum(P ∘ dP) under dropout, so the ds formula is
+    unchanged."""
+    if dropout > 0.0:
+        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, seed_ref,
+         dq_ref, dk_ref, dv_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+         dq_ref, dk_ref, dv_ref) = refs
+        seed_ref = None
+    inv_keep = 1.0 / (1.0 - dropout) if dropout > 0.0 else 1.0
     n_blocks = (k_ref.shape[1] + bk - 1) // bk
+    sk_total = k_ref.shape[1]
     for i in range(g):
         q = q_ref[i]
         do = do_ref[i]
@@ -183,8 +290,15 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                 do, v, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
+            if dropout > 0.0:
+                row_u = (pl.program_id(0) * g + i).astype(jnp.uint32)
+                keep = _keep_tile(seed_ref, row_u, q.shape[0], sk_total,
+                                  j * bk, q.shape[0], k.shape[0], dropout)
+                dp = jnp.where(keep, dp * inv_keep, 0.0)
+                pb = jnp.where(keep, p * inv_keep, 0.0).astype(q.dtype)
+            else:
+                pb = p.astype(q.dtype)
             ds = p * (dp - delta)
-            pb = p.astype(q.dtype)
             dsb = ds.astype(q.dtype)
             dq = jnp.dot(dsb, k, preferred_element_type=jnp.float32)
             dq_acc = dq if dq_acc is None else dq_acc + dq
@@ -243,7 +357,8 @@ def _pick_g(bh: int, sq: int, sk: int, budget: int, cap: int) -> int:
     return g
 
 
-def _flash_fwd_folded(qf, kf, vf, *, causal: bool, interpret: bool):
+def _flash_fwd_folded(qf, kf, vf, *, causal: bool, interpret: bool,
+                      dropout: float = 0.0, seeds=None):
     """Core forward on (b*h, s, d) folded operands."""
     bh, sq, d = qf.shape
     sk = kf.shape[1]
@@ -251,15 +366,22 @@ def _flash_fwd_folded(qf, kf, vf, *, causal: bool, interpret: bool):
     g = _pick_g(bh, sq, sk, budget=2 * 1024 * 1024, cap=4)
     scale = 1.0 / math.sqrt(d)
     kernel = functools.partial(_flash_fwd_kernel, causal=causal, scale=scale,
-                               g=g)
+                               g=g, dropout=dropout)
+    in_specs = [
+        pl.BlockSpec((g, sq, d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((g, sk, d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((g, sk, dv), lambda i: (i, 0, 0)),
+    ]
+    args = (qf, kf, vf)
+    if dropout > 0.0:
+        # two uint32 seeds ride in SMEM; the mask itself is regenerated
+        # per score tile from counters (never materialized in HBM)
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args = args + (jnp.asarray(seeds, jnp.uint32),)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh // g,),
-        in_specs=[
-            pl.BlockSpec((g, sq, d), lambda i: (i, 0, 0)),
-            pl.BlockSpec((g, sk, d), lambda i: (i, 0, 0)),
-            pl.BlockSpec((g, sk, dv), lambda i: (i, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((g, sq, dv), lambda i: (i, 0, 0)),
             pl.BlockSpec((g, 1, sq), lambda i: (i, 0, 0)),
@@ -269,21 +391,12 @@ def _flash_fwd_folded(qf, kf, vf, *, causal: bool, interpret: bool):
             jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*args)
     return out, lse
 
 
-def _flash_fwd(q, k, v, *, causal: bool, interpret: bool):
-    b, _, h, _ = q.shape
-    out, lse = _flash_fwd_folded(
-        _bhsd_to_fold(q), _bhsd_to_fold(k), _bhsd_to_fold(v),
-        causal=causal, interpret=interpret,
-    )
-    return _fold_to_bhsd(out, b, h), lse
-
-
 def _flash_bwd_folded(qf, kf, vf, of, lse, dof, *, causal: bool,
-                      interpret: bool):
+                      interpret: bool, dropout: float = 0.0, seeds=None):
     """Core backward on (b*h, s, d) folded operands."""
     bh, sq, d = qf.shape
     sk = kf.shape[1]
@@ -304,18 +417,23 @@ def _flash_bwd_folded(qf, kf, vf, of, lse, dof, *, causal: bool,
         # gradient rows unwritten) -> auto
         gg = _pick_g(bh, sq, bk, budget=1024 * 1024, cap=2)
     scale = 1.0 / math.sqrt(d)
+    in_specs = [
+        pl.BlockSpec((gg, sq, d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((gg, sk, d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((gg, sk, dv_d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((gg, sq, dv_d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((gg, sq, dv_d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((gg, 1, sq), lambda i: (i, 0, 0)),
+    ]
+    args = (qf, kf, vf, dof, of, lse)
+    if dropout > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args = args + (jnp.asarray(seeds, jnp.uint32),)
     dq, dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_kernel, causal=causal, scale=scale,
-                          g=gg, bk=bk),
+                          g=gg, bk=bk, dropout=dropout),
         grid=(bh // gg,),
-        in_specs=[
-            pl.BlockSpec((gg, sq, d), lambda i: (i, 0, 0)),
-            pl.BlockSpec((gg, sk, d), lambda i: (i, 0, 0)),
-            pl.BlockSpec((gg, sk, dv_d), lambda i: (i, 0, 0)),
-            pl.BlockSpec((gg, sq, dv_d), lambda i: (i, 0, 0)),
-            pl.BlockSpec((gg, sq, dv_d), lambda i: (i, 0, 0)),
-            pl.BlockSpec((gg, 1, sq), lambda i: (i, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((gg, sq, d), lambda i: (i, 0, 0)),
             pl.BlockSpec((gg, sk, d), lambda i: (i, 0, 0)),
@@ -327,82 +445,78 @@ def _flash_bwd_folded(qf, kf, vf, of, lse, dof, *, causal: bool,
             jax.ShapeDtypeStruct((bh, sk, dv_d), vf.dtype),
         ],
         interpret=interpret,
-    )(qf, kf, vf, dof, of, lse)
+    )(*args)
     return dq, dk, dv
 
 
-def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, block_k: int,
-               interpret: bool):
-    b, _, h, _ = q.shape
-    dq, dk, dv = _flash_bwd_folded(
-        _bhsd_to_fold(q), _bhsd_to_fold(k), _bhsd_to_fold(v),
-        _bhsd_to_fold(out), lse, _bhsd_to_fold(g),
-        causal=causal, interpret=interpret,
-    )
-    return (_fold_to_bhsd(dq, b, h), _fold_to_bhsd(dk, b, h),
-            _fold_to_bhsd(dv, b, h))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_folded_core(qf, kf, vf, seeds, causal, interpret, dropout):
+    out, _ = _flash_fwd_folded(qf, kf, vf, causal=causal,
+                               interpret=interpret, dropout=dropout,
+                               seeds=seeds)
+    return out
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_folded_vjp_fwd(qf, kf, vf, seeds, causal, interpret, dropout):
+    out, lse = _flash_fwd_folded(qf, kf, vf, causal=causal,
+                                 interpret=interpret, dropout=dropout,
+                                 seeds=seeds)
+    return out, (qf, kf, vf, out, lse, seeds)
+
+
+def _flash_folded_vjp_bwd(causal, interpret, dropout, res, g):
+    qf, kf, vf, out, lse, seeds = res
+    dq, dk, dv = _flash_bwd_folded(qf, kf, vf, out, lse, g, causal=causal,
+                                   interpret=interpret, dropout=dropout,
+                                   seeds=seeds)
+    return dq, dk, dv, None  # seeds are integral: no cotangent
+
+
+_flash_folded_core.defvjp(_flash_folded_vjp_fwd, _flash_folded_vjp_bwd)
+
+
 def flash_attention_folded(qf, kf, vf, causal: bool = False,
-                           interpret: bool = False):
+                           interpret: bool = False, *,
+                           dropout: float = 0.0, seeds=None):
     """flash_attention on PRE-FOLDED (batch*heads, seq, head_dim)
     operands. The MHA op's fast path projects q/k/v straight into this
     layout (einsum "bse,ehd->bhsd" + free reshape), so the per-layer
-    fold/unfold transposes of the bshd wrapper never materialize."""
+    fold/unfold transposes of the bshd wrapper never materialize.
+
+    dropout/seeds thread attention dropout INTO the kernels: the
+    counter-based keep-mask (attention_dropout_mask with these `seeds`,
+    two uint32s from dropout_seeds(rng)) is regenerated per VMEM tile in
+    the forward and the backward, so dropout no longer forces the
+    dense-materialized path."""
     assert flash_supported(qf.shape[1], kf.shape[1]), (
         "sequence too long for the fused VMEM tile — use chunked_attention "
         "or ring_attention"
     )
-    out, _ = _flash_fwd_folded(qf, kf, vf, causal=causal,
-                               interpret=interpret)
-    return out
+    dropout = float(dropout)
+    if dropout > 0.0 and seeds is None:
+        raise ValueError("flash dropout needs seeds (dropout_seeds(rng))")
+    if seeds is None:
+        seeds = jnp.zeros((2,), jnp.uint32)
+    return _flash_folded_core(qf, kf, vf, seeds, causal, interpret, dropout)
 
 
-def _flash_folded_vjp_fwd(qf, kf, vf, causal, interpret):
-    out, lse = _flash_fwd_folded(qf, kf, vf, causal=causal,
-                                 interpret=interpret)
-    return out, (qf, kf, vf, out, lse)
-
-
-def _flash_folded_vjp_bwd(causal, interpret, res, g):
-    qf, kf, vf, out, lse = res
-    return _flash_bwd_folded(qf, kf, vf, out, lse, g, causal=causal,
-                             interpret=interpret)
-
-
-flash_attention_folded.defvjp(_flash_folded_vjp_fwd, _flash_folded_vjp_bwd)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
-                    block_k: int = 256, interpret: bool = False):
+                    block_k: int = 256, interpret: bool = False, *,
+                    dropout: float = 0.0, seeds=None):
     """Fused Pallas attention: forward AND backward keep scores/probs in
     VMEM (the backward recomputes the prob tile from the saved per-row
     log-sum-exp — the standard flash-attention scheme) and batch several
     (batch*head) rows per program (_pick_g). Requires
     flash_supported(seq_q, seq_k); block_q/block_k are accepted for
-    signature stability but rows are processed as whole tiles."""
-    assert flash_supported(q.shape[1], k.shape[1]), (
-        "sequence too long for the fused VMEM tile — use chunked_attention "
-        "or ring_attention"
+    signature stability but rows are processed as whole tiles. Routes
+    through the folded core, so gradients and RNG-threaded dropout
+    (dropout/seeds) behave identically to flash_attention_folded."""
+    b, _, h, _ = q.shape
+    out = flash_attention_folded(
+        _bhsd_to_fold(q), _bhsd_to_fold(k), _bhsd_to_fold(v),
+        causal=causal, interpret=interpret, dropout=dropout, seeds=seeds,
     )
-    out, _ = _flash_fwd(q, k, v, causal=causal, interpret=interpret)
-    return out
-
-
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal=causal, interpret=interpret)
-    return out, (q, k, v, out, lse)
-
-
-def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, g, causal=causal,
-                      block_k=block_k, interpret=interpret)
-
-
-flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+    return _fold_to_bhsd(out, b, h)
 
 
 def local_attention(q, k, v, *, causal: bool = False,

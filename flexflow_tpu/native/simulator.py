@@ -59,7 +59,10 @@ class NativeSimulator:
                 extra = cost_model.parallel_op_cost(op) if op.is_parallel_op else 0.0
                 fwd.append(cm.forward_time + extra)
                 bwd.append(cm.backward_time + extra)
-                sync.append(cm.sync_time)
+                # exposed sync only: under the cost model's overlap
+                # discount the hidden share rides behind backward
+                # compute, so the native annealer must not re-charge it
+                sync.append(max(0.0, cm.sync_time - cm.hidden_sync_time))
             view_off.append(len(view_ids))
 
         def arr_i64(x):

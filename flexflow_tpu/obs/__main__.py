@@ -4,11 +4,17 @@ Usage:
     python -m flexflow_tpu.obs trace   <events.jsonl> [-o trace.json]
     python -m flexflow_tpu.obs summary <events.jsonl>
     python -m flexflow_tpu.obs prom    <metrics.jsonl> [-o metrics.prom]
+    python -m flexflow_tpu.obs explain [--top N] [model shape flags]
 
 ``trace`` converts a structured event log to Chrome-trace JSON (open at
 https://ui.perfetto.dev). ``summary`` schema-validates the log and
 prints per-category/event counts plus step/search aggregates.
 ``prom`` re-renders the last metrics.jsonl snapshot as Prometheus text.
+``explain`` compiles the benchmark Transformer (CPU-sized by default;
+pass --seq/--hidden/... for the real bench shape on a TPU host), joins
+the cost model against on-device profile_ops measurements and prints
+the miscalibrated-op kernel worklist — each perf round starts from this
+list (docs/performance.md).
 
 This module is a CLI entry point: bare print() is its job (fflint FFL201
 allowlists __main__ modules).
@@ -93,6 +99,45 @@ def _cmd_prom(args) -> int:
     return 0
 
 
+def _cmd_explain(args) -> int:
+    from .. import (
+        FFConfig,
+        FFModel,
+        LossType,
+        MetricsType,
+        SGDOptimizer,
+    )
+    from ..models.transformer import build_transformer
+    from .explain import explain_strategy
+
+    cfg = FFConfig()
+    cfg.batch_size = args.batch
+    cfg.allow_mixed_precision = args.bf16
+    model = FFModel(cfg)
+    build_transformer(
+        model, batch_size=args.batch, seq_length=args.seq,
+        hidden_size=args.hidden, num_heads=args.heads,
+        num_layers=args.layers,
+    )
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR],
+    )
+    exp = explain_strategy(model, repeats=args.repeats)
+    print(exp.summary(args.top))
+    print(f"kernel worklist (top {args.top} by |simulated - measured|):")
+    for w in exp.worklist(args.top):
+        verdict = ("cost model optimistic — fuse/speed up this kernel"
+                   if w["ratio"] > 1.0 else
+                   "cost model pessimistic — recalibrate this class")
+        print(f"  #{w['rank']} {w['name']} [{w['op_type']}] "
+              f"meas {w['meas_total_s'] * 1e3:.4f} ms vs "
+              f"sim {w['sim_total_s'] * 1e3:.4f} ms "
+              f"(x{w['ratio']:.2f}) — {verdict}")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m flexflow_tpu.obs",
@@ -107,9 +152,22 @@ def main(argv=None) -> int:
     m = sub.add_parser("prom", help="metrics.jsonl -> Prometheus text")
     m.add_argument("metrics")
     m.add_argument("-o", "--output")
+    e = sub.add_parser(
+        "explain",
+        help="print the miscalibrated-op kernel worklist for the "
+             "benchmark Transformer on this host's device",
+    )
+    e.add_argument("--top", type=int, default=3)
+    e.add_argument("--batch", type=int, default=2)
+    e.add_argument("--seq", type=int, default=64)
+    e.add_argument("--hidden", type=int, default=128)
+    e.add_argument("--heads", type=int, default=4)
+    e.add_argument("--layers", type=int, default=2)
+    e.add_argument("--repeats", type=int, default=1)
+    e.add_argument("--bf16", action="store_true")
     args = p.parse_args(argv)
     return {"trace": _cmd_trace, "summary": _cmd_summary,
-            "prom": _cmd_prom}[args.cmd](args)
+            "prom": _cmd_prom, "explain": _cmd_explain}[args.cmd](args)
 
 
 if __name__ == "__main__":

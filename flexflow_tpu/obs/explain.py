@@ -59,6 +59,22 @@ class StrategyExplanation:
     def top(self, n: int = 10) -> List[dict]:
         return self.rows[:n]
 
+    def worklist(self, n: int = 3) -> List[dict]:
+        """The per-round kernel worklist: the n most miscalibrated ops,
+        each a {rank, name, op_type, sim_total_s, meas_total_s, ratio}
+        record. This is where a perf round starts (ROADMAP item 1 /
+        docs/performance.md): the top entries are either kernels worth
+        fusing (measured ≫ simulated) or cost-model entries worth
+        recalibrating (simulated ≫ measured) — e.g. the overlap
+        discount's overlap_efficiency when collective-adjacent ops rank
+        high."""
+        return [
+            {"rank": i + 1, "name": r["name"], "op_type": r["op_type"],
+             "sim_total_s": r["sim_total_s"],
+             "meas_total_s": r["meas_total_s"], "ratio": r["ratio"]}
+            for i, r in enumerate(self.rows[:n])
+        ]
+
     def most_miscalibrated(self) -> Optional[dict]:
         return self.rows[0] if self.rows else None
 

@@ -27,6 +27,46 @@ from ..ff_types import DataType, OperatorType
 from .registry import WeightSpec, register_op
 
 
+# Dropout-fallback bookkeeping: the "dropout forces the dense path" warning
+# used to fire on EVERY traced forward (once per layer per trace — dozens of
+# identical lines per compile). Now each distinct (impl, layer, reason)
+# warns once per process, and every occurrence is counted in the
+# ff_attention_fallback_total{reason=...} metric instead (obs.count — a
+# no-op without an active telemetry session).
+_FALLBACK_WARNED: set = set()
+
+
+def reset_attention_fallback_warnings() -> None:
+    """Forget which (impl, layer, reason) fallbacks already warned
+    (tests; a fresh process starts empty)."""
+    _FALLBACK_WARNED.clear()
+
+
+def _dropout_fallback(impl: str, op_name: str, reason: str) -> None:
+    from .. import obs
+
+    obs.count("ff_attention_fallback_total",
+              help="attention ops that fell back to the dense path",
+              reason=reason)
+    key = (impl, op_name, reason)
+    if key in _FALLBACK_WARNED:
+        return
+    _FALLBACK_WARNED.add(key)
+    detail = {
+        "kernel": f"FF_ATTENTION_IMPL={impl} does not thread the dropout "
+                  "rng (only the fused flash kernels do)",
+        "mesh": "the flash dropout kernel runs device-local; sharded "
+                "meshes keep the dense path",
+        "backend": "the fused Pallas kernel needs the TPU backend",
+        "seq": "the sequence exceeds the fused kernel's VMEM tile",
+    }[reason]
+    warnings.warn(
+        f"attention dropout on {op_name or 'a MHA op'} "
+        f"(FF_ATTENTION_IMPL={impl}) falls back to the dense path: "
+        f"{detail}"
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class MultiHeadAttentionParams:
     """reference: include/flexflow/ops/attention_params.h"""
@@ -113,12 +153,35 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
             f"FF_ATTENTION_IMPL={impl!r}: "
             "expected auto|dense|flash|chunked|ring|ulysses"
         )
-    if impl in ("flash", "chunked", "ring", "ulysses") and use_dropout:
-        warnings.warn(
-            f"FF_ATTENTION_IMPL={impl} ignored: attention dropout needs the "
-            "dense path (streaming kernels don't thread the dropout rng)"
-        )
     from ..kernels.attention import flash_supported
+
+    # RNG-threaded flash dropout: the fused Pallas kernels regenerate a
+    # counter-based keep-mask per VMEM tile (kernels/attention.py), so
+    # dropout > 0 no longer forces the dense-materialized path wherever
+    # the fused kernel is eligible. The other streaming kernels
+    # (chunked/ring/ulysses) and sharded meshes still fall back to dense
+    # — warn once per (impl, layer, reason), count every occurrence.
+    flash_dropout_ok = (
+        use_dropout
+        and impl in ("auto", "flash")
+        and jax.default_backend() == "tpu"
+        and flash_supported(seq_len, kv_len)
+        and data_degree * model_degree * seq_degree == 1
+    )
+    if use_dropout and not flash_dropout_ok:
+        if impl in ("chunked", "ring", "ulysses"):
+            _dropout_fallback(impl, ctx.op_name, "kernel")
+        elif impl == "flash" or (
+                impl == "auto"
+                and (jax.default_backend() == "tpu"
+                     or score_bytes > 256 * 1024 * 1024)):
+            # without dropout this call would have streamed
+            if jax.default_backend() != "tpu":
+                _dropout_fallback(impl, ctx.op_name, "backend")
+            elif not flash_supported(seq_len, kv_len):
+                _dropout_fallback(impl, ctx.op_name, "seq")
+            else:
+                _dropout_fallback(impl, ctx.op_name, "mesh")
 
     # Single-chip/unsharded fast path: project q/k/v straight into the
     # kernel's folded (b*h, s, d) layout — the head transpose rides the
@@ -126,10 +189,10 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
     # round-trip each way (fold + unfold, fwd and bwd).
     if (impl in ("auto", "flash")
             and jax.default_backend() == "tpu"
-            and not use_dropout
+            and (not use_dropout or flash_dropout_ok)
             and flash_supported(seq_len, kv_len)
             and data_degree * model_degree * seq_degree == 1):
-        from ..kernels.attention import flash_attention_folded
+        from ..kernels.attention import dropout_seeds, flash_attention_folded
 
         dqk, dv = params.qk_head_dim, params.v_head_dim
         qf = jnp.einsum("bse,ehd->bhsd", q_in, wq,
@@ -141,7 +204,11 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
         qf = qf.astype(q_in.dtype).reshape(b * h, seq_len, dqk)
         kf = kf.astype(q_in.dtype).reshape(b * h, kv_len, dqk)
         vf = vf.astype(q_in.dtype).reshape(b * h, kv_len, dv)
-        attn = flash_attention_folded(qf, kf, vf, params.causal)
+        attn = flash_attention_folded(
+            qf, kf, vf, params.causal,
+            dropout=params.dropout if use_dropout else 0.0,
+            seeds=dropout_seeds(ctx.rng) if use_dropout else None,
+        )
         out = jnp.einsum(
             "bhsd,hde->bse", attn.reshape(b, h, seq_len, dv), wo,
             preferred_element_type=jnp.float32,
@@ -309,9 +376,24 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
             scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         if use_dropout:
-            keep = 1.0 - params.dropout
-            mask = jax.random.bernoulli(ctx.rng, keep, probs.shape)
-            probs = jnp.where(mask, probs / keep, 0).astype(probs.dtype)
+            # same counter-based mask the flash kernels regenerate
+            # blockwise in VMEM — the two paths draw IDENTICAL masks from
+            # the same rng, so flash-with-dropout is testable against
+            # dense-with-dropout (and switching paths between compiles
+            # doesn't change the dropout stream)
+            from ..kernels.attention import (
+                attention_dropout_mask,
+                dropout_seeds,
+            )
+
+            keep = attention_dropout_mask(
+                dropout_seeds(ctx.rng), params.dropout,
+                probs.shape[0] * probs.shape[1],
+                probs.shape[2], probs.shape[3],
+            ).reshape(probs.shape)
+            probs = jnp.where(
+                keep, probs * (1.0 / (1.0 - params.dropout)), 0
+            ).astype(probs.dtype)
         attn = jnp.einsum(
             "bhst,bthd->bshd", probs, v, preferred_element_type=jnp.float32
         )

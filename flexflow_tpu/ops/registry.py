@@ -138,6 +138,10 @@ class FwdCtx:
     # The executing jax.sharding.Mesh, for ops that drop into shard_map
     # (pipeline block stack, ring attention).
     mesh: Optional[object] = None
+    # The PCG op's name, for per-layer diagnostics (the attention
+    # fallback warn-once/metric keys on it). "" when the caller has no
+    # layer identity (raw op-def invocations in tests).
+    op_name: str = ""
 
     def add_aux_loss(self, value):
         if self.aux_losses is not None:

@@ -137,6 +137,7 @@ class PCGExecutor:
         remat: bool = False,
         constants: Optional[Dict] = None,
         plan_cost_model=None,
+        overlap_grad_sync: bool = False,
     ):
         self.graph = graph
         self.mesh = mesh
@@ -171,6 +172,13 @@ class PCGExecutor:
         outs = graph.output_tensors()
         assert outs, "graph has no output tensor"
         self.logits_pt = outs[-1]
+        # Comm/compute-overlapped gradient sync (the reference's
+        # overlap_backward_update, config.h:133): decompose the implicit
+        # data-parallel grad all-reduce into per-weight reduce-scatter +
+        # sharded optimizer update + all-gather of the updated params
+        # (set_overlap_grad_sync / config.overlap_backward_update).
+        self.overlap_grad_sync = overlap_grad_sync
+        self._overlap_spec_cache = None
         # NaN/Inf step guard (runtime/resilience.py StepGuardConfig);
         # None = unguarded step (the default). Changing it invalidates
         # the cached train step (set_step_guard).
@@ -374,6 +382,7 @@ class PCGExecutor:
                         training=training, rng=op_rng, seq_length=-1,
                         compute_dtype=self.compute_dtype, aux_losses=None,
                         n_devices=1, mesh=None,  # device-local inside shard_map
+                        op_name=op.name,
                     )
                     outs = d.forward(
                         op.params, params.get(op.name, {}), ins, ctx
@@ -469,6 +478,9 @@ class PCGExecutor:
     def init_state(self) -> TrainState:
         params = self.init_params()
         opt_state = self.optimizer.init_state(params)
+        # overlapped grad sync stores optimizer state sharded over the
+        # data axis (ZeRO-1): the sharded update then never gathers it
+        opt_state = self._place_opt_state_sharded(opt_state)
         return TrainState(params=params, opt_state=opt_state,
                           net_state=self.init_net_state())
 
@@ -543,6 +555,7 @@ class PCGExecutor:
                     aux_losses=aux_out,
                     n_devices=self.mesh.size,
                     mesh=self.mesh,
+                    op_name=op.name,
                 )
                 w = params.get(op.name, {})
                 if training and self.remat and op.op_type in _REMAT_OPS:
@@ -672,6 +685,135 @@ class PCGExecutor:
             self._train_step_nodonate = None
             self._train_scan = None
 
+    # -- comm/compute-overlapped gradient sync ------------------------------
+    def set_overlap_grad_sync(self, flag: bool) -> None:
+        """Enable/disable the reduce-scatter + sharded-update + all-gather
+        step decomposition. Traced into the step program, so a change
+        invalidates the cached train steps (like set_step_guard)."""
+        flag = bool(flag)
+        if flag != self.overlap_grad_sync:
+            self.overlap_grad_sync = flag
+            self._overlap_spec_cache = None
+            self._train_step = None
+            self._train_step_nodonate = None
+            self._train_scan = None
+
+    def _overlap_specs(self) -> Dict:
+        """(op name, weight name) -> (data-sharded, canonical) NamedSharding
+        for every weight eligible for the overlapped update.
+
+        The transform: constrain the weight's GRADIENT to a spec that
+        additionally shards one replicated dim over the "data" axis — the
+        XLA partitioner then lowers the pending cross-replica psum as a
+        reduce-scatter instead of an all-reduce — run the (elementwise)
+        optimizer update on the owned 1/d shard, and constrain the new
+        param back to its canonical spec (an all-gather of UPDATED
+        values). Wire bytes match the all-reduce exactly (RS + AG ==
+        2(d-1)/d), but each weight's reduce-scatter depends only on that
+        weight's gradient, so XLA's async-collective scheduler can
+        overlap layer i's collective with layer i-1's backward matmuls —
+        the reference's overlap_backward_update (config.h:133), with the
+        optimizer state sharded ZeRO-1 style as a bonus (it never needs
+        gathering; see init_state).
+
+        Ineligible (left on the plain all-reduce path): weights already
+        touching the data or fsdp axes (FSDP reduce-scatters on its own),
+        and weights with no dim divisible by the data-axis size."""
+        if self._overlap_spec_cache is not None:
+            return self._overlap_spec_cache
+        out: Dict = {}
+        dsize = self.mesh.shape.get("data", 1) if self.mesh is not None else 1
+        if not self.overlap_grad_sync or dsize <= 1:
+            self._overlap_spec_cache = out
+            return out
+        for op in self.topo:
+            for wname, wpt in zip(op.weight_names, op.weights):
+                shape = tuple(wpt.material_shape())
+                spec = list(pspec_for_parallel_tensor(wpt, self.mesh))
+                spec += [None] * (len(shape) - len(spec))
+                flat = set()
+                for e in spec:
+                    if isinstance(e, (tuple, list)):
+                        flat.update(e)
+                    elif e is not None:
+                        flat.add(e)
+                if "data" in flat or "fsdp" in flat:
+                    continue
+                for di, size in enumerate(shape):
+                    if spec[di] is None and size >= dsize \
+                            and size % dsize == 0:
+                        sharded = list(spec)
+                        sharded[di] = "data"
+                        out[(op.name, wname)] = (
+                            NamedSharding(self.mesh,
+                                          PartitionSpec(*sharded)),
+                            NamedSharding(self.mesh, PartitionSpec(*spec)),
+                        )
+                        break
+        self._overlap_spec_cache = out
+        return out
+
+    def _constrain_weight_tree(self, tree, omap, *, sharded: bool):
+        """Apply the overlap shardings to a params-shaped
+        {op: {weight: array}} tree (grads, params, or updated params)."""
+        if not omap:
+            return tree
+        idx = 0 if sharded else 1
+        return {
+            op: {
+                w: (jax.lax.with_sharding_constraint(v, omap[(op, w)][idx])
+                    if (op, w) in omap and v is not None else v)
+                for w, v in d.items()
+            }
+            for op, d in tree.items()
+        }
+
+    def _constrain_opt_state(self, tree, omap):
+        """Constrain weight-shaped optimizer-state leaves to the sharded
+        spec of the weight they mirror (identified by the leaf's trailing
+        (op name, weight name) dict path — SGD's {"v": params-like},
+        Adam's {"m"/"v": params-like}; scalars pass through)."""
+        if not omap:
+            return tree
+
+        def f(path, leaf):
+            if leaf is None or not hasattr(leaf, "shape"):
+                return leaf
+            keys = [p.key for p in path
+                    if isinstance(p, jax.tree_util.DictKey)]
+            if len(keys) >= 2 and (keys[-2], keys[-1]) in omap:
+                return jax.lax.with_sharding_constraint(
+                    leaf, omap[(keys[-2], keys[-1])][0]
+                )
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(
+            f, tree, is_leaf=lambda x: x is None
+        )
+
+    def _place_opt_state_sharded(self, opt_state):
+        """Host-side placement of fresh optimizer state on the overlap
+        shardings: the sharded update reads and writes 1/d-sized state
+        shards, so the state LIVES sharded across steps (ZeRO-1) — no
+        all-gather of m/v ever happens, and opt-state HBM divides by the
+        data degree. Checkpointing host-gathers shards transparently."""
+        omap = self._overlap_specs()
+        if not omap:
+            return opt_state
+
+        def f(path, leaf):
+            if leaf is None or not hasattr(leaf, "shape"):
+                return leaf
+            keys = [p.key for p in path
+                    if isinstance(p, jax.tree_util.DictKey)]
+            if len(keys) >= 2 and (keys[-2], keys[-1]) in omap:
+                return jax.device_put(leaf, omap[(keys[-2], keys[-1])][0])
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(
+            f, opt_state, is_leaf=lambda x: x is None
+        )
+
     def init_guard_state(self) -> GuardState:
         assert self.step_guard is not None, "set_step_guard() first"
         cfg = self.step_guard
@@ -684,6 +826,8 @@ class PCGExecutor:
 
     def _make_step(self):
         guard = self.step_guard
+        # overlap shardings are trace-time constants of the step program
+        omap = self._overlap_specs()
 
         def step(state: TrainState, batch_inputs, labels, rng, *extra):
             def loss_of(params):
@@ -709,12 +853,35 @@ class PCGExecutor:
                 loss_of, has_aux=True
             )(state.params)
             grads = self._cast_grads(grads)
+            if omap:
+                # overlapped grad sync: pin each eligible gradient to a
+                # data-sharded layout, turning the pending cross-replica
+                # psum into a per-weight reduce-scatter. Each weight's
+                # collective depends only on that weight's gradient, so
+                # the async-collective scheduler hides layer i's ICI
+                # traffic behind layer i-1's backward matmuls. The guard
+                # norm and the optimizer update below then run on the
+                # owned 1/d shards (partial norms psum to one scalar —
+                # no second full-tree traversal), and only the UPDATED
+                # params all-gather back (see _overlap_specs).
+                grads = self._constrain_weight_tree(grads, omap,
+                                                    sharded=True)
+            upd_src_params = (
+                self._constrain_weight_tree(state.params, omap,
+                                            sharded=True)
+                if omap else state.params
+            )
             new_net = dict(state.net_state)
             new_net.update(net_out)
             if guard is None:
                 new_params, new_opt = self.optimizer.update(
-                    state.params, grads, state.opt_state
+                    upd_src_params, grads, state.opt_state
                 )
+                if omap:
+                    new_params = self._constrain_weight_tree(
+                        new_params, omap, sharded=False
+                    )
+                    new_opt = self._constrain_opt_state(new_opt, omap)
                 new_guard = state.guard
                 partials = self.metrics.compute(logits, labels)
                 partials["loss"] = loss
@@ -732,16 +899,25 @@ class PCGExecutor:
                     lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype),
                     grads,
                 )
+                # under overlap the grads are data-sharded here, so this
+                # is a per-shard partial sum-of-squares + one scalar psum
+                # — the guard's old extra full-tree traversal is gone
                 gnorm = global_grad_norm(grads)
                 finite = jnp.isfinite(gnorm)
                 upd_params, upd_opt = self.optimizer.update(
-                    state.params, grads, state.opt_state
+                    upd_src_params, grads, state.opt_state
                 )
                 # a skipped step carries params AND opt state through
                 # unchanged — momentum/bias-correction must not advance
                 # on a discarded gradient
-                new_params = _tree_select(finite, upd_params, state.params)
+                new_params = _tree_select(finite, upd_params,
+                                          upd_src_params)
                 new_opt = _tree_select(finite, upd_opt, state.opt_state)
+                if omap:
+                    new_params = self._constrain_weight_tree(
+                        new_params, omap, sharded=False
+                    )
+                    new_opt = self._constrain_opt_state(new_opt, omap)
                 g = state.guard
                 cap = jnp.asarray(
                     guard.max_loss_scale

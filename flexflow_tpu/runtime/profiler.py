@@ -142,15 +142,26 @@ def profile_ops(
 
 
 def simulated_timeline_events(graph, views, cost_model,
-                              *, backward: bool = False) -> List[dict]:
+                              *, backward: bool = False,
+                              overlap_sync: bool = False) -> List[dict]:
     """The simulated schedule as obs-tracer events (the schema
     obs/tracer.py documents: ts/dur in seconds, cat "simulated", tid =
     device id) — export with obs.to_chrome_trace, or merge with a
-    measured events.jsonl to overlay simulation against reality."""
+    measured events.jsonl to overlay simulation against reality.
+
+    overlap_sync=True additionally lays out the BACKWARD pass (reverse
+    topo order after the forward makespan) with each statically
+    overlappable weight-grad collective (analysis/collectives.
+    overlappable_grad_syncs) as its own span on a dedicated comm-channel
+    tid, concurrent with later backward compute spans — open the export
+    in Perfetto and the collective/compute overlap the overlapped
+    executor schedules is directly visible as parallel tracks."""
     events: List[dict] = []
     dev_free: Dict[int, float] = {}
     ready: Dict[int, float] = {}
-    for op in graph.topo_order():
+    fwd_span: Dict[int, float] = {}
+    topo = graph.topo_order()
+    for op in topo:
         view = views[op.guid]
         cm = cost_model.measure_operator_cost(op, view)
         lb = max(
@@ -158,7 +169,9 @@ def simulated_timeline_events(graph, views, cost_model,
         )
         ids = view.device_ids()
         start = max([lb] + [dev_free.get(d, 0.0) for d in ids])
-        dur = cm.forward_time + (cm.backward_time if backward else 0.0)
+        dur = cm.forward_time + (
+            cm.backward_time if backward and not overlap_sync else 0.0
+        )
         end = start + dur
         for d in ids:
             dev_free[d] = end
@@ -178,20 +191,70 @@ def simulated_timeline_events(graph, views, cost_model,
             })
         for t in op.outputs:
             ready[t.guid] = end
+        fwd_span[op.guid] = end
+    if not overlap_sync:
+        return events
+    from ..analysis.collectives import overlappable_grad_syncs
+
+    overlappable = overlappable_grad_syncs(graph)
+    comm_tid = max(dev_free, default=0) + 1
+    comm_free = 0.0
+    cursor = max(dev_free.values()) if dev_free else 0.0
+    for op in reversed(topo):
+        view = views[op.guid]
+        cm = cost_model.measure_operator_cost(op, view)
+        start = cursor
+        end = start + cm.backward_time
+        cursor = end
+        for d in view.device_ids():
+            events.append({
+                "ts": start, "ph": "X", "name": f"{op.name}.bwd",
+                "cat": "simulated", "dur": cm.backward_time, "tid": d,
+                "args": {"op_type": op.op_type.name, "pass": "backward"},
+            })
+        if cm.sync_time <= 0:
+            continue
+        if op.guid in overlappable:
+            # the collective rides the comm channel while later backward
+            # spans keep the devices busy — the overlap evidence
+            s = max(comm_free, end)
+            comm_free = s + cm.sync_time
+            events.append({
+                "ts": s, "ph": "X", "name": f"{op.name}.grad_sync",
+                "cat": "simulated", "dur": cm.sync_time, "tid": comm_tid,
+                "args": {"op_type": op.op_type.name,
+                         "collective": "reduce_scatter+all_gather",
+                         "overlapped": True},
+            })
+        else:
+            for d in view.device_ids():
+                events.append({
+                    "ts": cursor, "ph": "X",
+                    "name": f"{op.name}.grad_sync", "cat": "simulated",
+                    "dur": cm.sync_time, "tid": d,
+                    "args": {"op_type": op.op_type.name,
+                             "collective": "all_reduce",
+                             "overlapped": False},
+                })
+            cursor += cm.sync_time
     return events
 
 
-def export_simulated_timeline(graph, views, cost_model, path: str) -> None:
+def export_simulated_timeline(graph, views, cost_model, path: str, *,
+                              overlap_sync: bool = False) -> None:
     """Export the simulated schedule as Chrome trace JSON (reference:
     Simulator::simulate_runtime's export_file_name, simulator.h:724),
     in the SAME schema as the runtime tracer's trace.json (categories as
     named processes, devices as tids) so both load into one Perfetto
-    session and overlay."""
+    session and overlay. overlap_sync=True adds the backward pass with
+    overlappable collectives on a comm-channel track (see
+    simulated_timeline_events / docs/performance.md)."""
     from ..obs.tracer import to_chrome_trace
 
     with open(path, "w") as f:
         json.dump(
-            to_chrome_trace(simulated_timeline_events(graph, views,
-                                                      cost_model)),
+            to_chrome_trace(simulated_timeline_events(
+                graph, views, cost_model, overlap_sync=overlap_sync,
+            )),
             f,
         )

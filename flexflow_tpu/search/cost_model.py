@@ -34,10 +34,15 @@ class CostMetrics:
     inputs_memory: int = 0
     outputs_memory: int = 0
     weights_memory: int = 0
+    # seconds of sync_time the overlapped schedule hides behind backward
+    # compute (0 unless the cost model runs with overlap_backward_update;
+    # never exceeds sync_time, so total_time is never below fwd + bwd)
+    hidden_sync_time: float = 0.0
 
     @property
     def total_time(self) -> float:
-        return self.forward_time + self.backward_time + self.sync_time
+        exposed = max(0.0, self.sync_time - self.hidden_sync_time)
+        return self.forward_time + self.backward_time + exposed
 
     @property
     def total_memory(self) -> int:
@@ -189,6 +194,10 @@ def validate_calibration(cal: dict) -> dict:
 
     if not isinstance(cal, dict):
         raise ValueError(f"calibration must be a dict, got {type(cal)}")
+    # fraction of an overlappable collective that actually hides behind
+    # backward compute on silicon (the overlap discount's calibration
+    # knob — tuned from the explain-worklist loop, docs/performance.md)
+    check_eff("overlap_efficiency", cal.get("overlap_efficiency"))
     op_class = cal.get("op_class", {})
     if not isinstance(op_class, dict):
         raise ValueError("calibration op_class must be a dict")
@@ -248,9 +257,22 @@ class CostModel:
     say otherwise."""
 
     def __init__(self, machine: MachineModel, *, bf16: bool = True,
-                 calibration=None):
+                 calibration=None, overlap_backward_update: bool = False,
+                 overlap_efficiency: Optional[float] = None):
         self.machine = machine
         self.bf16 = bf16
+        # "overlappable" discount (config.search_overlap_backward_update):
+        # a weight-gradient sync collective is statically independent of
+        # the backward critical path — the gradient it reduces feeds ONLY
+        # the optimizer update, and every topologically-earlier op's
+        # backward cannot read it (analysis/collectives.
+        # overlappable_grad_syncs is the graph-level proof) — so the
+        # overlapped executor hides it behind dependent backward matmuls
+        # and the search should price only the EXPOSED remainder:
+        # max(0, sync - overlap_efficiency * backward). Explicit parallel
+        # ops (Repartition/Combine/...) sit on the activation path and
+        # keep their full price.
+        self.overlap_backward_update = overlap_backward_update
         if calibration is None:
             calibration = load_default_calibration()
         elif calibration is False:
@@ -263,6 +285,11 @@ class CostModel:
         elif isinstance(calibration, dict):
             validate_calibration(calibration)
         self.calibration = calibration
+        if overlap_efficiency is None:
+            overlap_efficiency = (calibration or {}).get(
+                "overlap_efficiency", 1.0
+            )
+        self.overlap_efficiency = float(overlap_efficiency)
         self._cache: Dict[Tuple, CostMetrics] = {}
         self._xfer_cache: Dict[Tuple, float] = {}
         # measured-mode overrides: key -> (fwd, bwd) seconds
@@ -377,6 +404,16 @@ class CostModel:
                 if replicas > 1:
                     group = ids[::w_deg][:replicas]
                     sync += self.machine.allreduce_cost(w_bytes / w_deg, group)
+        hidden = 0.0
+        if sync > 0.0 and self.overlap_backward_update:
+            # overlappable discount: the exposed sync is what the comm
+            # channel can't hide behind this op's share of backward
+            # compute (the machine model owns the overlap seam so
+            # topology-aware models can refine it)
+            exposed = self.machine.exposed_comm_time(
+                sync, bwd, self.overlap_efficiency
+            )
+            hidden = sync - exposed
         # Per-device weight bytes divide by the weight's OWN shard degree,
         # never by the view's part count: a replicated weight under a
         # data-parallel view lives in FULL on every replica (dividing by
@@ -396,6 +433,7 @@ class CostModel:
             forward_time=fwd,
             backward_time=bwd,
             sync_time=sync,
+            hidden_sync_time=hidden,
             inputs_memory=int(
                 sum(_vol(t.material_shape()) * t.data_type.size for t in op.inputs)
                 / parts

@@ -146,6 +146,23 @@ class MachineModel:
         return (num_bytes * (n - 1) / n / self.ici_bandwidth
                 + (n - 1) * self.ici_latency)
 
+    def exposed_comm_time(self, comm_s: float, hideable_compute_s: float,
+                          efficiency: float = 1.0) -> float:
+        """Comm time left on the critical path when a collective may run
+        concurrently with `hideable_compute_s` of independent compute
+        (the overlap-discount seam, search/cost_model.py): the compute
+        and comm channels progress in parallel, so only
+        max(0, comm - efficiency * compute) is exposed. `efficiency` is
+        the calibrated fraction of the compute window the DMA engines
+        actually fill (1.0 = perfect overlap; ICI transfers on TPU are
+        DMA-driven and steal little compute). Never negative, and never
+        bigger than the additive cost — the two invariants the discount
+        unit tests pin down."""
+        if comm_s <= 0.0:
+            return 0.0
+        eff = min(max(efficiency, 0.0), 1.0)
+        return max(0.0, comm_s - eff * max(0.0, hideable_compute_s))
+
     def compute_cost(
         self, flops: float, mem_bytes: float, dtype_is_bf16: bool = True,
         *, mxu_eff: Optional[float] = None, hbm_eff: Optional[float] = None,

@@ -27,7 +27,7 @@ def simulate_runtime(
     views: Dict[int, MachineView],
     cost_model: CostModel,
     *,
-    overlap_backward_update: bool = False,
+    overlap_backward_update: Optional[bool] = None,
 ) -> float:
     """List-schedule fwd+bwd (+weight sync) task graph onto per-device
     timelines (reference: simulator.cc:822 simulate_runtime).
@@ -35,7 +35,20 @@ def simulate_runtime(
     Simplification vs the reference: one task per op per pass covering its
     whole view (per-shard tasks run concurrently on their devices anyway
     under SPMD), comm folded into task start via xfer estimates.
+
+    overlap_backward_update (None = follow the cost model's flag) models
+    the overlapped executor schedule (parallel/executor.py
+    set_overlap_grad_sync): each STATICALLY overlappable weight-grad sync
+    (analysis/collectives.overlappable_grad_syncs) runs on a comm channel
+    concurrent with the compute timeline — it starts when the producing
+    op's backward finishes (and the channel is free; collectives
+    serialize on the wire) and only extends the makespan past the compute
+    end. Non-overlappable syncs stay serial, exactly as executed.
     """
+    if overlap_backward_update is None:
+        overlap_backward_update = getattr(
+            cost_model, "overlap_backward_update", False
+        )
     machine = cost_model.machine
     dev_free: Dict[int, float] = {}
     ready_fwd: Dict[int, float] = {}  # tensor guid -> time available
@@ -91,6 +104,12 @@ def simulate_runtime(
             p = prod.get(t.guid)
             if p is not None:
                 consumers.setdefault(p[0].guid, []).append(op)
+    overlappable: set = set()
+    if overlap_backward_update:
+        from ..analysis.collectives import overlappable_grad_syncs
+
+        overlappable = overlappable_grad_syncs(graph)
+    comm_free = 0.0  # the comm channel: overlapped syncs serialize here
     for op in reversed(topo):
         view = views[op.guid]
         cm = cost_model.measure_operator_cost(op, view)
@@ -113,20 +132,18 @@ def simulate_runtime(
         if op.is_parallel_op:
             dur += cost_model.parallel_op_cost(op)
         end = run_task(view, lb, dur)
-        # weight sync (allreduce) after wgrad unless overlapped
-        if cm.sync_time > 0 and not overlap_backward_update:
-            end = run_task(view, end, cm.sync_time)
+        # weight sync (allreduce) after wgrad: overlappable syncs ride
+        # the comm channel concurrent with later backward tasks; the
+        # rest (and every sync when overlap is off) stay serial
+        if cm.sync_time > 0:
+            if op.guid in overlappable:
+                comm_free = max(comm_free, end) + cm.sync_time
+            else:
+                end = run_task(view, end, cm.sync_time)
         bwd_end[op.guid] = end
 
     total = max(dev_free.values()) if dev_free else 0.0
-    if overlap_backward_update:
-        # overlapped syncs ride behind compute; add the largest single sync
-        total += max(
-            (cost_model.measure_operator_cost(o, views[o.guid]).sync_time
-             for o in topo),
-            default=0.0,
-        )
-    return total
+    return max(total, comm_free)
 
 
 class MCMCSearch:

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Step hot-path perf checks (docs/performance.md): interpret-mode flash
+# kernel parity (incl. RNG-threaded dropout, fwd+bwd), the overlapped
+# reduce-scatter/update/all-gather step's numerical equivalence to the
+# all-reduce step (guarded and unguarded), and the cost model's
+# overlappable-collective discount invariants — swept over 8- and
+# 4-device CPU meshes so the data-degree-dependent paths are exercised
+# at two shard counts. CI wires this into the lint workflow.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+for ndev in 8 4; do
+    echo "perf_check: JAX_NUM_CPU_DEVICES=$ndev"
+    JAX_NUM_CPU_DEVICES="$ndev" python -m pytest tests/test_perf_overlap.py \
+        -q -p no:cacheprovider
+done
+
+echo "perf_check: OK"

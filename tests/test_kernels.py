@@ -268,6 +268,19 @@ def test_attention_impl_invalid(monkeypatch):
 
 
 def test_attention_impl_dropout_warns_and_runs_dense(monkeypatch):
+    # on the CPU backend the fused dropout kernel is unavailable, so
+    # forced-flash-with-dropout still lands on dense — with ONE warning
+    # per (impl, layer, reason), not one per trace
+    import flexflow_tpu.ops.attention as mha
+
+    mha.reset_attention_fallback_warnings()
     with pytest.warns(UserWarning, match="dense path"):
+        path, _ = _mha_forward(monkeypatch, "flash", dropout=0.5, training=True)
+    assert path == "dense"
+    # second identical call: deduped (no warning)
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")
         path, _ = _mha_forward(monkeypatch, "flash", dropout=0.5, training=True)
     assert path == "dense"
