@@ -6,8 +6,13 @@ fix_hint)`) and composable passes:
 
   structure    — wiring/validity/acyclicity (backs Graph.check_correctness)
   sharding     — shape/dtype/degree re-derivation vs declared tensors
-  collectives  — implied-collective consistency (order, axes, views)
+  collectives  — implied-collective consistency (order, axes, views,
+                 all-to-all coverage)
   memory       — static per-device HBM-fit from material shapes
+  perf         — FFA5xx performance lints: overlap-discount soundness,
+                 padding/roofline, slice-boundary collective cost (perf.py)
+  schedule     — overlap race/aliasing over the executor's modelled
+                 reduce-scatter/update/all-gather step (schedule.py)
   rules        — substitution-rule soundness (substitution_lint)
 
 Entry points: `analyze_graph` (a graph + optional views), `analyze_model`
@@ -37,6 +42,13 @@ from .memory import (  # noqa: F401
     memory_diagnostics,
     training_weight_multiplier,
 )
+from .perf import diagnostics_by_op, perf_diagnostics  # noqa: F401
+from .schedule import (  # noqa: F401
+    OverlapSchedule,
+    ScheduleTask,
+    build_overlap_schedule,
+    schedule_race_diagnostics,
+)
 from .sharding import sharding_diagnostics  # noqa: F401
 from .structure import graph_is_wellformed, structural_diagnostics  # noqa: F401
 from .substitution_lint import (  # noqa: F401
@@ -45,7 +57,8 @@ from .substitution_lint import (  # noqa: F401
     lint_rules,
 )
 
-ALL_PASSES = ("structure", "sharding", "collectives", "memory")
+ALL_PASSES = ("structure", "sharding", "collectives", "memory", "perf",
+              "schedule")
 
 
 def analyze_graph(
@@ -58,6 +71,8 @@ def analyze_graph(
     train: bool = True,
     grad_bytes_ratio: float = 1.0,
     passes: Sequence[str] = ALL_PASSES,
+    cost_model=None,
+    executor=None,
 ) -> AnalysisReport:
     """Run the selected analysis passes over a PCG.
 
@@ -65,6 +80,11 @@ def analyze_graph(
     back to their own `machine_view`, then to whole-mesh placement.
     num_devices: live device count (enables view-bounds and degree-
     product checks). hbm_bytes: per-device budget for the memory pass.
+    cost_model: the search's cost oracle — enables the "perf" pass's
+    overlap-discount audit (FFA501) and its machine model feeds the
+    roofline/topology lints (FFA503/504). executor: a live PCGExecutor
+    whose ``overlap_schedule()`` hook the "schedule" pass audits for
+    FFA502 races (skipped when absent or the overlapped path is off).
     """
     rep = AnalysisReport()
     if "structure" in passes:
@@ -85,14 +105,24 @@ def analyze_graph(
             grad_bytes_ratio=grad_bytes_ratio,
         )
         rep.extend(mem_rep)
+    if "perf" in passes:
+        rep.extend(perf_diagnostics(
+            graph, views=views, cost_model=cost_model,
+            num_devices=num_devices,
+        ))
+    if "schedule" in passes and executor is not None:
+        sched = executor.overlap_schedule()
+        if sched is not None:
+            rep.extend(schedule_race_diagnostics(sched))
     return rep
 
 
 def analyze_model(model, *, passes: Sequence[str] = ALL_PASSES,
                   hbm_bytes: Optional[int] = None) -> AnalysisReport:
     """Analyze a compiled FFModel: its (possibly searched) PCG, the
-    searched machine views, the live device count, and the configured
-    per-chip HBM budget."""
+    searched machine views, the live device count, the configured
+    per-chip HBM budget, the search's cost model (perf pass), and the
+    executor's overlapped step schedule (schedule pass)."""
     import jax
 
     graph = model.graph
@@ -101,18 +131,19 @@ def analyze_model(model, *, passes: Sequence[str] = ALL_PASSES,
 
         raise NotCompiledError("analyze_model: call compile() first")
     ndev = min(model.config.numWorkers, len(jax.devices()))
+    cost_model = None
+    try:
+        cost_model = model._build_cost_model()
+    except Exception as e:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "analyze_model: no cost model (%s); skipping the overlap-"
+            "discount and topology-cost checks", e)
     if hbm_bytes is None:
         hbm_bytes = model.config.device_mem or None
-        if hbm_bytes is None:
-            try:
-                hbm_bytes = model._build_cost_model().machine.chip.hbm_capacity
-            except Exception as e:
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "analyze_model: no machine model for the HBM budget "
-                    "(%s); skipping the memory-fit check", e)
-                hbm_bytes = None
+        if hbm_bytes is None and cost_model is not None:
+            hbm_bytes = cost_model.machine.chip.hbm_capacity
     return analyze_graph(
         graph,
         views=getattr(model, "searched_views", None),
@@ -122,6 +153,8 @@ def analyze_model(model, *, passes: Sequence[str] = ALL_PASSES,
         train=model._is_training_compile(),
         grad_bytes_ratio=model._grad_bytes_ratio(),
         passes=passes,
+        cost_model=cost_model,
+        executor=model.executor,
     )
 
 

@@ -3,15 +3,31 @@
     python -m flexflow_tpu.analysis                  # lint the shipped
                                                      # substitution collection
     python -m flexflow_tpu.analysis rules a.json b.json
+    python -m flexflow_tpu.analysis model            # compile the (CPU-
+                                                     # sized) bench
+                                                     # Transformer and run
+                                                     # the FULL pass stack
+    python -m flexflow_tpu.analysis model --machine-model-file \\
+        machine_config_multislice --fail-on error --json
 
-Graph-level analysis has no file format to read from the CLI; it runs
-in-process via `flexflow_tpu.analysis.analyze_graph` / `analyze_model`
-and through `fit(lint=...)`. Exit codes: 0 clean, 1 ERROR diagnostics
-found, 2 usage error.
+``model`` builds the benchmark Transformer (CPU-sized by default; pass
+--seq/--hidden/... for the real bench shape), searches a strategy on the
+configured machine, and runs every analysis pass over the result —
+including the FFA5xx perf lints (overlap-discount soundness, padding
+roofline, slice-boundary collective cost) and the FFA502 overlap-race
+audit of the executor's schedule. This is the CI gate: a searched
+strategy whose static story does not hold exits non-zero before any
+device time is spent.
+
+``--json`` emits one machine-readable report object on stdout.
+``--fail-on error`` (default) exits 1 on ERROR diagnostics;
+``--fail-on warning`` also fails on warnings. Exit codes: 0 clean,
+1 threshold exceeded, 2 usage error.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from typing import List, Optional
 
@@ -19,40 +35,163 @@ from . import analyze_rules_path
 from .diagnostics import Severity
 
 
-def main(argv: Optional[List[str]] = None) -> int:
-    p = argparse.ArgumentParser(
-        prog="python -m flexflow_tpu.analysis",
-        description="Static PCG / substitution-rule analyzer",
-    )
-    p.add_argument("command", nargs="?", default="rules",
-                   choices=["rules"],
-                   help="what to analyze (default: rules)")
-    p.add_argument("paths", nargs="*",
-                   help="substitution-rule JSON files (default: the "
-                        "shipped collection)")
-    p.add_argument("--quiet", action="store_true",
-                   help="only print errors")
-    args = p.parse_args(argv)
+def _exceeds(n_err: int, n_warn: int, fail_on: str) -> bool:
+    return n_err > 0 or (fail_on == "warning" and n_warn > 0)
 
+
+def _print_report(path_or_name: str, rep, args) -> None:
+    print(f"== {path_or_name}: {len(rep.errors)} error(s), "
+          f"{len(rep.warnings)} warning(s)")
+    for d in rep:
+        if args.quiet and d.severity is not Severity.ERROR:
+            continue
+        print("  " + d.format())
+
+
+def _cmd_rules(args) -> int:
     paths = args.paths
     if not paths:
         from ..search.substitution_loader import default_rules_path
 
         paths = [default_rules_path()]
 
-    rc = 0
+    files = []
+    n_err = n_warn = 0
     for path in paths:
         rep = analyze_rules_path(path)
-        n_err = len(rep.errors)
-        print(f"== {path}: {n_err} error(s), {len(rep.warnings)} "
-              f"warning(s)")
-        for d in rep:
-            if args.quiet and d.severity is not Severity.ERROR:
-                continue
-            print("  " + d.format())
-        if n_err:
-            rc = 1
-    return rc
+        n_err += len(rep.errors)
+        n_warn += len(rep.warnings)
+        if args.json:
+            files.append({
+                "path": path,
+                "errors": len(rep.errors),
+                "warnings": len(rep.warnings),
+                "diagnostics": [d.to_dict() for d in rep],
+            })
+        else:
+            _print_report(path, rep, args)
+    if args.json:
+        print(json.dumps({
+            "command": "rules", "errors": n_err, "warnings": n_warn,
+            "fail_on": args.fail_on, "files": files,
+        }, indent=2))
+    return 1 if _exceeds(n_err, n_warn, args.fail_on) else 0
+
+
+def _cmd_model(args) -> int:
+    import jax
+
+    from .. import (
+        FFConfig,
+        FFModel,
+        LossType,
+        MetricsType,
+        SGDOptimizer,
+    )
+    from ..models.transformer import build_transformer
+    from . import analyze_graph
+
+    cfg = FFConfig()
+    cfg.batch_size = args.batch
+    if args.machine_model_file:
+        cfg.machine_model_file = args.machine_model_file
+    if args.budget is not None:
+        cfg.search_budget = args.budget
+    if args.overlap_discount:
+        cfg.search_overlap_backward_update = True
+    model = FFModel(cfg)
+    build_transformer(
+        model, batch_size=args.batch, seq_length=args.seq,
+        hidden_size=args.hidden, num_heads=args.heads,
+        num_layers=args.layers,
+    )
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR],
+    )
+    cost_model = model._build_cost_model()
+    # the strategy was searched FOR the configured machine — analyze it
+    # against that machine's device count, not this host's (a 2-slice
+    # config on a CPU dev box still carries 32-part views by design;
+    # lowering demotes what the live mesh can't shard)
+    if args.machine_model_file:
+        ndev = cost_model.machine.num_workers
+    else:
+        ndev = min(model.config.numWorkers, len(jax.devices()))
+    rep = analyze_graph(
+        model.graph,
+        views=getattr(model, "searched_views", None),
+        num_devices=ndev,
+        hbm_bytes=cost_model.machine.chip.hbm_capacity,
+        optimizer=model.optimizer,
+        train=model._is_training_compile(),
+        grad_bytes_ratio=model._grad_bytes_ratio(),
+        cost_model=cost_model,
+        executor=model.executor,
+    )
+    name = (f"bench transformer (b{args.batch} s{args.seq} "
+            f"h{args.hidden} x{args.layers}, {ndev} device(s))")
+    if args.json:
+        print(json.dumps({
+            "command": "model",
+            "model": "transformer",
+            "batch": args.batch, "seq": args.seq,
+            "hidden": args.hidden, "heads": args.heads,
+            "layers": args.layers,
+            "machine_model_file": args.machine_model_file or None,
+            "num_devices": ndev,
+            "searched_cost_s": getattr(model, "searched_cost", None),
+            "errors": len(rep.errors), "warnings": len(rep.warnings),
+            "fail_on": args.fail_on,
+            "diagnostics": [d.to_dict() for d in rep],
+        }, indent=2))
+    else:
+        _print_report(name, rep, args)
+    return 1 if _exceeds(len(rep.errors), len(rep.warnings),
+                         args.fail_on) else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m flexflow_tpu.analysis",
+        description="Static PCG / substitution-rule / strategy-perf "
+                    "analyzer",
+    )
+    p.add_argument("command", nargs="?", default="rules",
+                   choices=["rules", "model"],
+                   help="what to analyze (default: rules)")
+    p.add_argument("paths", nargs="*",
+                   help="substitution-rule JSON files (default: the "
+                        "shipped collection)")
+    p.add_argument("--quiet", action="store_true",
+                   help="only print errors")
+    p.add_argument("--json", action="store_true",
+                   help="emit one machine-readable JSON report on stdout")
+    p.add_argument("--fail-on", choices=["error", "warning"],
+                   default="error",
+                   help="severity threshold for a non-zero exit "
+                        "(default: error)")
+    # model-command shape/search knobs (CPU-sized defaults, like
+    # `python -m flexflow_tpu.obs explain`)
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--seq", type=int, default=64)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--budget", type=int, default=None,
+                   help="search budget override")
+    p.add_argument("--machine-model-file", default="",
+                   help="machine description to search/analyze against "
+                        "(e.g. machine_config_multislice)")
+    p.add_argument("--overlap-discount", action="store_true",
+                   help="search with the overlappable-collective "
+                        "discount on, so FFA501 audits a live discount")
+    args = p.parse_args(argv)
+
+    if args.command == "model":
+        return _cmd_model(args)
+    return _cmd_rules(args)
 
 
 if __name__ == "__main__":

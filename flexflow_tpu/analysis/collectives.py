@@ -26,7 +26,14 @@ up as deadlocks or silently-wrong numbers on device:
   * FFA207 — a WeightShard (FSDP) op whose target carries no shardable
     weights, or whose target's weight-dim degrees disagree with the
     declared shard degree (the implied all-gather/reduce-scatter pair
-    would move the wrong bytes, or nothing at all).
+    would move the wrong bytes, or nothing at all);
+  * FFA505 — all-to-all / collective-bytes coverage: an AllToAll whose
+    declared exchange degree disagrees with its input sharding (the
+    expert-dispatch / Ulysses exchange would move the wrong shards),
+    and — the coverage half — any parallel op whose collective kind
+    ``estimate_collective_bytes`` has no model for: unknown kinds are a
+    typed WARNING diagnostic instead of a silent skip, so the
+    ``ff_pcg_collective_bytes`` export can never silently under-report.
 """
 from __future__ import annotations
 
@@ -40,7 +47,9 @@ _COLLECTIVE_OF = {
     OperatorType.OP_COMBINE: "all-gather",
     OperatorType.OP_REPLICATE: "broadcast",
     OperatorType.OP_REDUCTION: "all-reduce",
-    OperatorType.OP_ALL_TO_ALL: "all-to-all",
+    # exported under ff_pcg_collective_bytes{kind="all_to_all"} — the
+    # expert-dispatch / sequence<->head exchange (ROADMAP item 5)
+    OperatorType.OP_ALL_TO_ALL: "all_to_all",
     # FSDP/ZeRO weight sharding implies a PAIR per step: all-gather the
     # sharded params on use (fwd + bwd) and reduce-scatter the weight
     # grads (parallel/weight_sharding.py). estimate_collective_bytes
@@ -59,28 +68,44 @@ def _view_of(op, views: Dict) -> Optional[object]:
     return op.machine_view
 
 
-def estimate_collective_bytes(graph, views: Optional[Dict] = None
+def estimate_collective_bytes(graph, views: Optional[Dict] = None,
+                              report: Optional[AnalysisReport] = None
                               ) -> "list[dict]":
     """Static per-op collective payload estimate for a placed strategy.
 
     For each parallel op, the wire bytes its implied collective moves
     per step under the standard ring algorithms (all-reduce 2(p-1)/p of
-    the buffer, all-gather/scatter/all-to-all/broadcast (p-1)/p,
-    reduce-scatter (p-1)/p), where p is the participant count (the
-    view's parts, falling back to the tensor's parallel degree). A
-    WeightShard (FSDP) op contributes TWO records over its target's full
-    weight bytes: kind ``all_gather`` (the params are gathered on use in
-    the forward AND the backward, so 2x(p-1)/p) and kind
-    ``reduce_scatter`` (the weight-grad half of the replicated
+    the buffer, all-gather/scatter/broadcast (p-1)/p, reduce-scatter
+    (p-1)/p, all-to-all (p-1)/p of the buffer exchanged pairwise), where
+    p is the participant count (the view's parts, falling back to the
+    tensor's parallel degree; an AllToAll uses its declared exchange
+    degree). A WeightShard (FSDP) op contributes TWO records over its
+    target's full weight bytes: kind ``all_gather`` (the params are
+    gathered on use in the forward AND the backward, so 2x(p-1)/p) and
+    kind ``reduce_scatter`` (the weight-grad half of the replicated
     strategy's all-reduce). Feeds the telemetry gauge
     ``ff_pcg_collective_bytes`` so a strategy's communication footprint
-    is visible without running it."""
+    is visible without running it.
+
+    report: optional AnalysisReport that receives an FFA505 WARNING for
+    every parallel op whose kind has no bytes model here — unknown
+    kinds must never silently vanish from the export (they used to)."""
     from ..parallel.weight_sharding import shard_target_weight_bytes
 
     out = []
     for op in graph.topo_order():
         kind = _COLLECTIVE_OF.get(op.op_type)
         if kind is None:
+            if op.is_parallel_op and report is not None:
+                report.add(
+                    Severity.WARNING, "FFA505",
+                    f"parallel op {op.op_type.name} has no collective-"
+                    "bytes model — its wire traffic is missing from the "
+                    "ff_pcg_collective_bytes export and from every lint "
+                    "that keys off it", op=op,
+                    fix_hint="teach analysis/collectives._COLLECTIVE_OF "
+                             "+ estimate_collective_bytes the kind",
+                )
             continue
         if op.op_type == OperatorType.OP_WEIGHT_SHARD:
             p = max(1, op.params.shard_degree)
@@ -100,13 +125,21 @@ def estimate_collective_bytes(graph, views: Optional[Dict] = None
             continue
         full = t.get_volume() * t.data_type.size
         v = _view_of(op, views or {})
-        p = max(1, v.num_parts()) if v is not None else \
-            max(1, t.get_total_degree())
+        if op.op_type == OperatorType.OP_ALL_TO_ALL:
+            # the exchange degree is declared on the op; a view may
+            # cover more devices than actually trade shards
+            p = max(1, op.params.degree)
+        else:
+            p = max(1, v.num_parts()) if v is not None else \
+                max(1, t.get_total_degree())
         if p <= 1:
             wire = 0
         elif kind == "all-reduce":
             wire = int(full * 2 * (p - 1) / p)
         else:
+            # one pass of the buffer over the group: all-gather/scatter/
+            # broadcast rings and the pairwise all-to-all exchange all
+            # move (p-1)/p of the full payload per device per step
             wire = int(full * (p - 1) / p)
         out.append({"op": op.name, "guid": op.guid, "kind": kind,
                     "bytes": wire, "parts": p})
@@ -201,6 +234,21 @@ def collective_diagnostics(graph, views: Optional[Dict] = None,
             _check_softmax_axis(op, rep)
         elif op.op_type == OperatorType.OP_WEIGHT_SHARD:
             _check_weight_shard(op, rep)
+        elif op.op_type == OperatorType.OP_ALL_TO_ALL:
+            _check_all_to_all(op, rep)
+        elif op.is_parallel_op and op.op_type not in _COLLECTIVE_OF:
+            # coverage half of FFA505: a collective we cannot lower to a
+            # kind is invisible to the bytes export AND to the ordering
+            # lint below — say so instead of silently skipping
+            rep.add(
+                Severity.WARNING, "FFA505",
+                f"parallel op {op.op_type.name} has no collective-bytes "
+                "model — its wire traffic is missing from the "
+                "ff_pcg_collective_bytes export and it is excluded from "
+                "the cross-shard ordering check", op=op,
+                fix_hint="teach analysis/collectives._COLLECTIVE_OF + "
+                         "estimate_collective_bytes the kind",
+            )
 
     # -- machine-view transitions -----------------------------------------
     for op in ops:
@@ -372,6 +420,51 @@ def _check_weight_shard(op, rep: AnalysisReport) -> None:
             "memory accounting would be wrong", op=op,
             fix_hint="shard the target's weights (shard_op_weights) or "
                      "drop the node (fsdp_unshard_weights)",
+        )
+
+
+def _check_all_to_all(op, rep: AnalysisReport) -> None:
+    """FFA505: the AllToAll exchange (sequence<->head resharding, MoE
+    expert dispatch) must agree with its input sharding: the dim being
+    gathered must actually be sharded `degree`-ways, and the dim being
+    scattered must divide by `degree` — a mismatch moves the wrong
+    shards between peers (wrong numbers, not just wrong cost)."""
+    if not op.inputs:
+        return
+    in_t = op.inputs[0]
+    p = op.params
+    ndim = len(in_t.dims)
+    if not (0 <= p.scatter_dim < ndim and 0 <= p.gather_dim < ndim):
+        rep.add(
+            Severity.ERROR, "FFA505",
+            f"all-to-all dims (scatter={p.scatter_dim}, "
+            f"gather={p.gather_dim}) out of range for rank-{ndim} input "
+            f"{in_t.get_shape()!r}", op=op,
+        )
+        return
+    if p.degree < 2:
+        rep.add(
+            Severity.ERROR, "FFA505",
+            f"all-to-all with degree {p.degree}: nothing to exchange "
+            "(degree must be >= 2)", op=op,
+        )
+        return
+    g = in_t.dims[p.gather_dim]
+    if g.degree != p.degree:
+        rep.add(
+            Severity.ERROR, "FFA505",
+            f"all-to-all gathers dim {p.gather_dim}, which is sharded "
+            f"{g.degree}-way, but declares exchange degree {p.degree} — "
+            "each peer would contribute the wrong shard count", op=op,
+            fix_hint=f"set degree={g.degree} (the gather dim's actual "
+                     "sharding) or reshard the input first",
+        )
+    s = in_t.dims[p.scatter_dim]
+    if s.size % p.degree != 0:
+        rep.add(
+            Severity.ERROR, "FFA505",
+            f"all-to-all scatters dim {p.scatter_dim} (size {s.size}) "
+            f"{p.degree}-ways, which does not divide evenly", op=op,
         )
 
 

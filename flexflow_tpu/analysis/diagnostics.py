@@ -46,6 +46,17 @@ class Diagnostic:
         return f"{self.severity.name.lower()}: {self.code}{where}: " \
                f"{self.message}{hint}"
 
+    def to_dict(self) -> dict:
+        """JSON-ready record (the CLI's --json report and CI tooling)."""
+        return {
+            "severity": self.severity.name.lower(),
+            "code": self.code,
+            "message": self.message,
+            "op_guid": self.op_guid,
+            "op_name": self.op_name,
+            "fix_hint": self.fix_hint,
+        }
+
 
 class AnalysisReport:
     """Ordered collection of diagnostics from one analyzer run."""

@@ -1,0 +1,326 @@
+"""Static performance analysis (the FFA5xx pass family).
+
+The search trusts its cost model; PR 8 made that trust load-bearing by
+letting the search DISCOUNT weight-grad collectives it believes will
+hide behind backward compute, and by running an overlapped
+reduce-scatter / sharded-update / all-gather step with donated buffers.
+These passes audit the CHOSEN strategy before it executes:
+
+  * FFA501 — overlap-discount soundness: recompute the statically
+    hideable backward-compute window behind every discounted collective
+    (analysis/collectives.hideable_backward_compute) and flag discounts
+    the schedule cannot actually realize, with the exposed-time delta
+    the search is blind to. Per-collective overshoot is a WARNING (the
+    per-op seam is a calibrated approximation); a discount on a
+    collective the schedule keeps serial, or an aggregate discount the
+    whole backward pass cannot absorb, is an ERROR — the search lied to
+    itself.
+  * FFA502 — static overlap race/aliasing detection over the modelled
+    executor schedule (analysis/schedule.py; run via the "schedule"
+    pass / an executor's ``overlap_schedule()`` hook).
+  * FFA503 — roofline/padding diagnostics: ops whose SHARD shape pays
+    MXU tile padding the unsharded shape would not (the PR-1 cost-model
+    sublane/lane quantization rule), with a fix_hint naming the degree
+    change that removes the padding.
+  * FFA504 — slice-boundary collective lint: collectives whose ring
+    crosses an ICI/DCN slice boundary while the machine model prices a
+    flat mesh (the static precondition for hierarchical multi-slice
+    search, ROADMAP item 4); under a topology-aware machine,
+    non-contiguous rings are reported with their torus hop factor.
+  * FFA505 — all-to-all / collective-bytes coverage (lives in
+    analysis/collectives.py next to the per-op collective checks:
+    unknown collective kinds are a typed warning instead of a silent
+    estimate skip, and the all-to-all kind is modelled + exported).
+
+Entry: ``perf_diagnostics(graph, views, cost_model=..., executor=...)``;
+wired into ``analyze_graph``/``analyze_model`` as the "perf" and
+"schedule" passes, into ``compile()`` (core/model.py warns on errors
+after the strategy search), into ``fit(lint=...)``, the
+``python -m flexflow_tpu.analysis`` CLI, and ``obs.explain_strategy()``
+(each ranked op carries its FFA5xx diagnostics).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+from ..ff_types import OperatorType
+from .collectives import _COLLECTIVE_OF, _view_of
+from .diagnostics import AnalysisReport, Severity
+
+# relative/absolute slack before a discount overshoot is reported: the
+# per-op seam and the schedule window are both analytic, so hold back on
+# float-noise-sized deltas
+_REL_TOL = 1e-6
+_ABS_TOL_S = 1e-9
+
+# ops with an MXU (systolic-array) shape, i.e. a tile-quantized cost
+# (search/cost_model.op_padded_flops)
+_MXU_OPS = frozenset({
+    OperatorType.OP_LINEAR,
+    OperatorType.OP_CONV2D,
+    OperatorType.OP_BATCHMATMUL,
+    OperatorType.OP_MULTIHEAD_ATTENTION,
+})
+
+
+def perf_diagnostics(
+    graph,
+    views: Optional[Dict] = None,
+    *,
+    cost_model=None,
+    machine=None,
+    num_devices: Optional[int] = None,
+    executor=None,
+) -> AnalysisReport:
+    """Run the FFA5xx static performance passes over a placed strategy.
+
+    cost_model: the search's cost oracle (enables FFA501 and the
+    roofline numbers in FFA503; its machine model feeds FFA504).
+    machine: explicit MachineModel when no cost model is at hand.
+    executor: a live PCGExecutor — its ``overlap_schedule()`` hook is
+    audited for FFA502 races.
+    """
+    rep = AnalysisReport()
+    views = views or {}
+    if machine is None and cost_model is not None:
+        machine = cost_model.machine
+    if cost_model is not None:
+        _overlap_discount_diagnostics(graph, views, cost_model, rep)
+    _padding_roofline_diagnostics(graph, views, machine, rep)
+    if machine is not None:
+        _topology_cost_diagnostics(graph, views, machine, rep)
+    if executor is not None:
+        sched = executor.overlap_schedule()
+        if sched is not None:
+            from .schedule import schedule_race_diagnostics
+
+            rep.extend(schedule_race_diagnostics(sched))
+    return rep
+
+
+# ----------------------------------------------------------------------
+# FFA501 — overlap-discount soundness
+# ----------------------------------------------------------------------
+def _overlap_discount_diagnostics(graph, views, cost_model,
+                                  rep: AnalysisReport) -> None:
+    if not getattr(cost_model, "overlap_backward_update", False):
+        return
+    from ..pcg.machine_view import MachineView
+
+    from .collectives import (
+        hideable_backward_compute,
+        overlappable_grad_syncs,
+    )
+
+    eff = min(max(float(getattr(cost_model, "overlap_efficiency", 1.0)),
+                  0.0), 1.0)
+    overlappable = overlappable_grad_syncs(graph)
+    windows = hideable_backward_compute(graph, views, cost_model)
+    v1 = MachineView(start_device_id=0, dim=(1,), stride=(1,))
+    total_hidden = 0.0
+    max_window = 0.0
+    for op in graph.topo_order():
+        v = _view_of(op, views) or v1
+        cm = cost_model.measure_operator_cost(op, v)
+        hidden = cm.hidden_sync_time
+        if hidden <= 0.0:
+            continue
+        if op.guid not in overlappable:
+            # the structural proof (analysis/collectives.
+            # overlappable_grad_syncs) excludes this op — e.g. its
+            # reduce-scatter is owned by an FSDP WeightShard node — so
+            # the overlapped simulator keeps the sync SERIAL while the
+            # per-op cost discounted it: the two halves of the search
+            # disagree about the same collective
+            rep.add(
+                Severity.ERROR, "FFA501",
+                f"the cost model discounted {hidden * 1e3:.3f} ms of "
+                f"this op's {cm.sync_time * 1e3:.3f} ms gradient sync, "
+                "but the collective is NOT statically overlappable "
+                "(overlappable_grad_syncs excludes it) — the schedule "
+                f"keeps it serial; exposed-time delta {hidden * 1e3:.3f} "
+                "ms", op=op,
+                fix_hint="exclude the op from the discount (FSDP-owned "
+                         "and activation-path collectives keep their "
+                         "full price)",
+            )
+            continue
+        total_hidden += hidden
+        window = eff * windows.get(op.guid, 0.0)
+        max_window = max(max_window, window)
+        delta = hidden - window
+        if delta > max(_ABS_TOL_S, _REL_TOL * cm.sync_time):
+            rep.add(
+                Severity.WARNING, "FFA501",
+                f"search discount hides {hidden * 1e3:.3f} ms of this "
+                f"op's gradient sync, but only "
+                f"{window * 1e3:.3f} ms of backward compute is "
+                "statically schedulable behind it "
+                f"(eff={eff:.2f}); exposed-time delta "
+                f"{delta * 1e3:.3f} ms the simulated step time omits",
+                op=op,
+                fix_hint="lower overlap_efficiency (calibration) or "
+                         "accept the optimism for mid-stack ops — the "
+                         "aggregate check below is the hard bound",
+            )
+    if total_hidden > max_window + max(_ABS_TOL_S, _REL_TOL * total_hidden):
+        # the comm channel serializes: every discounted collective must
+        # fit inside the LARGEST hideable window — if the total claimed
+        # hidden time exceeds it, no schedule can realize the discount
+        rep.add(
+            Severity.ERROR, "FFA501",
+            f"aggregate overlap discount {total_hidden * 1e3:.3f} ms "
+            f"exceeds the largest statically hideable backward window "
+            f"{max_window * 1e3:.3f} ms (eff={eff:.2f}) — the searched "
+            "strategy's simulated step time is unrealizable; exposed-"
+            f"time delta {(total_hidden - max_window) * 1e3:.3f} ms",
+            fix_hint="disable search_overlap_backward_update for this "
+                     "graph or re-search with a calibrated "
+                     "overlap_efficiency",
+        )
+
+
+# ----------------------------------------------------------------------
+# FFA503 — roofline / sharding-induced padding
+# ----------------------------------------------------------------------
+def _tile_waste(extent: int, quantum: int) -> float:
+    return math.ceil(max(1, extent) / quantum) * quantum / max(1, extent)
+
+
+def _padding_roofline_diagnostics(graph, views, machine,
+                                  rep: AnalysisReport) -> None:
+    from ..search.cost_model import (
+        MXU_LANES,
+        MXU_SUBLANES,
+        op_bytes,
+        op_flops,
+    )
+    from ..search.machine_model import TPUChipSpec
+
+    chip = machine.chip if machine is not None else TPUChipSpec()
+    ridge = chip.peak_flops_bf16 / chip.hbm_bandwidth
+    seen = set()
+    for op in graph.topo_order():
+        if op.op_type not in _MXU_OPS or not op.outputs:
+            continue
+        tensors = [("output", op.outputs[0])]
+        if op.inputs:
+            tensors.append(("input", op.inputs[0]))
+        for role, t in tensors:
+            material = [(i, d) for i, d in enumerate(t.dims)
+                        if not d.is_replica_dim]
+            rank = len(material)
+            for mi, (di, d) in enumerate(material):
+                if d.degree <= 1 or d.size % d.degree != 0:
+                    continue
+                # MXU tile quantization — the SAME quanta op_padded_flops
+                # prices shards at: lanes on the minormost dim, sublanes
+                # on the second-minormost
+                quantum = MXU_LANES if mi == rank - 1 else \
+                    MXU_SUBLANES if mi == rank - 2 else None
+                if quantum is None:
+                    continue
+                if (t.guid, di) in seen:
+                    continue
+                shard = d.size // d.degree
+                waste_shard = _tile_waste(shard, quantum)
+                waste_full = _tile_waste(d.size, quantum)
+                if waste_shard < 1.5 or waste_shard < 1.5 * waste_full:
+                    continue  # padding not sharding-induced (or minor)
+                seen.add((t.guid, di))
+                deg = t.get_total_degree()
+                useful = op_flops(op) / max(1, deg)
+                nbytes = op_bytes(op) / max(1, deg)
+                intensity = useful / max(1.0, nbytes)
+                bound = ("HBM-bound" if intensity < ridge
+                         else "padding-bound")
+                fix = _padding_fix_hint(role, di, d.size, d.degree,
+                                        quantum)
+                rep.add(
+                    Severity.WARNING, "FFA503",
+                    f"{role} dim {di} shard extent {shard} pads to "
+                    f"{int(_tile_waste(shard, quantum) * shard)} on the "
+                    f"MXU ({waste_shard:.1f}x cost; the unsharded extent "
+                    f"{d.size} wastes only {waste_full:.1f}x) — the "
+                    f"{d.degree}-way sharding drove this op {bound} "
+                    f"(useful intensity {intensity:.0f} flops/B vs "
+                    f"ridge {ridge:.0f})",
+                    op=op, fix_hint=fix,
+                )
+
+
+def _padding_fix_hint(role: str, dim: int, size: int, degree: int,
+                      quantum: int) -> str:
+    for d in range(degree - 1, 0, -1):
+        if degree % d == 0 and (size // d) % quantum == 0:
+            return (f"reduce {role} dim {dim} degree {degree} -> {d} "
+                    f"(shard extent {size // d} is a multiple of "
+                    f"{quantum})")
+    return (f"no divisor of {degree} shards {size} into {quantum}-"
+            f"multiples; unshard dim {dim} or pad it to a multiple of "
+            f"{quantum * degree}")
+
+
+# ----------------------------------------------------------------------
+# FFA504 — slice-boundary collective cost
+# ----------------------------------------------------------------------
+def _topology_cost_diagnostics(graph, views, machine,
+                               rep: AnalysisReport) -> None:
+    hierarchical = bool(getattr(machine, "hierarchical", False))
+    for op in graph.topo_order():
+        kind = _COLLECTIVE_OF.get(op.op_type)
+        if kind is None:
+            continue
+        v = _view_of(op, views or {})
+        if v is None:
+            continue
+        ids = list(v.device_ids())
+        if len(ids) <= 1:
+            continue
+        per_slice: Dict[int, List[int]] = {}
+        for d in ids:
+            per_slice.setdefault(machine.node_of(d), []).append(d)
+        if len(per_slice) > 1 and not hierarchical:
+            sizes = {s: len(v2) for s, v2 in sorted(per_slice.items())}
+            rep.add(
+                Severity.WARNING, "FFA504",
+                f"{kind} ring spans {len(per_slice)} slices "
+                f"(devices per slice {sizes}) but the flat machine "
+                "model prices every link at ICI bandwidth "
+                f"({machine.ici_bandwidth / 1e9:.0f} GB/s); the DCN "
+                f"crossings ({machine.dcn_bandwidth / 1e9:.0f} GB/s) "
+                "make the search's cost for this collective fiction",
+                op=op,
+                fix_hint="set machine_model_version = 1 / topology_dims "
+                         "in the machine config (e.g. "
+                         "machine_config_multislice) so collectives "
+                         "decompose into intra-slice + DCN phases",
+            )
+        elif hierarchical and hasattr(machine, "ring_hop_factor"):
+            # torus routing (search/network.py): a ring whose neighbors
+            # are multi-hop pays per-step hop cost a contiguous ring
+            # would not — priced correctly here, surfaced so strategies
+            # with scattered placements are explainable
+            max_hops, _ = machine.ring_hop_factor(ids)
+            if max_hops >= 2:
+                rep.add(
+                    Severity.INFO, "FFA504",
+                    f"{kind} ring neighbors are up to {int(max_hops)} "
+                    "ICI hops apart on the slice torus — per-step cost "
+                    f"scales ~{int(max_hops)}x vs a contiguous ring "
+                    "(priced by the topology model; a contiguous "
+                    "placement would be cheaper)",
+                    op=op,
+                )
+
+
+# ----------------------------------------------------------------------
+# joins for obs/explain.py
+# ----------------------------------------------------------------------
+def diagnostics_by_op(report: AnalysisReport) -> Dict[int, List]:
+    """op guid -> [Diagnostic] (graph-level findings land under None)."""
+    out: Dict[int, List] = {}
+    for d in report:
+        out.setdefault(d.op_guid, []).append(d)
+    return out

@@ -1065,6 +1065,29 @@ class FFModel:
                 "(falling through to lowering, which demotes infeasible "
                 "degrees to replicated): " + "; ".join(problems[:5])
             )
+        # static perf audit of the WINNER (analysis/perf.py FFA5xx): the
+        # search trusted a cost model that discounts overlappable
+        # collectives — verify the discounts are schedulable and the
+        # topology pricing holds before the strategy ever executes. The
+        # cost model here is the SAME oracle the search scored with, so
+        # an FFA501 finding is the search disagreeing with itself.
+        from ..analysis.perf import perf_diagnostics
+
+        perf_rep = perf_diagnostics(
+            self.graph, views=self.searched_views, cost_model=cost_model,
+            num_devices=ndev,
+        )
+        if perf_rep.errors:
+            warnings.warn(
+                "static perf analysis flagged the searched strategy "
+                "(fit(lint=...) re-checks; docs/analysis.md FFA5xx): "
+                + "; ".join(d.format() for d in perf_rep.errors[:5])
+            )
+        self.search_trajectory.event(
+            "perf_lint", errors=len(perf_rep.errors),
+            warnings=len(perf_rep.warnings),
+            codes=sorted({d.code for d in perf_rep}),
+        )
         if cfg.export_strategy_file:
             from ..runtime.strategy_io import export_strategy
 

@@ -61,17 +61,20 @@ class StrategyExplanation:
 
     def worklist(self, n: int = 3) -> List[dict]:
         """The per-round kernel worklist: the n most miscalibrated ops,
-        each a {rank, name, op_type, sim_total_s, meas_total_s, ratio}
-        record. This is where a perf round starts (ROADMAP item 1 /
-        docs/performance.md): the top entries are either kernels worth
-        fusing (measured ≫ simulated) or cost-model entries worth
-        recalibrating (simulated ≫ measured) — e.g. the overlap
-        discount's overlap_efficiency when collective-adjacent ops rank
-        high."""
+        each a {rank, name, op_type, sim_total_s, meas_total_s, ratio,
+        diagnostics} record. This is where a perf round starts (ROADMAP
+        item 1 / docs/performance.md): the top entries are either
+        kernels worth fusing (measured ≫ simulated) or cost-model
+        entries worth recalibrating (simulated ≫ measured) — and when a
+        row also carries FFA5xx codes, the static analyzer already
+        NAMES the structural reason (unsound overlap discount, padding-
+        bound shard, mispriced slice-crossing collective) before any
+        recalibration guesswork."""
         return [
             {"rank": i + 1, "name": r["name"], "op_type": r["op_type"],
              "sim_total_s": r["sim_total_s"],
-             "meas_total_s": r["meas_total_s"], "ratio": r["ratio"]}
+             "meas_total_s": r["meas_total_s"], "ratio": r["ratio"],
+             "diagnostics": [d["code"] for d in r.get("diagnostics", [])]}
             for i, r in enumerate(self.rows[:n])
         ]
 
@@ -119,16 +122,25 @@ class StrategyExplanation:
             f"({sub.get('improved', 0)} improved the best)"
         )
         hdr = (f"  {'op':<28} {'type':<20} {'sim ms':>9} {'meas ms':>9} "
-               f"{'|err| ms':>9} {'ratio':>7}")
+               f"{'|err| ms':>9} {'ratio':>7}  static")
         lines.append(hdr)
+        flagged = []
         for r in self.rows[:n]:
+            codes = sorted({d["code"] for d in r.get("diagnostics", [])})
             lines.append(
                 f"  {r['name'][:28]:<28} {r['op_type'][:20]:<20} "
                 f"{r['sim_total_s'] * 1e3:>9.4f} "
                 f"{r['meas_total_s'] * 1e3:>9.4f} "
                 f"{r['abs_err_s'] * 1e3:>9.4f} "
                 f"{r['ratio']:>7.2f}"
+                + (f"  !{','.join(codes)}" if codes else "")
             )
+            if codes:
+                flagged.append(r)
+        for r in flagged:
+            for d in r.get("diagnostics", []):
+                lines.append(f"    {r['name']}: {d['severity']} "
+                             f"{d['code']}: {d['message']}")
         ratios = self.calibration_ratios()
         if ratios:
             worst = sorted(ratios.items(),
@@ -175,6 +187,26 @@ def explain_strategy(model, x=None, *, repeats: int = 3, warmup: int = 1,
     measured = profile_ops(model, x, repeats=repeats, warmup=warmup,
                            backward=True)
     views = getattr(model, "searched_views", None) or {}
+    # static FFA5xx perf lints over the same strategy: the |sim − meas|
+    # ranking says WHERE the cost model is wrong, the analyzer says WHY
+    # (unsound overlap discount, padding-bound shard, slice-crossing
+    # collective) — join them per op so the two confront each other in
+    # one report
+    diags_by_guid: Dict = {}
+    try:
+        from ..analysis.perf import diagnostics_by_op, perf_diagnostics
+
+        perf_rep = perf_diagnostics(
+            model.graph, views=views, cost_model=cm,
+            executor=model.executor,
+        )
+        diags_by_guid = diagnostics_by_op(perf_rep)
+    except Exception as e:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "explain_strategy: static perf analysis failed (%s); rows "
+            "carry no FFA5xx annotations", e)
     v1 = MachineView(start_device_id=0, dim=(1,), stride=(1,))
     rows: List[dict] = []
     for op in model.graph.ops:
@@ -198,6 +230,8 @@ def explain_strategy(model, x=None, *, repeats: int = 3, warmup: int = 1,
             "meas_total_s": meas_t,
             "abs_err_s": abs(sim_t - meas_t),
             "ratio": (meas_t / sim_t) if sim_t > 0 else float("inf"),
+            "diagnostics": [d.to_dict()
+                            for d in diags_by_guid.get(op.guid, [])],
             "_key": _op_cost_key(op),
         })
     rows.sort(key=lambda r: r["abs_err_s"], reverse=True)

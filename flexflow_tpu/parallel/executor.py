@@ -753,6 +753,23 @@ class PCGExecutor:
         self._overlap_spec_cache = out
         return out
 
+    def overlap_schedule(self):
+        """Schedule-introspection hook for the static analyzer
+        (analysis/schedule.py): the per-weight task chains this
+        executor's overlapped step actually traces — backward →
+        reduce-scatter(grad) → sharded update (donating opt state) →
+        all-gather of updated params (donating the old param storage) —
+        as an ``OverlapSchedule`` the FFA502 race detector can walk.
+        Returns None when the overlapped path is off or inert (data
+        degree 1 leaves ``_overlap_specs`` empty), matching the step
+        the jit actually runs."""
+        from ..analysis.schedule import build_overlap_schedule
+
+        omap = self._overlap_specs()
+        if not omap:
+            return None
+        return build_overlap_schedule(self.graph, set(omap.keys()))
+
     def _constrain_weight_tree(self, tree, omap, *, sharded: bool):
         """Apply the overlap shardings to a params-shaped
         {op: {weight: array}} tree (grads, params, or updated params)."""

@@ -92,6 +92,16 @@ def op_flops(op: PCGOp) -> float:
     return float(sum(_vol(s) for s in out_shapes))
 
 
+# MXU tile quanta (the public scaling-book tile-quantization rule): the
+# systolic array is 128 lanes wide (output/contraction dims), with 8-row
+# sublanes. op_padded_flops prices shards at these quanta, and the
+# static padding lint (analysis/perf.py FFA503) keys off the SAME
+# constants so the search and the analyzer can never disagree about
+# which shard extents pad.
+MXU_LANES = 128
+MXU_SUBLANES = 8
+
+
 def _pad(v, q: int) -> float:
     return float(math.ceil(max(1, int(v)) / q) * q)
 
@@ -120,14 +130,14 @@ def op_padded_flops(op: PCGOp, parts: int = 1) -> float:
     if t == OperatorType.OP_LINEAR and op.inputs and op.outputs:
         si = _shard_shape(op.inputs[0])
         so = _shard_shape(op.outputs[0])
-        return 2.0 * _pad(_vol(so[:-1]), 8) * _pad(si[-1], 128) * _pad(so[-1], 128)
+        return 2.0 * _pad(_vol(so[:-1]), MXU_SUBLANES) * _pad(si[-1], MXU_LANES) * _pad(so[-1], MXU_LANES)
     if t == OperatorType.OP_CONV2D and op.inputs and op.outputs:
         si = _shard_shape(op.inputs[0])   # (N, Cin, H, W) shard
         so = _shard_shape(op.outputs[0])  # (N, Cout, OH, OW) shard
         p = op.params
         contraction = si[1] * p.kernel_h * p.kernel_w // max(1, p.groups)
-        return 2.0 * _pad(so[0] * so[2] * so[3], 8) * _pad(contraction, 128) \
-            * _pad(so[1], 128)
+        return 2.0 * _pad(so[0] * so[2] * so[3], MXU_SUBLANES) * _pad(contraction, MXU_LANES) \
+            * _pad(so[1], MXU_LANES)
     if t == OperatorType.OP_BATCHMATMUL and len(op.inputs) == 2:
         sa = _shard_shape(op.inputs[0])
         sb = _shard_shape(op.inputs[1])
@@ -135,8 +145,8 @@ def op_padded_flops(op: PCGOp, parts: int = 1) -> float:
         # padding applies per batch element (exactly like the MHA branch's
         # bq*h*_pad(sq,8) below), not once to the flattened batch*rows
         # product — flattening under-priced small-rows batched matmuls
-        return 2.0 * _vol(sa[:-2]) * _pad(sa[-2], 8) * _pad(sa[-1], 128) \
-            * _pad(sb[-1], 128)
+        return 2.0 * _vol(sa[:-2]) * _pad(sa[-2], MXU_SUBLANES) * _pad(sa[-1], MXU_LANES) \
+            * _pad(sb[-1], MXU_LANES)
     if t == OperatorType.OP_MULTIHEAD_ATTENTION and len(op.inputs) == 3:
         q, k = op.inputs[0], op.inputs[1]
         p = op.params
@@ -151,10 +161,10 @@ def op_padded_flops(op: PCGOp, parts: int = 1) -> float:
         # the DP grants it single-part views, so charging one shard here
         # would let a TP candidate undercut without paying its devices
         h, d = p.num_heads, p.qk_head_dim
-        proj = 2.0 * _pad(bq * sq, 8) * _pad(eq, 128) * _pad(h * d, 128) * 3
-        scores = 2.0 * bq * h * _pad(sq, 8) * _pad(d, 128) * _pad(sk, 128)
-        av = 2.0 * bq * h * _pad(sq, 8) * _pad(sk, 128) * _pad(p.v_head_dim, 128)
-        out = 2.0 * _pad(bq * sq, 8) * _pad(h * p.v_head_dim, 128) * _pad(p.embed_dim, 128)
+        proj = 2.0 * _pad(bq * sq, MXU_SUBLANES) * _pad(eq, MXU_LANES) * _pad(h * d, MXU_LANES) * 3
+        scores = 2.0 * bq * h * _pad(sq, MXU_SUBLANES) * _pad(d, MXU_LANES) * _pad(sk, MXU_LANES)
+        av = 2.0 * bq * h * _pad(sq, MXU_SUBLANES) * _pad(sk, MXU_LANES) * _pad(p.v_head_dim, MXU_LANES)
+        out = 2.0 * _pad(bq * sq, MXU_SUBLANES) * _pad(h * p.v_head_dim, MXU_LANES) * _pad(p.embed_dim, MXU_LANES)
         return proj + scores + av + out
     return op_flops(op) / max(1, parts)
 

@@ -55,6 +55,15 @@ class MachineModel:
     def num_workers(self) -> int:
         return self.num_nodes * self.workers_per_node
 
+    @property
+    def hierarchical(self) -> bool:
+        """True when this machine prices collectives over an ICI/DCN
+        hierarchy (TopologyAwareMachineModel). The flat model prices
+        every group at flat-mesh bandwidths — a cross-slice ring under
+        it is mispriced by construction, which is exactly what the
+        FFA504 lint (analysis/perf.py) flags."""
+        return False
+
     def node_of(self, device_id: int) -> int:
         return device_id // self.workers_per_node
 

@@ -137,6 +137,10 @@ class TopologyAwareMachineModel(MachineModel):
         )
         self._link_load: Dict[Tuple[int, int], int] = {}
 
+    @property
+    def hierarchical(self) -> bool:
+        return True
+
     def reset_congestion(self):
         self._link_load.clear()
 
@@ -206,8 +210,12 @@ class TopologyAwareMachineModel(MachineModel):
             worst = max(worst, t)
         return worst
 
-    def _ring_hop_factor(self, ids) -> Tuple[float, bool]:
-        """(max ICI hops between ring neighbors, crosses_dcn)."""
+    def ring_hop_factor(self, ids) -> Tuple[float, bool]:
+        """(max ICI hops between ring neighbors, crosses_dcn) for a ring
+        over `ids` in order. Public: the collective costs below scale by
+        it, and the FFA504 topology lint (analysis/perf.py) reports it
+        for non-contiguous rings."""
+        ids = list(ids)
         n = len(ids)
         max_hops, crosses = 1, False
         for i in range(n):
@@ -217,6 +225,9 @@ class TopologyAwareMachineModel(MachineModel):
             else:
                 max_hops = max(max_hops, max(1, h))
         return float(max_hops), crosses
+
+    # internal alias kept for call sites/tests predating the public name
+    _ring_hop_factor = ring_hop_factor
 
     def allreduce_cost(self, num_bytes: float, device_ids) -> float:
         """Ring allreduce: neighbor links when the group is a contiguous

@@ -1,12 +1,16 @@
 #!/usr/bin/env bash
-# Static-analysis sweep (ISSUE 4), mirroring verify_check.sh: the
-# project AST linter, the substitution-rule lint over the shipped
-# collection, and the analyzer test suite on CPU meshes of varying
-# size — seeded-defect PCGs (wrong reduction axis, degree-vs-devices
-# mismatch, cross-shard collective order, over-HBM views) must each
-# produce their diagnostic code STATICALLY, and the clean searched zoo
-# strategies must produce zero errors. Use before touching pcg/,
-# search/, parallel strategies, or the analyzer itself:
+# Static-analysis sweep (ISSUE 4 + the FFA5xx perf passes of ISSUE 9),
+# mirroring verify_check.sh: the project AST linter, the
+# substitution-rule lint over the shipped collection, the analyzer CLI
+# over the bench Transformer (flat and 2-slice machines, --fail-on
+# error), and the analyzer test suites on CPU meshes of varying size —
+# seeded-defect PCGs (wrong reduction axis, degree-vs-devices mismatch,
+# cross-shard collective order, over-HBM views, unsound overlap
+# discount, overlap-schedule donation race, padding-bound shard,
+# slice-crossing ring, mis-degreed all-to-all) must each produce their
+# diagnostic code STATICALLY, and the clean searched zoo strategies
+# must produce zero errors. Use before touching pcg/, search/, parallel
+# strategies, or the analyzer itself:
 #
 #   scripts/analyze_check.sh                 # full sweep (8, 4-device)
 #   FF_ANALYZE_DEVICES=8 scripts/analyze_check.sh -k collective
@@ -17,7 +21,21 @@ echo "=== fflint: project AST rules over flexflow_tpu/ ==="
 python tools/fflint.py flexflow_tpu/
 
 echo "=== substitution-rule lint: shipped collection ==="
-env JAX_PLATFORMS=cpu python -m flexflow_tpu.analysis
+env JAX_PLATFORMS=cpu python -m flexflow_tpu.analysis --fail-on error
+
+echo "=== analyzer CLI: bench Transformer (CPU-sized), full pass stack ==="
+env JAX_PLATFORMS=cpu \
+    JAX_NUM_CPU_DEVICES=8 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m flexflow_tpu.analysis model --budget 2 --fail-on error
+
+echo "=== analyzer CLI: bench Transformer on the 2-slice machine ==="
+env JAX_PLATFORMS=cpu \
+    JAX_NUM_CPU_DEVICES=8 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m flexflow_tpu.analysis model --budget 2 \
+        --machine-model-file machine_config_multislice \
+        --fail-on error --json > /dev/null
 
 devices="${FF_ANALYZE_DEVICES:-8 4}"
 for n in $devices; do
@@ -25,5 +43,6 @@ for n in $devices; do
     env JAX_PLATFORMS=cpu \
         JAX_NUM_CPU_DEVICES="$n" \
         XLA_FLAGS="--xla_force_host_platform_device_count=$n" \
-        python -m pytest tests/test_analysis.py -v -p no:cacheprovider "$@"
+        python -m pytest tests/test_analysis.py tests/test_perf_analysis.py \
+        -v -p no:cacheprovider "$@"
 done

@@ -1,0 +1,567 @@
+"""Static performance analyzer tests (flexflow_tpu/analysis/perf.py +
+analysis/schedule.py): one seeded-defect PCG per FFA5xx code — unsound
+overlap discount (FFA501), a donation race in the overlapped executor
+schedule that the dynamic canary cannot deterministically catch
+(FFA502), a sharding-padded memory/padding-bound op (FFA503), a
+slice-crossing ring priced at flat ICI bandwidth (FFA504), and a
+mis-degreed all-to-all plus the unknown-collective-kind coverage
+warning (FFA505) — each caught STATICALLY; a clean searched-zoo sweep
+(incl. FSDP and overlapped-step configs) asserting zero FFA5xx errors;
+the explain_strategy() FFA5xx annotation join; the analyzer CLI's
+--json / --fail-on; and the fflint FFL103 host-sync rule."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import jax
+
+from flexflow_tpu import (
+    ActiMode,
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+    analyze_graph,
+    analyze_model,
+)
+from flexflow_tpu.analysis.diagnostics import AnalysisReport, Severity
+from flexflow_tpu.analysis.perf import perf_diagnostics
+from flexflow_tpu.analysis.schedule import (
+    ScheduleTask,
+    OverlapSchedule,
+    build_overlap_schedule,
+    schedule_race_diagnostics,
+)
+from flexflow_tpu.ff_types import OperatorType
+from flexflow_tpu.ops.elementwise import ElementUnaryParams
+from flexflow_tpu.ops.linear import LinearParams
+from flexflow_tpu.parallel.parallel_ops import (
+    AllToAllParams,
+    FusedParallelOpParams,
+    RepartitionParams,
+)
+from flexflow_tpu.pcg.graph import Graph
+from flexflow_tpu.pcg.machine_view import MachineView
+from flexflow_tpu.pcg.op import PCGOp
+from flexflow_tpu.pcg.parallel_tensor import ParallelTensor, make_dims
+from flexflow_tpu.search import CostModel, MachineModel
+from flexflow_tpu.search.network import (
+    TopologyAwareMachineModel,
+    TorusTopology,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------------------
+# graph-building helpers (no compile, no devices)
+# ----------------------------------------------------------------------
+def pt(sizes, degrees=None, dtype=DataType.DT_FLOAT):
+    return ParallelTensor(dims=make_dims(sizes, degrees), data_type=dtype)
+
+
+def add_op(graph, op_type, params, inputs, out, view=None):
+    op = PCGOp(op_type, params, inputs)
+    out.owner_op = op
+    op.outputs.append(out)
+    op.machine_view = view
+    graph.add_op(op)
+    return op
+
+
+def give_weight(op, sizes, degrees=None, name="kernel"):
+    w = pt(sizes, degrees)
+    w.owner_op = op
+    op.weights.append(w)
+    op.weight_names.append(name)
+    return w
+
+
+def view_over(start, n):
+    return MachineView(start_device_id=start, dim=(n,), stride=(1,))
+
+
+def overlap_cost_model(workers=8, **kw):
+    return CostModel(MachineModel(num_nodes=1, workers_per_node=workers),
+                     overlap_backward_update=True, **kw)
+
+
+def dp_linear_graph(out_channels=4096, in_features=1024, parts=4):
+    """One data-parallel Linear with a big replicated weight: its grad
+    sync is real, but it is the topologically FIRST op — its backward
+    runs LAST, so NO backward compute can hide its collective."""
+    g = Graph()
+    x = pt([32, in_features], [parts, 1])
+    out = pt([32, out_channels], [parts, 1])
+    op = add_op(g, OperatorType.OP_LINEAR, LinearParams(out_channels),
+                [x], out, view=view_over(0, parts))
+    give_weight(op, [in_features, out_channels])
+    return g, op
+
+
+# ----------------------------------------------------------------------
+# FFA501 — overlap-discount soundness
+# ----------------------------------------------------------------------
+def test_ffa501_flags_unhideable_discount():
+    """Seeded defect: the search discounts the only weight's grad sync,
+    but zero backward compute is schedulable behind it — the simulated
+    step time omits the full collective (the search lied to itself)."""
+    g, _ = dp_linear_graph()
+    rep = perf_diagnostics(g, cost_model=overlap_cost_model())
+    errs = [d for d in rep.by_code("FFA501")
+            if d.severity is Severity.ERROR]
+    assert errs, rep.summary()
+    assert "exposed" in errs[0].message
+    warns = [d for d in rep.by_code("FFA501")
+             if d.severity is Severity.WARNING]
+    assert warns and "exposed-time delta" in warns[0].message
+
+
+def test_ffa501_silent_without_discount_and_with_real_window():
+    g, _ = dp_linear_graph()
+    # overlap off: nothing was discounted, nothing to audit
+    cm = CostModel(MachineModel(num_nodes=1, workers_per_node=8))
+    assert not perf_diagnostics(g, cost_model=cm).by_code("FFA501")
+    # a deep stack of compute UPSTREAM of the weight op gives the sync a
+    # real window: those ops' backward runs AFTER the weight's backward
+    # produces its gradient, so the collective hides behind it
+    g2 = Graph()
+    relu_in = pt([32, 4096], [4, 1])
+    for _ in range(128):
+        out = pt([32, 4096], [4, 1])
+        add_op(g2, OperatorType.OP_RELU,
+               ElementUnaryParams(op_type=OperatorType.OP_RELU),
+               [relu_in], out, view=view_over(0, 4))
+        relu_in = out
+    out = pt([32, 4096], [4, 1])
+    op = add_op(g2, OperatorType.OP_LINEAR, LinearParams(4096),
+                [relu_in], out, view=view_over(0, 4))
+    give_weight(op, [4096, 4096])
+    rep = perf_diagnostics(g2, cost_model=overlap_cost_model())
+    assert not [d for d in rep.by_code("FFA501")
+                if d.severity is Severity.ERROR], rep.summary()
+
+
+def test_ffa501_flags_discount_on_fsdp_owned_sync():
+    """Divergence class: the per-op cost model discounts a sync that the
+    structural proof (overlappable_grad_syncs) EXCLUDES because an FSDP
+    WeightShard owns its reduce-scatter — the overlapped simulator keeps
+    it serial while the op cost hides it."""
+    from flexflow_tpu.parallel.weight_sharding import insert_weight_shard
+
+    g, op = dp_linear_graph()
+    # shard the weight 2-way under a 4-part view: 2 replicas still sync
+    insert_weight_shard(g, op, degree=2)
+    rep = perf_diagnostics(g, cost_model=overlap_cost_model())
+    errs = [d for d in rep.by_code("FFA501")
+            if d.severity is Severity.ERROR]
+    assert any("NOT statically overlappable" in d.message for d in errs), \
+        rep.summary()
+
+
+# ----------------------------------------------------------------------
+# FFA502 — overlap schedule races
+# ----------------------------------------------------------------------
+def overlapped_schedule():
+    g, op = dp_linear_graph()
+    return g, op, build_overlap_schedule(g, {(op.name, "kernel")})
+
+
+def test_ffa502_clean_builder_schedule():
+    _, op, sched = overlapped_schedule()
+    kinds = {t.kind for t in sched}
+    assert {"backward", "reduce_scatter", "update",
+            "all_gather", "barrier"} <= kinds
+    rep = schedule_race_diagnostics(sched)
+    assert rep.ok, rep.summary()
+
+
+def test_ffa502_flags_update_before_reduce_scatter_completes():
+    """Seeded defect: drop the update's dependency on the pending
+    reduce-scatter — it reads a half-reduced gradient shard."""
+    _, op, sched = overlapped_schedule()
+    bad = sched.replace(f"update:{op.name}.kernel", after=())
+    rep = schedule_race_diagnostics(bad)
+    assert rep.by_code("FFA502"), rep.summary()
+    assert any("still be in flight" in d.message for d in rep.errors)
+
+
+def test_ffa502_flags_unfenced_all_gather_at_step_end():
+    """Seeded defect: the step returns params without a completion edge
+    on the param all-gather — the next step can read a half-gathered
+    buffer. The dynamic canary only catches this when the race loses."""
+    _, op, sched = overlapped_schedule()
+    bad = sched.replace("step_end", after=())
+    rep = schedule_race_diagnostics(bad)
+    assert any(d.code == "FFA502" and "param_next" in d.message
+               for d in rep.errors), rep.summary()
+
+
+def test_ffa502_flags_tied_weight_donation_race():
+    """Seeded defect: two ops share one weight tensor; the downstream
+    op's all-gather DONATES the shared param storage while the upstream
+    op's backward (which runs later) still reads it."""
+    g = Graph()
+    x = pt([32, 64], [4, 1])
+    h = pt([32, 64], [4, 1])
+    op1 = add_op(g, OperatorType.OP_LINEAR, LinearParams(64), [x], h,
+                 view=view_over(0, 4))
+    w = give_weight(op1, [64, 64])
+    out = pt([32, 64], [4, 1])
+    op2 = add_op(g, OperatorType.OP_LINEAR, LinearParams(64), [h], out,
+                 view=view_over(0, 4))
+    op2.weights.append(w)  # tied: SAME tensor, shared storage
+    op2.weight_names.append("kernel")
+    sched = build_overlap_schedule(g, {(op2.name, "kernel")})
+    rep = schedule_race_diagnostics(sched)
+    races = [d for d in rep.by_code("FFA502")
+             if "donation race" in d.message or "donates" in d.message]
+    assert races, rep.summary()
+
+
+def test_ffa502_flags_dangling_dependency():
+    sched = OverlapSchedule([
+        ScheduleTask(name="a", kind="backward", writes=("g",)),
+        ScheduleTask(name="b", kind="update", reads=("g",),
+                     after=("ghost",)),
+    ])
+    rep = schedule_race_diagnostics(sched)
+    assert any("unknown task" in d.message for d in rep.by_code("FFA502"))
+
+
+# ----------------------------------------------------------------------
+# FFA503 — sharding-induced padding / roofline
+# ----------------------------------------------------------------------
+def test_ffa503_flags_padded_shard_and_names_fix_degree():
+    g = Graph()
+    x = pt([32, 512])
+    out = pt([32, 256], [1, 4])  # 64-wide shards each pad to a 128 tile
+    op = add_op(g, OperatorType.OP_LINEAR, LinearParams(256), [x], out)
+    give_weight(op, [512, 256], [1, 4])
+    rep = perf_diagnostics(g, cost_model=CostModel(MachineModel()))
+    hits = rep.by_code("FFA503")
+    assert hits, rep.summary()
+    assert all(d.severity is Severity.WARNING for d in hits)
+    assert "degree 4 -> 2" in hits[0].fix_hint
+    assert "128" in hits[0].fix_hint
+
+
+def test_ffa503_silent_on_tile_aligned_sharding():
+    g = Graph()
+    x = pt([32, 512])
+    out = pt([32, 256], [1, 2])  # 128-wide shards: no padding added
+    op = add_op(g, OperatorType.OP_LINEAR, LinearParams(256), [x], out)
+    give_weight(op, [512, 256], [1, 2])
+    rep = perf_diagnostics(g, cost_model=CostModel(MachineModel()))
+    assert not rep.by_code("FFA503"), rep.summary()
+
+
+# ----------------------------------------------------------------------
+# FFA504 — slice-boundary collective pricing
+# ----------------------------------------------------------------------
+def cross_slice_graph():
+    g = Graph()
+    x = pt([32, 16])
+    out = pt([32, 16], [4, 1])
+    add_op(g, OperatorType.OP_REPARTITION, RepartitionParams(0, 4),
+           [x], out, view=view_over(2, 4))  # devices 2..5 span 2 slices
+    return g
+
+
+def test_ffa504_flags_flat_priced_cross_slice_ring():
+    g = cross_slice_graph()
+    flat = MachineModel(num_nodes=2, workers_per_node=4)
+    rep = perf_diagnostics(g, machine=flat)
+    hits = rep.by_code("FFA504")
+    assert hits, rep.summary()
+    assert "flat machine model" in hits[0].message
+    assert "machine_model_version" in hits[0].fix_hint
+
+
+def test_ffa504_hierarchical_machine_prices_it_no_flat_warning():
+    g = cross_slice_graph()
+    topo = TopologyAwareMachineModel(
+        num_nodes=2, workers_per_node=4, topology=TorusTopology(dims=(4,))
+    )
+    rep = perf_diagnostics(g, machine=topo)
+    assert not [d for d in rep.by_code("FFA504")
+                if d.severity is Severity.WARNING], rep.summary()
+
+
+def test_ffa504_reports_multi_hop_ring_under_topology_model():
+    g = Graph()
+    x = pt([32, 16])
+    out = pt([32, 16], [4, 1])
+    # strided view: ring neighbors are 2 hops apart on a 1-D torus
+    add_op(g, OperatorType.OP_REPARTITION, RepartitionParams(0, 4),
+           [x], out,
+           view=MachineView(start_device_id=0, dim=(4,), stride=(2,)))
+    topo = TopologyAwareMachineModel(
+        num_nodes=1, workers_per_node=8, topology=TorusTopology(dims=(8,))
+    )
+    rep = perf_diagnostics(g, machine=topo)
+    infos = [d for d in rep.by_code("FFA504")
+             if d.severity is Severity.INFO]
+    assert infos and "hops" in infos[0].message, rep.summary()
+
+
+# ----------------------------------------------------------------------
+# FFA505 — all-to-all coverage + unknown-kind bugfix
+# ----------------------------------------------------------------------
+def a2a_graph(degree=4, gather_degree=2):
+    g = Graph()
+    x = pt([8, 16, 32], [1, gather_degree, 1])
+    out = pt([8, 16, 32], [1, 1, degree])
+    add_op(g, OperatorType.OP_ALL_TO_ALL,
+           AllToAllParams(scatter_dim=2, gather_dim=1, degree=degree),
+           [x], out)
+    return g
+
+
+def test_ffa505_flags_degree_vs_input_sharding_mismatch():
+    rep = analyze_graph(a2a_graph(degree=4, gather_degree=2),
+                        passes=("collectives",))
+    errs = rep.by_code("FFA505")
+    assert errs and errs[0].severity is Severity.ERROR, rep.summary()
+    assert "degree=2" in errs[0].fix_hint
+
+
+def test_ffa505_clean_on_consistent_all_to_all():
+    rep = analyze_graph(a2a_graph(degree=2, gather_degree=2),
+                        passes=("collectives",))
+    assert not rep.by_code("FFA505"), rep.summary()
+
+
+def test_all_to_all_bytes_exported_under_all_to_all_kind():
+    from flexflow_tpu.analysis.collectives import estimate_collective_bytes
+
+    recs = estimate_collective_bytes(a2a_graph(degree=2, gather_degree=2))
+    assert len(recs) == 1
+    assert recs[0]["kind"] == "all_to_all"
+    # 8*16*32 f32 elements, (p-1)/p with p=2
+    assert recs[0]["bytes"] == 8 * 16 * 32 * 4 // 2
+    assert recs[0]["parts"] == 2
+
+
+def test_unknown_collective_kind_is_typed_warning_not_silent_skip():
+    from flexflow_tpu.analysis.collectives import estimate_collective_bytes
+
+    g = Graph()
+    x = pt([8, 16])
+    out = pt([8, 16])
+    add_op(g, OperatorType.OP_FUSED_PARALLEL,
+           FusedParallelOpParams(stages=()), [x], out)
+    rep = AnalysisReport()
+    recs = estimate_collective_bytes(g, report=rep)
+    assert recs == []
+    hits = rep.by_code("FFA505")
+    assert hits and hits[0].severity is Severity.WARNING
+    assert "missing from" in hits[0].message
+    # the collectives pass reports it too (fit(lint=...) visibility)
+    rep2 = analyze_graph(g, passes=("collectives",))
+    assert rep2.by_code("FFA505")
+
+
+# ----------------------------------------------------------------------
+# clean searched-zoo sweep: zero FFA5xx errors end to end
+# ----------------------------------------------------------------------
+def searched_mlp(**cfg_overrides):
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    cfg.search_budget = 4
+    for k, v in cfg_overrides.items():
+        setattr(cfg, k, v)
+    m = FFModel(cfg)
+    x = m.create_tensor((32, 16), DataType.DT_FLOAT)
+    t = m.dense(x, 32, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, 32, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, 3)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.1),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    return m
+
+
+@pytest.mark.parametrize("overrides", [
+    {},                                          # plain searched
+    {"search_overlap_backward_update": True},    # searched WITH discount
+    {"only_data_parallel": True},                # overlapped-step (DP)
+    {"fsdp_degree": 2},                          # FSDP weight sharding
+], ids=["searched", "overlap-discount", "overlapped-dp", "fsdp"])
+def test_clean_zoo_zero_ffa5xx_errors(overrides):
+    if overrides.get("fsdp_degree") and len(jax.devices()) < 4:
+        pytest.skip("fsdp config needs >= 4 devices")
+    m = searched_mlp(**overrides)
+    rep = analyze_model(m)
+    ffa5_errors = [d for d in rep.errors if d.code.startswith("FFA5")]
+    assert ffa5_errors == [], rep.summary()
+    assert rep.ok, rep.summary()
+
+
+def test_executor_overlap_schedule_hook_is_clean():
+    """The live executor's own schedule description (the introspection
+    hook) must be race-free — and present when the overlapped DP path is
+    actually armed."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a data-parallel mesh")
+    m = searched_mlp(only_data_parallel=True)
+    sched = m.executor.overlap_schedule()
+    assert sched is not None and len(sched) > 0
+    rep = schedule_race_diagnostics(sched)
+    assert rep.ok, rep.summary()
+    # flipping the knob off removes the schedule (matches the jitted step)
+    m.executor.set_overlap_grad_sync(False)
+    assert m.executor.overlap_schedule() is None
+
+
+# ----------------------------------------------------------------------
+# explain_strategy() carries FFA5xx annotations
+# ----------------------------------------------------------------------
+def test_explain_strategy_annotates_flagged_ops():
+    from flexflow_tpu.obs import explain_strategy
+
+    m = searched_mlp()
+    # seed a padding defect the perf pass will flag on a ranked op: the
+    # annotation join is by op guid, independent of execution
+    dense = [op for op in m.graph.ops
+             if op.op_type == OperatorType.OP_LINEAR][0]
+    for d in dense.outputs[0].dims[1:]:
+        d.degree = 4  # 32-wide channel dim -> 8-wide padded shards
+    exp = explain_strategy(m, repeats=1, warmup=0)
+    row = next(r for r in exp.rows if r["name"] == dense.name)
+    codes = {d["code"] for d in row["diagnostics"]}
+    assert "FFA503" in codes, row
+    assert any("FFA503" in w["diagnostics"]
+               for w in exp.worklist(len(exp.rows)))
+    assert "FFA503" in exp.summary(len(exp.rows))
+
+
+# ----------------------------------------------------------------------
+# CLI: --json and --fail-on
+# ----------------------------------------------------------------------
+def _rule_json(dst_combine_degree):
+    return {"rule": [{
+        "name": "cli_rule",
+        "srcOp": [{"type": "OP_LINEAR",
+                   "input": [{"opId": -1, "tsId": 0}], "para": []}],
+        "dstOp": [
+            {"type": "OP_PARTITION", "input": [{"opId": -1, "tsId": 0}],
+             "para": [{"key": "PM_PARALLEL_DIM", "value": 0},
+                      {"key": "PM_PARALLEL_DEGREE", "value": 2}]},
+            {"type": "OP_LINEAR", "input": [{"opId": 0, "tsId": 0}],
+             "para": []},
+            {"type": "OP_COMBINE", "input": [{"opId": 1, "tsId": 0}],
+             "para": [{"key": "PM_PARALLEL_DIM", "value": 0},
+                      {"key": "PM_PARALLEL_DEGREE",
+                       "value": dst_combine_degree}]},
+        ],
+        "mappedOutput": [{"srcOpId": 0, "srcTsId": 0,
+                          "dstOpId": 2, "dstTsId": 0}],
+    }]}
+
+
+def test_cli_json_and_fail_on(tmp_path, capsys):
+    from flexflow_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_rule_json(4)))
+    assert main(["rules", str(bad), "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["command"] == "rules" and out["errors"] >= 1
+    assert out["files"][0]["diagnostics"][0]["code"].startswith("FFA")
+    # a WARNING-only report passes --fail-on error but fails on warning
+    warn_rule = _rule_json(2)
+    warn_rule["rule"][0]["dstOp"][1]["type"] = "OP_NOT_A_REAL_TYPE"
+    warn = tmp_path / "warn.json"
+    warn.write_text(json.dumps(warn_rule))
+    assert main(["rules", str(warn)]) == 0
+    capsys.readouterr()
+    assert main(["rules", str(warn), "--fail-on", "warning"]) == 1
+
+
+def test_cli_model_command_json_clean():
+    """Acceptance: the CLI compiles the (CPU-sized) bench Transformer,
+    runs the full pass stack incl. FFA5xx, and exits clean."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "flexflow_tpu.analysis", "model",
+         "--json", "--fail-on", "error", "--budget", "2",
+         "--layers", "1", "--seq", "16", "--hidden", "32", "--heads", "2"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["command"] == "model"
+    assert out["errors"] == 0
+    assert isinstance(out["diagnostics"], list)
+
+
+# ----------------------------------------------------------------------
+# fflint FFL103 — host sync on the step path
+# ----------------------------------------------------------------------
+sys.path.insert(0, os.path.join(REPO, "tools"))
+from fflint import lint_source  # noqa: E402
+
+
+def _codes(src, path):
+    return [f.code for f in lint_source(src, path)]
+
+
+STEP_SRC = (
+    "def build(self):\n"
+    "    host = np.asarray(jax.device_get(w), dtype=float)  "
+    "# fflint: disable=FFL101\n"
+    "    def step(state, bx):\n"
+    "        jax.block_until_ready(state)\n"
+    "        return np.asarray(bx)\n"
+    "    return step\n"
+)
+
+
+def test_ffl103_flags_host_sync_in_step_path_only():
+    hits = _codes(STEP_SRC, "/x/flexflow_tpu/parallel/executor2.py")
+    assert hits.count("FFL103") == 2
+    # same code outside parallel//kernels/ is exempt
+    assert "FFL103" not in _codes(STEP_SRC, "/x/flexflow_tpu/runtime/r.py")
+    # build-time code in the scoped modules is exempt (innermost fn rule)
+    assert "FFL103" not in _codes(
+        "def init_params(self):\n    a = np.asarray(jax.device_get(w), "
+        "dtype=float)  # fflint: disable=FFL101\n",
+        "/x/flexflow_tpu/parallel/executor2.py")
+
+
+def test_ffl103_kernel_scope_and_pragma():
+    src = (
+        "def attn_kernel(refs):\n"
+        "    q = np.asarray(refs)\n"
+        "def helper(refs):\n"
+        "    q = np.asarray(refs)\n"
+    )
+    hits = lint_source(src, "/x/flexflow_tpu/kernels/k.py")
+    assert [f.code for f in hits] == ["FFL103"] and hits[0].line == 2
+    suppressed = src.replace("q = np.asarray(refs)\n",
+                             "q = np.asarray(refs)  "
+                             "# fflint: disable=FFL103\n", 1)
+    assert "FFL103" not in _codes(suppressed,
+                                  "/x/flexflow_tpu/kernels/k.py")
+
+
+def test_ffl103_rule_listed_and_tree_clean():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fflint.py"),
+         "--list-rules"],
+        capture_output=True, text=True,
+    )
+    assert "FFL103" in proc.stdout
+    tree = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fflint.py"),
+         os.path.join(REPO, "flexflow_tpu")],
+        capture_output=True, text=True,
+    )
+    assert tree.returncode == 0, tree.stdout + tree.stderr

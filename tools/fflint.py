@@ -26,6 +26,16 @@ FFL102  reuse of a donated state after a donated step call
         `build_train_step()` callable (donating by default) is dead
         after the call; reading it again observes reused buffers.
         Rebind it from the step's return value first.
+FFL103  host-sync call inside a step-path function of parallel/ or
+        kernels/ modules
+        The per-step dispatch path (the traced `step`/`loss_of`/...
+        closures and the `*_kernel` bodies) must never synchronize with
+        the host: `block_until_ready` / `jax.device_get` stall the
+        async dispatch queue (the Perfetto traces show the step pipeline
+        draining), and `np.asarray`/`np.array` on a traced value either
+        raises under jit or, on concrete per-step values, forces a
+        device->host round-trip per step. Hoist host reads out of the
+        step path, or pragma genuinely host-side helpers.
 FFL201  bare `print()` inside flexflow_tpu/ library code
         Historical: fit/eval reported progress via bare print()s —
         invisible to telemetry, unredirectable, and uncapturable. Route
@@ -60,6 +70,9 @@ RULES = {
     "FFL101": "np.asarray/np.array without copy=True on "
               "jax.device_get(...) output",
     "FFL102": "donated train-step input read again after the step call",
+    "FFL103": "host-sync call (block_until_ready / jax.device_get / "
+              "np.asarray) inside a step-path function of parallel/ or "
+              "kernels/",
     "FFL201": "bare print() in flexflow_tpu/ library code (use "
               "flexflow_tpu.obs.progress; __main__ modules exempt)",
 }
@@ -234,6 +247,73 @@ def _check_donated_reuse(tree: ast.AST, path: str,
 
 
 # ----------------------------------------------------------------------
+# FFL103 — host sync on the step path
+# ----------------------------------------------------------------------
+# The traced / per-step-dispatch closures of the executor and the Pallas
+# kernel bodies. A call is attributed to its INNERMOST enclosing
+# function: build-time code in `build_decode` stays exempt while the
+# `step` closure it returns is covered.
+_STEP_PATH_NAMES = frozenset({
+    "step", "loss_of", "grad_of", "fwd", "body", "run", "multi",
+})
+
+
+def _is_step_path_fn(name: str) -> bool:
+    return (name in _STEP_PATH_NAMES or name.endswith("_step")
+            or name.startswith("step_") or name.endswith("_kernel"))
+
+
+def _in_step_path_module(path: str) -> bool:
+    parts = os.path.normpath(os.path.abspath(path)).split(os.sep)
+    if "flexflow_tpu" not in parts[:-1]:
+        return False
+    return "parallel" in parts[:-1] or "kernels" in parts[:-1]
+
+
+def _walk_innermost_fn(node: ast.AST, fn_name: str = ""):
+    """Yield (node, innermost enclosing function name) pairs."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield child, fn_name
+            yield from _walk_innermost_fn(child, child.name)
+        else:
+            yield child, fn_name
+            yield from _walk_innermost_fn(child, fn_name)
+
+
+def _host_sync_reason(call: ast.Call) -> str:
+    fn = _dotted(call.func)
+    leaf = fn.split(".")[-1]
+    root = fn.split(".")[0]
+    if leaf == "block_until_ready":
+        return f"{fn}() blocks the host until the device drains"
+    if leaf == "device_get":
+        return f"{fn}() is a device->host transfer"
+    if leaf in ("asarray", "array") and root in ("np", "numpy"):
+        return (f"{fn}() on a device value forces a host round-trip "
+                "(or raises under jit)")
+    return ""
+
+
+def _check_step_path_sync(tree: ast.AST, path: str,
+                          findings: List[Finding]) -> None:
+    if not _in_step_path_module(path):
+        return
+    for node, fn_name in _walk_innermost_fn(tree):
+        if not isinstance(node, ast.Call) or not _is_step_path_fn(fn_name):
+            continue
+        reason = _host_sync_reason(node)
+        if reason:
+            findings.append(Finding(
+                path, node.lineno, node.col_offset, "FFL103",
+                f"host sync inside step-path function `{fn_name}`: "
+                f"{reason}; hoist it out of the per-step path "
+                "(historical: per-step host syncs serialized async "
+                "dispatch and flattened bench throughput)",
+            ))
+
+
+# ----------------------------------------------------------------------
 # FFL201 — bare print() in library code
 # ----------------------------------------------------------------------
 def _in_flexflow_tpu(path: str) -> bool:
@@ -271,6 +351,7 @@ def lint_source(source: str, path: str) -> List[Finding]:
     _check_excepts(tree, path, findings)
     _check_asarray(tree, path, findings)
     _check_donated_reuse(tree, path, findings)
+    _check_step_path_sync(tree, path, findings)
     _check_prints(tree, path, findings)
     pragmas = _pragmas(source)
     file_off: Set[str] = set()
