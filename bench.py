@@ -50,11 +50,13 @@ def wait_for_backend(max_wait_s: float = 600.0) -> None:
 
 
 def read_baseline(metric: str):
-    """The throughput this round is compared against (the vs_baseline
+    """(value, source) this round is compared against (the vs_baseline
     field): a published number in BASELINE.json if the driver recorded
     one, else the first measured round (BENCH_r01.json) — the north-star
     file documents configurations, not numbers, so round 1 is the
-    de-facto baseline of this build."""
+    de-facto baseline of this build. The source rides along in the JSON
+    line so a null/odd vs_baseline is diagnosable from the artifact
+    alone."""
     here = os.path.dirname(os.path.abspath(__file__))
     try:
         with open(os.path.join(here, "BASELINE.json")) as f:
@@ -62,17 +64,17 @@ def read_baseline(metric: str):
         for key in (metric, "transformer_train_throughput"):
             v = published.get(key)
             if isinstance(v, (int, float)) and v > 0:
-                return float(v)
+                return float(v), f"BASELINE.json:published.{key}"
     except (OSError, ValueError):
         pass
     try:
         with open(os.path.join(here, "BENCH_r01.json")) as f:
             v = json.load(f).get("parsed", {}).get("value")
         if isinstance(v, (int, float)) and v > 0:
-            return float(v)
+            return float(v), "BENCH_r01.json"
     except (OSError, ValueError):
         pass
-    return None
+    return None, None
 
 
 def phase_breakdown(model, x, y, key, *, repeats: int, fetch):
@@ -236,7 +238,7 @@ def main():
         print(f"bench: phase breakdown failed: {e}", file=sys.stderr)
         phases = None
 
-    baseline = read_baseline("transformer_train_throughput")
+    baseline, baseline_source = read_baseline("transformer_train_throughput")
     print(
         json.dumps(
             {
@@ -248,6 +250,7 @@ def main():
                     if baseline else None
                 ),
                 "baseline": baseline,
+                "baseline_source": baseline_source,
                 "phases_s_per_step": phases,
             }
         )

@@ -6,6 +6,10 @@ hide behind backward compute, and by running an overlapped
 reduce-scatter / sharded-update / all-gather step with donated buffers.
 These passes audit the CHOSEN strategy before it executes:
 
+  * FFA500 — oracle provenance (INFO): when the audited cost model is
+    calibrated from measurement (obs/calibration.py store or an
+    in-process profile), one line names the source so FFA501/FFA503
+    numbers are read as measured, not analytic.
   * FFA501 — overlap-discount soundness: recompute the statically
     hideable backward-compute window behind every discounted collective
     (analysis/collectives.hideable_backward_compute) and flag discounts
@@ -86,6 +90,7 @@ def perf_diagnostics(
     if machine is None and cost_model is not None:
         machine = cost_model.machine
     if cost_model is not None:
+        _oracle_provenance_diagnostic(cost_model, rep)
         _overlap_discount_diagnostics(graph, views, cost_model, rep)
     _padding_roofline_diagnostics(graph, views, machine, rep)
     if machine is not None:
@@ -97,6 +102,30 @@ def perf_diagnostics(
 
             rep.extend(schedule_race_diagnostics(sched))
     return rep
+
+
+# ----------------------------------------------------------------------
+# oracle provenance (calibrated vs analytic)
+# ----------------------------------------------------------------------
+def _oracle_provenance_diagnostic(cost_model, rep: AnalysisReport) -> None:
+    """One INFO line naming the oracle every FFA5xx verdict below was
+    judged against. When a calibration store / profiled table is
+    attached (obs.explain.attach_profiled_costs), the overlap and
+    roofline numbers come from MEASURED per-op seconds, not the analytic
+    roofline — a reader triaging an FFA501 error needs to know which."""
+    source = getattr(cost_model, "calibration_source", None)
+    if source is None:
+        return
+    prov = (cost_model.provenance() if hasattr(cost_model, "provenance")
+            else {"source": source})
+    n = prov.get("measured_ops", len(getattr(cost_model, "measured", ())))
+    rep.add(
+        Severity.INFO, "FFA500",
+        f"cost oracle is calibrated from {source} ({n} measured op "
+        "entr" + ("y" if n == 1 else "ies") + "); serial-view op costs "
+        "below are measured seconds, sharded views fall back to the "
+        "analytic roofline",
+    )
 
 
 # ----------------------------------------------------------------------

@@ -705,6 +705,7 @@ class FFModel:
         loss_type=None,
         metrics: Sequence = (),
         comp_mode: CompMode = CompMode.COMP_MODE_TRAINING,
+        calibration=None,
     ):
         if optimizer is not None:
             self.optimizer = optimizer
@@ -714,6 +715,19 @@ class FFModel:
         self.loss_type = to_loss_type(loss_type)
         self.comp_mode = comp_mode
         self.metrics_obj = Metrics(self.loss_type, metrics)
+        # Persisted cost calibration (obs/calibration.py): an explicit
+        # store/path — or the active telemetry session's store — resolves
+        # to measured per-op (fwd, bwd) costs + cost-model globals BEFORE
+        # the strategy search, so MCMC/DP price ops from measurement.
+        # Rejected (stale/mismatched/empty) stores resolve to nothing and
+        # the analytic roofline stands.
+        from ..obs.calibration import resolve_calibration
+
+        calib_table, calib_globals = resolve_calibration(calibration)
+        if calib_table is not None and len(calib_table):
+            self._profiled_op_costs = calib_table
+        if calib_globals:
+            self._calibration_globals = calib_globals
         # Every compile records what it did (phase timings + every search
         # decision) into a bounded in-memory trajectory; fit(telemetry=)
         # replays it into the event log and obs.explain_strategy joins it
@@ -923,13 +937,19 @@ class FFModel:
         profiled = getattr(self, "_profiled_op_costs", None)
         if profiled:
             # explain_strategy(...).apply(model) fed real on-device op
-            # timings back: serial-view costs resolve to those
+            # timings back — or compile(calibration=...) loaded a
+            # persisted store: serial-view costs resolve to those
             # measurements instead of the analytic roofline (the
             # --measured-search attach below, if enabled, supersedes
             # this with proper per-shard measurement)
             from ..obs.explain import attach_profiled_costs
 
             attach_profiled_costs(cm, profiled)
+        glb = getattr(self, "_calibration_globals", None)
+        if glb and glb.get("overlap_efficiency") is not None:
+            # the store's measured overlap efficiency overrides the
+            # shipped calibration's for the discount soundness math
+            cm.overlap_efficiency = float(glb["overlap_efficiency"])
         return cm
 
     def _run_strategy_search(self, ndev: int):

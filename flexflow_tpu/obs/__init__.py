@@ -30,7 +30,15 @@ import contextlib
 import sys
 from typing import Optional
 
+from .calibration import CalibrationStore, resolve_calibration  # noqa: F401
 from .metrics import MetricsRegistry, parse_prometheus  # noqa: F401
+from .request_trace import (  # noqa: F401
+    NULL_REQUEST_TRACE,
+    RequestTrace,
+    SLOMonitor,
+    mint_request_trace,
+    record_request_stages,
+)
 from .telemetry import Telemetry, TelemetryConfig  # noqa: F401
 from .tracer import (  # noqa: F401
     NULL_TRACER,

@@ -1,20 +1,28 @@
 """Telemetry artifact CLI.
 
 Usage:
-    python -m flexflow_tpu.obs trace   <events.jsonl> [-o trace.json]
-    python -m flexflow_tpu.obs summary <events.jsonl>
-    python -m flexflow_tpu.obs prom    <metrics.jsonl> [-o metrics.prom]
-    python -m flexflow_tpu.obs explain [--top N] [model shape flags]
+    python -m flexflow_tpu.obs trace    <events.jsonl> [-o trace.json]
+    python -m flexflow_tpu.obs summary  <events.jsonl>
+    python -m flexflow_tpu.obs prom     <metrics.jsonl> [-o metrics.prom]
+    python -m flexflow_tpu.obs requests <events.jsonl> [--slowest K]
+    python -m flexflow_tpu.obs explain  [--top N] [model shape flags]
+    python -m flexflow_tpu.obs calibrate inspect <store.json>
+    python -m flexflow_tpu.obs calibrate prune   <store.json> --max-age-h H
+    python -m flexflow_tpu.obs calibrate diff    <a.json> <b.json>
 
 ``trace`` converts a structured event log to Chrome-trace JSON (open at
 https://ui.perfetto.dev). ``summary`` schema-validates the log and
 prints per-category/event counts plus step/search aggregates.
 ``prom`` re-renders the last metrics.jsonl snapshot as Prometheus text.
-``explain`` compiles the benchmark Transformer (CPU-sized by default;
-pass --seq/--hidden/... for the real bench shape on a TPU host), joins
-the cost model against on-device profile_ops measurements and prints
-the miscalibrated-op kernel worklist — each perf round starts from this
-list (docs/performance.md).
+``requests`` reconstructs per-request lifecycles from the serving
+flight recorder's events (cat "requests"): stage breakdown, top-K
+slowest, shed and requeue causes. ``explain`` compiles the benchmark
+Transformer (CPU-sized by default; pass --seq/--hidden/... for the real
+bench shape on a TPU host), joins the cost model against on-device
+profile_ops measurements and prints the miscalibrated-op kernel
+worklist — each perf round starts from this list (docs/performance.md).
+``calibrate`` inspects/maintains a persistent cost-model calibration
+store (obs/calibration.py).
 
 This module is a CLI entry point: bare print() is its job (fflint FFL201
 allowlists __main__ modules).
@@ -26,7 +34,7 @@ import json
 import sys
 from collections import Counter
 
-from .tracer import read_events_jsonl, to_chrome_trace
+from .tracer import lanes_from_events, read_events_jsonl, to_chrome_trace
 
 
 def _cmd_trace(args) -> int:
@@ -35,7 +43,8 @@ def _cmd_trace(args) -> int:
         print(f"warning: {p}", file=sys.stderr)
     out = args.output or "trace.json"
     with open(out, "w") as f:
-        json.dump(to_chrome_trace(events), f)
+        json.dump(to_chrome_trace(events,
+                                  lane_names=lanes_from_events(events)), f)
     print(f"wrote {out}: {len(events)} event(s) "
           f"({len(problems)} malformed line(s) skipped)")
     return 0
@@ -99,6 +108,137 @@ def _cmd_prom(args) -> int:
     return 0
 
 
+def _cmd_requests(args) -> int:
+    from .request_trace import REQUEST_CAT
+
+    events, problems = read_events_jsonl(args.events)
+    for p in problems:
+        print(f"warning: {p}", file=sys.stderr)
+    lanes = {tid: name for (cat, name), tid
+             in lanes_from_events(events).items() if cat == REQUEST_CAT}
+    reqs: dict = {}
+    for e in events:
+        if e.get("cat") != REQUEST_CAT:
+            continue
+        rid = e.get("args", {}).get("request")
+        if rid is None:
+            continue  # lane metadata etc.
+        reqs.setdefault(rid, []).append(e)
+    if not reqs:
+        print(f"{args.events}: no request events (cat={REQUEST_CAT!r}); "
+              "was the session started with request_sample_rate > 0?")
+        return 1
+    rows = []
+    shed_causes: Counter = Counter()
+    requeues = 0
+    for rid, evs in reqs.items():
+        stages = {"queue": 0.0, "prefill": 0.0, "decode": 0.0}
+        replicas = set()
+        sheds = []
+        gens = []
+        tokens = None
+        done = False
+        for e in evs:
+            name, a = e["name"], e.get("args", {})
+            if e["ph"] == "X" and name in stages:
+                stages[name] += float(e.get("dur", 0.0))
+            if name == "shed":
+                sheds.append((a.get("reason"), a.get("stage")))
+                shed_causes[a.get("reason")] += 1
+            elif name == "requeue":
+                gens.append(a.get("generation"))
+            elif name == "complete":
+                done = True
+                tokens = a.get("tokens")
+            tid = int(e.get("tid", 0))
+            if tid in lanes and lanes[tid] != "admission":
+                replicas.add(lanes[tid])
+        requeues += len(gens)
+        ts = [float(e["ts"]) for e in evs]
+        spans = [float(e["ts"]) + float(e.get("dur", 0.0)) for e in evs]
+        rows.append({
+            "request": rid, "total_s": max(spans) - min(ts),
+            "stages": stages, "replicas": sorted(replicas),
+            "sheds": sheds, "requeue_generations": gens,
+            "completed": done, "tokens": tokens,
+        })
+    rows.sort(key=lambda r: r["total_s"], reverse=True)
+    n_done = sum(1 for r in rows if r["completed"])
+    print(f"{args.events}: {len(rows)} traced request(s), "
+          f"{n_done} completed, {requeues} requeue(s), "
+          f"{sum(shed_causes.values())} shed(s)")
+    if shed_causes:
+        print("  shed causes: " + ", ".join(
+            f"{k}={v}" for k, v in shed_causes.most_common()))
+    k = max(1, args.slowest)
+    print(f"slowest {min(k, len(rows))} (stage seconds):")
+    print(f"  {'request':<14} {'total':>8} {'queue':>8} {'prefill':>8} "
+          f"{'decode':>8}  outcome")
+    for r in rows[:k]:
+        st = r["stages"]
+        if r["completed"]:
+            outcome = f"completed tokens={r['tokens']}"
+        elif r["sheds"]:
+            reason, stage = r["sheds"][-1]
+            outcome = f"shed {reason}@{stage}"
+        else:
+            outcome = "in flight"
+        if r["requeue_generations"]:
+            outcome += (f" (requeued x{len(r['requeue_generations'])}"
+                        f" gen={r['requeue_generations']})")
+        if r["replicas"]:
+            outcome += " on " + ",".join(r["replicas"])
+        print(f"  {r['request'][:14]:<14} {r['total_s']:>8.4f} "
+              f"{st['queue']:>8.4f} {st['prefill']:>8.4f} "
+              f"{st['decode']:>8.4f}  {outcome}")
+    return 0
+
+
+def _cmd_calibrate(args) -> int:
+    from .calibration import DEFAULT_MAX_AGE_S, CalibrationStore
+
+    if args.action == "inspect":
+        store = CalibrationStore(args.store)
+        s = store.summary()
+        print(json.dumps(s, indent=2, sort_keys=True, default=str))
+        bad = store.problems(max_age_s=args.max_age_h * 3600.0
+                             if args.max_age_h else DEFAULT_MAX_AGE_S)
+        if bad:
+            print("unusable for THIS process:", file=sys.stderr)
+            for b in bad:
+                print(f"  - {b}", file=sys.stderr)
+            return 1
+        print("usable: fingerprint/backend match, entries fresh")
+        return 0
+    if args.action == "prune":
+        store = CalibrationStore(args.store)
+        if args.max_age_h is None:
+            print("prune: --max-age-h is required", file=sys.stderr)
+            return 2
+        n = store.prune(args.max_age_h * 3600.0)
+        if n:
+            store.save()
+        print(f"pruned {n} entr{'y' if n == 1 else 'ies'}; "
+              f"{len(store.ops)} remain")
+        return 0
+    # diff
+    a, b = CalibrationStore(args.store), CalibrationStore(args.other)
+    delta = a.diff(b)
+    if not delta:
+        print("stores agree on every shared key")
+        return 0
+    for d in delta:
+        if d["status"] == "changed":
+            print(f"  ~ {d['op_type']:<22} x{d['ratio']:.3f} "
+                  f"({d['total_s_a'] * 1e3:.4f} -> "
+                  f"{d['total_s_b'] * 1e3:.4f} ms)  {d['key'][:60]}")
+        else:
+            side = "a only" if d["status"] == "only_in_a" else "b only"
+            print(f"  {side:>8}: {d['op_type']:<22} {d['key'][:60]}")
+    print(f"{len(delta)} difference(s)")
+    return 0
+
+
 def _cmd_explain(args) -> int:
     from .. import (
         FFConfig,
@@ -152,6 +292,26 @@ def main(argv=None) -> int:
     m = sub.add_parser("prom", help="metrics.jsonl -> Prometheus text")
     m.add_argument("metrics")
     m.add_argument("-o", "--output")
+    r = sub.add_parser(
+        "requests",
+        help="per-request stage breakdown + slowest/shed/requeue report "
+             "from the serving flight recorder's events",
+    )
+    r.add_argument("events")
+    r.add_argument("--slowest", type=int, default=10,
+                   help="how many slowest requests to detail")
+    c = sub.add_parser(
+        "calibrate",
+        help="inspect/prune/diff a persistent cost-model calibration "
+             "store (obs/calibration.py)",
+    )
+    c.add_argument("action", choices=("inspect", "prune", "diff"))
+    c.add_argument("store", help="calibration store JSON path")
+    c.add_argument("other", nargs="?",
+                   help="second store (diff only)")
+    c.add_argument("--max-age-h", type=float, default=None,
+                   help="staleness horizon in hours (inspect verdict / "
+                        "prune cutoff)")
     e = sub.add_parser(
         "explain",
         help="print the miscalibrated-op kernel worklist for the "
@@ -166,8 +326,13 @@ def main(argv=None) -> int:
     e.add_argument("--repeats", type=int, default=1)
     e.add_argument("--bf16", action="store_true")
     args = p.parse_args(argv)
+    if args.cmd == "calibrate" and args.action == "diff" \
+            and not args.other:
+        p.error("calibrate diff needs two store paths")
     return {"trace": _cmd_trace, "summary": _cmd_summary,
-            "prom": _cmd_prom, "explain": _cmd_explain}[args.cmd](args)
+            "prom": _cmd_prom, "requests": _cmd_requests,
+            "calibrate": _cmd_calibrate,
+            "explain": _cmd_explain}[args.cmd](args)
 
 
 if __name__ == "__main__":

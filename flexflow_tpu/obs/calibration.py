@@ -1,0 +1,355 @@
+"""Persistent cost-model calibration store.
+
+`explain_strategy()` measures |simulated − measured| per op, and
+`apply()` feeds the measurements back into the NEXT compile of the same
+process — but the feedback died with the process. This module persists
+it: a versioned on-disk JSON store of measured per-op (fwd, bwd) seconds
+keyed by the view-independent op signature (`explain._op_cost_key` —
+op type, params, material input/weight shapes), stamped with the machine
+fingerprint (`elastic.topology_fingerprint`) and the jax/backend version
+it was measured on, plus cost-model globals (overlap_efficiency and
+per-kind effective collective bandwidths from the machine model).
+
+Load path: ``compile(calibration=...)`` (a path or a store) or a
+telemetry session's ``TelemetryConfig(calibration_path=...)`` resolves
+the store through `resolve_calibration`, which REJECTS stale entries
+(``max_age_s``) and fingerprint/backend mismatches — measurements from a
+different topology or runtime say nothing about this one — and hands the
+surviving table to the existing `attach_profiled_costs` seam, so
+MCMC/DP search and `simulate_runtime` price serial-view ops from
+measurement without re-profiling every process. `analysis/perf.py`
+FFA501/FFA504 then audit the searched strategy against the calibrated
+(not analytical) oracle automatically, because they read the same cost
+model.
+
+Save path: `StrategyExplanation.apply(model)` writes through to the
+active session's store (or an explicit one) and saves atomically.
+
+CLI: ``python -m flexflow_tpu.obs calibrate inspect|prune|diff``.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+SCHEMA_VERSION = 1
+# entries older than this are stale by default: kernels, XLA and the
+# machine itself drift; a month-old measurement is a guess again
+DEFAULT_MAX_AGE_S = 30 * 24 * 3600.0
+_COLLECTIVE_KINDS = ("all_reduce", "all_gather", "reduce_scatter",
+                     "all_to_all")
+_PROBE_BYTES = float(1 << 20)  # 1 MiB payload for effective-rate probes
+
+
+class CalibrationStoreError(Exception):
+    """The store file is unreadable or from an incompatible schema."""
+
+
+def op_key_str(op_key: Tuple) -> str:
+    """Stable string form of `explain._op_cost_key`'s tuple (enum name +
+    params/shape reprs) — the on-disk dictionary key."""
+    op_type, params, in_shapes, w_shapes = op_key
+    name = getattr(op_type, "name", str(op_type))
+    return f"{name}|{params!r}|{in_shapes!r}|{w_shapes!r}"
+
+
+def current_fingerprint() -> dict:
+    """This process's machine fingerprint (topology_fingerprint), or {}
+    when the backend cannot be initialized (pure-CLI contexts)."""
+    try:
+        from ..runtime.elastic import topology_fingerprint
+
+        return topology_fingerprint()
+    except Exception as e:  # fflint: disable=FFL002 — best-effort stamp
+        logger.debug("calibration: no topology fingerprint (%s)", e)
+        return {}
+
+
+def current_backend() -> dict:
+    try:
+        import jax
+
+        return {"jax": jax.__version__,
+                "platform": jax.default_backend()}
+    except Exception as e:  # fflint: disable=FFL002 — best-effort stamp
+        logger.debug("calibration: no backend stamp (%s)", e)
+        return {}
+
+
+def collective_bandwidths(machine) -> Dict[str, float]:
+    """Effective bytes/s per collective kind on `machine` for a 1 MiB
+    payload across every worker — the machine model's analytic rate,
+    recorded so a store diff shows when the topology assumption moved."""
+    out: Dict[str, float] = {}
+    ids = list(range(max(2, getattr(machine, "num_workers", 2))))
+    for kind in _COLLECTIVE_KINDS:
+        fn = getattr(machine, f"{kind}_cost", None)
+        if fn is None:
+            continue
+        try:
+            cost = float(fn(_PROBE_BYTES, ids))
+        except Exception as e:  # fflint: disable=FFL002 — probe only
+            logger.debug("calibration: %s probe failed (%s)", kind, e)
+            continue
+        if cost > 0:
+            out[kind] = _PROBE_BYTES / cost
+    return out
+
+
+class _StoreTable:
+    """Dict-like view over a store's entries compatible with the
+    `attach_profiled_costs` seam: ``get(op_key)`` -> (fwd_s, bwd_s)."""
+
+    def __init__(self, entries: Dict[str, dict], source: str):
+        self._by_key = {k: (float(e["fwd_s"]), float(e["bwd_s"]))
+                        for k, e in entries.items()}
+        self.source = source
+
+    def get(self, op_key, default=None):
+        return self._by_key.get(op_key_str(op_key), default)
+
+    def __len__(self) -> int:
+        return len(self._by_key)
+
+
+class CalibrationStore:
+    """Versioned on-disk store of measured per-op costs + cost-model
+    globals. Constructing with an existing path loads it; `save()` is
+    atomic (tmp + rename). All timestamps are unix seconds."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.meta: dict = {"schema_version": SCHEMA_VERSION,
+                           "created_at": time.time(),
+                           "fingerprint": {}, "backend": {}}
+        self.globals: dict = {}
+        self.ops: Dict[str, dict] = {}
+        self._dirty = False
+        if path is not None and os.path.exists(path):
+            self._load(path)
+
+    def _load(self, path: str) -> None:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CalibrationStoreError(
+                f"calibration store {path}: unreadable ({e})"
+            ) from e
+        version = doc.get("schema_version")
+        if version != SCHEMA_VERSION:
+            raise CalibrationStoreError(
+                f"calibration store {path}: schema_version {version!r}, "
+                f"this build reads {SCHEMA_VERSION}"
+            )
+        self.meta = {k: doc.get(k) for k in
+                     ("schema_version", "created_at", "updated_at",
+                      "fingerprint", "backend")}
+        self.globals = dict(doc.get("globals") or {})
+        self.ops = dict(doc.get("ops") or {})
+
+    # -- recording -------------------------------------------------------
+    def record_op(self, op_key: Tuple, fwd_s: float, bwd_s: float, *,
+                  op_type: Optional[str] = None) -> bool:
+        """Upsert one measured entry; NaN measurements are skipped
+        (profile_ops reports NaN for not-measurable ops)."""
+        if fwd_s != fwd_s or bwd_s != bwd_s:
+            return False
+        self.ops[op_key_str(op_key)] = {
+            "op_type": op_type or getattr(op_key[0], "name", str(op_key[0])),
+            "fwd_s": float(fwd_s),
+            "bwd_s": float(bwd_s),
+            "recorded_at": time.time(),
+        }
+        self._dirty = True
+        return True
+
+    def record_globals(self, *, overlap_efficiency: Optional[float] = None,
+                       collectives: Optional[Dict[str, float]] = None) -> None:
+        if overlap_efficiency is not None:
+            self.globals["overlap_efficiency"] = float(overlap_efficiency)
+        if collectives:
+            self.globals.setdefault("collective_bytes_per_s", {}).update(
+                {k: float(v) for k, v in collectives.items()}
+            )
+        self._dirty = True
+
+    def record_explanation(self, explanation) -> int:
+        """Write-through from a StrategyExplanation: every measured row
+        plus the cost model's globals. Returns rows recorded."""
+        n = 0
+        for r in explanation.rows:
+            if self.record_op(r["_key"], r["meas_fwd_s"], r["meas_bwd_s"],
+                              op_type=r["op_type"]):
+                n += 1
+        glb = getattr(explanation, "cost_model_globals", None) or {}
+        self.record_globals(
+            overlap_efficiency=glb.get("overlap_efficiency"),
+            collectives=glb.get("collective_bytes_per_s"),
+        )
+        return n
+
+    # -- persistence -----------------------------------------------------
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or self.path
+        if path is None:
+            raise CalibrationStoreError("calibration store has no path")
+        self.path = path
+        if not self.meta.get("fingerprint"):
+            self.meta["fingerprint"] = current_fingerprint()
+        if not self.meta.get("backend"):
+            self.meta["backend"] = current_backend()
+        self.meta["updated_at"] = time.time()
+        doc = dict(self.meta)
+        doc["schema_version"] = SCHEMA_VERSION
+        doc["globals"] = self.globals
+        doc["ops"] = self.ops
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".calib.tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
+        self._dirty = False
+        return path
+
+    @property
+    def dirty(self) -> bool:
+        return self._dirty
+
+    # -- validation / maintenance ---------------------------------------
+    def problems(self, *, fingerprint: Optional[dict] = None,
+                 backend: Optional[dict] = None,
+                 max_age_s: float = DEFAULT_MAX_AGE_S) -> List[str]:
+        """Reasons this store must NOT calibrate the current process
+        (empty list = usable). Fingerprint/backend default to the live
+        process's; pass explicit dicts for offline checks."""
+        out: List[str] = []
+        if fingerprint is None:
+            fingerprint = current_fingerprint()
+        if backend is None:
+            backend = current_backend()
+        mine = self.meta.get("fingerprint") or {}
+        if mine and fingerprint and mine != fingerprint:
+            diff = sorted(
+                k for k in set(mine) | set(fingerprint)
+                if mine.get(k) != fingerprint.get(k)
+            )
+            out.append(
+                "machine fingerprint mismatch "
+                f"({', '.join(diff)}): measured on a different topology"
+            )
+        theirs = self.meta.get("backend") or {}
+        if theirs and backend and theirs != backend:
+            out.append(
+                f"backend mismatch: store {theirs}, process {backend}"
+            )
+        if max_age_s is not None and self.ops:
+            newest = max(e.get("recorded_at", 0.0)
+                         for e in self.ops.values())
+            age = time.time() - newest
+            if age > max_age_s:
+                out.append(f"stale: newest entry is {age / 3600.0:.1f}h "
+                           f"old (max {max_age_s / 3600.0:.1f}h)")
+        if not self.ops:
+            out.append("empty: no measured ops recorded")
+        return out
+
+    def prune(self, max_age_s: float) -> int:
+        """Drop entries older than `max_age_s`; returns entries removed."""
+        cutoff = time.time() - max_age_s
+        stale = [k for k, e in self.ops.items()
+                 if e.get("recorded_at", 0.0) < cutoff]
+        for k in stale:
+            del self.ops[k]
+        if stale:
+            self._dirty = True
+        return len(stale)
+
+    def diff(self, other: "CalibrationStore") -> List[dict]:
+        """Per-key comparison against another store: entries only on one
+        side and entries whose total measured cost moved."""
+        out: List[dict] = []
+        for k in sorted(set(self.ops) | set(other.ops)):
+            a, b = self.ops.get(k), other.ops.get(k)
+            if a is None or b is None:
+                out.append({"key": k, "status": "only_in_"
+                            + ("b" if a is None else "a"),
+                            "op_type": (a or b)["op_type"]})
+                continue
+            ta = a["fwd_s"] + a["bwd_s"]
+            tb = b["fwd_s"] + b["bwd_s"]
+            if abs(ta - tb) > 1e-12:
+                out.append({"key": k, "status": "changed",
+                            "op_type": a["op_type"],
+                            "total_s_a": ta, "total_s_b": tb,
+                            "ratio": (tb / ta) if ta > 0 else float("inf")})
+        return out
+
+    def table(self) -> _StoreTable:
+        return _StoreTable(self.ops, source=self.path or "<memory>")
+
+    def summary(self) -> dict:
+        by_type: Dict[str, int] = {}
+        for e in self.ops.values():
+            by_type[e["op_type"]] = by_type.get(e["op_type"], 0) + 1
+        newest = max((e.get("recorded_at", 0.0)
+                      for e in self.ops.values()), default=None)
+        return {"path": self.path, "ops": len(self.ops),
+                "by_op_type": by_type, "globals": dict(self.globals),
+                "fingerprint": self.meta.get("fingerprint") or {},
+                "backend": self.meta.get("backend") or {},
+                "newest_entry_at": newest}
+
+
+def resolve_calibration(calibration=None, *,
+                        max_age_s: float = DEFAULT_MAX_AGE_S,
+                        ) -> Tuple[Optional[_StoreTable], dict]:
+    """Resolve a ``compile(calibration=...)`` argument to an attachable
+    (table, globals) pair, rejecting unusable stores with a warning.
+
+    Accepts a CalibrationStore, a path, or None — None consults the
+    active telemetry session's store (TelemetryConfig.calibration_path),
+    so ``compile()`` under a session picks persisted measurements up
+    with no per-call plumbing. Returns (None, {}) when nothing usable is
+    attached."""
+    store = calibration
+    if store is None:
+        from . import active
+
+        tel = active()
+        store = getattr(tel, "calibration", None) if tel is not None \
+            else None
+        if store is None:
+            return None, {}
+    if isinstance(store, str):
+        try:
+            store = CalibrationStore(store)
+        except CalibrationStoreError as e:
+            logger.warning("calibration rejected: %s", e)
+            return None, {}
+    bad = store.problems(max_age_s=max_age_s)
+    if bad:
+        if not store.ops and len(bad) == 1:
+            # a fresh (about-to-be-written) session store is normal, not
+            # a rejection worth warning about
+            logger.debug("calibration store %s is empty; compiling "
+                         "uncalibrated", store.path or "<memory>")
+        else:
+            logger.warning(
+                "calibration store %s rejected: %s",
+                store.path or "<memory>", "; ".join(bad)
+            )
+        return None, {}
+    return store.table(), dict(store.globals)

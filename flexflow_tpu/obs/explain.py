@@ -40,6 +40,10 @@ def attach_profiled_costs(cost_model, profiled: Dict[Tuple, Tuple[float, float]]
         return (float("nan"), float("nan"))
 
     cost_model.measure_fn = measure
+    # provenance for audits (analysis/perf.py notes which oracle it
+    # judged): a CalibrationStore table carries its on-disk path
+    cost_model.calibration_source = getattr(profiled, "source",
+                                            "profiled(in-memory)")
 
 
 class StrategyExplanation:
@@ -51,10 +55,15 @@ class StrategyExplanation:
     """
 
     def __init__(self, rows: List[dict], trajectory_summary: dict,
-                 searched_cost: Optional[float]):
+                 searched_cost: Optional[float],
+                 cost_model_globals: Optional[dict] = None):
         self.rows = rows
         self.trajectory = trajectory_summary
         self.searched_cost = searched_cost
+        # the audited oracle's globals (overlap_efficiency, per-kind
+        # collective bandwidths) — persisted alongside the per-op rows
+        # by apply()'s calibration-store write-through
+        self.cost_model_globals = cost_model_globals or {}
 
     def top(self, n: int = 10) -> List[dict]:
         return self.rows[:n]
@@ -98,13 +107,29 @@ class StrategyExplanation:
         return {r["_key"]: (r["meas_fwd_s"], r["meas_bwd_s"])
                 for r in self.rows}
 
-    def apply(self, model) -> int:
+    def apply(self, model, store=None) -> int:
         """Feed the measurements back into the search loop: the model's
         next compile() builds its cost model with these (fwd, bwd)
         seconds overriding the analytic roofline for serial views
         (FFModel._build_cost_model -> attach_profiled_costs). Returns
-        the number of ops fed back."""
+        the number of ops fed back.
+
+        Persistence: when `store` is given — or the active telemetry
+        session carries a calibration store
+        (TelemetryConfig.calibration_path) — the measurements and the
+        oracle's globals are written through and saved, so the NEXT
+        process's compile(calibration=...) starts from them without
+        re-profiling (obs/calibration.py)."""
         model._profiled_op_costs = self.profiled_costs()
+        if store is None:
+            from . import active
+
+            tel = active()
+            store = getattr(tel, "calibration", None) \
+                if tel is not None else None
+        if store is not None:
+            store.record_explanation(self)
+            store.save()
         return len(model._profiled_op_costs)
 
     def summary(self, n: int = 10) -> str:
@@ -237,6 +262,13 @@ def explain_strategy(model, x=None, *, repeats: int = 3, warmup: int = 1,
     rows.sort(key=lambda r: r["abs_err_s"], reverse=True)
     traj = getattr(model, "search_trajectory", None)
     tsum = traj.summary() if traj is not None else {}
+    from .calibration import collective_bandwidths
+
+    glb = {
+        "overlap_efficiency": getattr(cm, "overlap_efficiency", None),
+        "collective_bytes_per_s": collective_bandwidths(cm.machine),
+    }
     return StrategyExplanation(
-        rows, tsum, getattr(model, "searched_cost", None)
+        rows, tsum, getattr(model, "searched_cost", None),
+        cost_model_globals=glb,
     )

@@ -4,9 +4,12 @@ The reference surfaces runtime health as scattered prints; here every
 runtime subsystem feeds named series in one registry, exported as a
 Prometheus text file (node-exporter textfile-collector compatible) and as
 JSONL snapshots. Series support optional labels (`registry.counter(name,
-kind="all-reduce")`), thread-safe under one registry lock — updates come
-from the training loop, the serving worker and the health-monitor
-threads concurrently.
+kind="all-reduce")`) and are thread-safe: family/label-map creation is
+guarded by the registry lock, and every series carries its OWN lock for
+value updates (reservoir appends included) — updates come from the
+training loop, every replica's serve thread, the batcher, watchdog and
+health-monitor threads concurrently, so hot-path observes must not
+serialize against each other on one global lock.
 
 Naming follows Prometheus conventions: `ff_<noun>_<unit>` gauges /
 histograms, `ff_<noun>_total` counters, base units (seconds, bytes).
@@ -141,7 +144,7 @@ class MetricsRegistry:
                 )
             series = fam[2].get(key)
             if series is None:
-                series = cls(self._lock, **kw)
+                series = cls(threading.Lock(), **kw)
                 fam[2][key] = series
             return series
 

@@ -43,6 +43,14 @@ class TelemetryConfig:
     max_events / flush_every: event-log bounds (tracer.py).
     search_replay_limit: how many recorded search-trajectory entries are
         replayed into the event log at attach time.
+    request_sample_rate: fraction of serving requests whose flight
+        recorder emits spans (obs/request_trace.py; head-based, decided
+        once at submit). Stage histograms and SLO counters cover ALL
+        requests regardless.
+    calibration_path: persistent cost-model calibration store
+        (obs/calibration.py) — explain_strategy().apply() writes
+        measured per-op costs through to it, and compile() under this
+        session loads it back.
     """
 
     dir: str
@@ -52,6 +60,8 @@ class TelemetryConfig:
     max_events: int = 200_000
     flush_every: int = 256
     search_replay_limit: int = 20_000
+    request_sample_rate: float = 1.0
+    calibration_path: Optional[str] = None
     events_file: str = "events.jsonl"
     prom_file: str = "metrics.prom"
     metrics_jsonl_file: str = "metrics.jsonl"
@@ -87,6 +97,11 @@ class Telemetry:
         self.tracer = Tracer(events_path, flush_every=config.flush_every,
                              max_events=config.max_events)
         self.metrics = MetricsRegistry()
+        self.calibration = None
+        if config.calibration_path:
+            from .calibration import CalibrationStore
+
+            self.calibration = CalibrationStore(config.calibration_path)
         self._finished = False
         self._attached_models: list = []
         self.tracer.instant("session_start", cat="obs",
@@ -276,6 +291,9 @@ class Telemetry:
         self.tracer.instant("session_end", cat="obs", unixtime=time.time())
         self.tracer.close()
         self.write_metrics()
+        if self.calibration is not None and self.calibration.dirty:
+            self.calibration.save()
         with open(os.path.join(self.config.dir,
                                self.config.trace_file), "w") as f:
-            json.dump(to_chrome_trace(self.tracer.events), f)
+            json.dump(to_chrome_trace(self.tracer.events,
+                                      lane_names=self.tracer.lane_names), f)

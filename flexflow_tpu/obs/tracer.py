@@ -74,6 +74,9 @@ class _NullSpan:
     def set(self, **args):  # matches Span.set
         return self
 
+    def done(self):  # matches Span.done
+        return None
+
 
 _NULL_SPAN = _NullSpan()
 
@@ -94,6 +97,9 @@ class NullTracer:
 
     def emit(self, event):
         return None
+
+    def lane(self, cat, name):
+        return 0
 
 
 NULL_TRACER = NullTracer()
@@ -161,6 +167,33 @@ class Tracer:
         self._written = 0  # events already flushed to disk
         self._emitted = 0
         self._lock = threading.Lock()
+        # named lanes: (cat, lane-name) -> stable tid within the category.
+        # tid 0 is the anonymous default lane, so named lanes start at 1;
+        # the mapping exports through to_chrome_trace(lane_names=...) as
+        # Perfetto thread_name metadata (per-replica request tracks).
+        self._lanes: Dict[Tuple[str, str], int] = {}
+
+    def lane(self, cat: str, name: str) -> int:
+        """Stable tid for a named lane within `cat` (get-or-assign). A
+        first assignment also records a "lane" instant event, so the
+        name->tid mapping survives in events.jsonl and the offline CLI
+        (`obs trace`) can label the Perfetto tracks a live session
+        labels via `lane_names`."""
+        key = (cat, name)
+        with self._lock:
+            tid = self._lanes.get(key)
+            fresh = tid is None
+            if fresh:
+                tid = 1 + sum(1 for c, _ in self._lanes if c == cat)
+                self._lanes[key] = tid
+        if fresh:  # emit outside the lock (emit() re-takes it)
+            self.instant("lane", cat=cat, tid=tid, lane=name)
+        return tid
+
+    @property
+    def lane_names(self) -> Dict[Tuple[str, str], int]:
+        with self._lock:
+            return dict(self._lanes)
 
     # -- recording -------------------------------------------------------
     def span(self, name, cat="runtime", tid=0, **args) -> Span:
@@ -218,17 +251,21 @@ class Tracer:
 # Chrome-trace / Perfetto export (the shared schema both the runtime
 # tracer and the simulator's timeline export emit)
 # ----------------------------------------------------------------------
-def to_chrome_trace(events: Iterable[dict]) -> dict:
+def to_chrome_trace(events: Iterable[dict],
+                    lane_names: Optional[Dict[Tuple[str, str], int]] = None,
+                    ) -> dict:
     """Internal events -> Chrome trace JSON (Perfetto-loadable).
 
     Categories become processes (stable pid per cat, named via
     process_name metadata) so a simulated timeline (cat "simulated") and
     the measured runtime (cat "train" etc.) overlay as separate tracks in
     one Perfetto view; `tid` is the lane within a category (device id for
-    per-device timelines). Seconds become microseconds and the whole
-    trace is shifted so the earliest timestamp is 0 (compile-time events
-    replayed into a later session may carry negative session-relative
-    ts)."""
+    per-device timelines, replica name for request traces). Passing a
+    tracer's `lane_names` ({(cat, name): tid}) emits thread_name metadata
+    so named lanes render labeled in Perfetto. Seconds become
+    microseconds and the whole trace is shifted so the earliest timestamp
+    is 0 (compile-time events replayed into a later session may carry
+    negative session-relative ts)."""
     events = [e for e in events if not validate_event(e)]
     pids: Dict[str, int] = {}
     out: List[dict] = []
@@ -255,7 +292,27 @@ def to_chrome_trace(events: Iterable[dict]) -> dict:
          "args": {"name": cat}}
         for cat, pid in pids.items()
     ]
+    for (cat, name), tid in sorted((lane_names or {}).items(),
+                                   key=lambda kv: kv[1]):
+        if cat in pids:  # a lane with no events has no process to hang on
+            meta.append({"name": "thread_name", "ph": "M",
+                         "pid": pids[cat], "tid": int(tid),
+                         "args": {"name": name}})
     return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+def lanes_from_events(events: Iterable[dict]) -> Dict[Tuple[str, str], int]:
+    """Reconstruct a tracer's {(cat, lane-name): tid} mapping from the
+    "lane" instant events it recorded — the offline complement of
+    `Tracer.lane_names` for CLI conversion of an events.jsonl file."""
+    out: Dict[Tuple[str, str], int] = {}
+    for e in events:
+        if e.get("name") == "lane":
+            name = e.get("args", {}).get("lane")
+            if name is not None:
+                out[(str(e.get("cat", "runtime")), str(name))] = \
+                    int(e.get("tid", 0))
+    return out
 
 
 def read_events_jsonl(path: str) -> Tuple[List[dict], List[str]]:

@@ -120,6 +120,17 @@ class PagePool:
         with self._lock:
             return sum(len(t) for t in self._tables.values())
 
+    def snapshot(self) -> Dict[str, int]:
+        """Consistent one-lock view of the pool's occupancy — the
+        request flight recorder attaches this to kv_reserve/kv_release
+        trace events, where three separately-locked property reads could
+        tear against a concurrent admission."""
+        with self._lock:
+            used = sum(len(t) for t in self._tables.values())
+            reserved = sum(self._reserved.values())
+        return {"pages_in_use": used, "pages_reserved": reserved,
+                "pages_free": self.config.num_pages - reserved}
+
     def page_table(self, seq_id: str) -> tuple:
         with self._lock:
             return tuple(self._tables.get(seq_id, ()))

@@ -47,6 +47,12 @@ from typing import Callable, Dict, List, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.request_trace import (
+    NULL_REQUEST_TRACE,
+    SLOMonitor,
+    mint_request_trace,
+    record_request_stages,
+)
 from .kvcache import KVCacheConfig, KVCacheExhaustedError, PagePool
 from .resilience import ResilienceError
 from .verify import NotCompiledError, ServingConfigError
@@ -560,6 +566,12 @@ class ServingConfig:
     rate_burst: int = 8
     adaptive_rate: bool = False
     target_p95_s: float = 1.0
+    # SLO targets (obs/request_trace.SLOMonitor): completed requests are
+    # judged against these; violations count in ff_slo_violations_total
+    # and a sustained violation fraction scales the ReplicaSet up. None
+    # disables the corresponding check.
+    slo_ttft_s: Optional[float] = None
+    slo_p99_s: Optional[float] = None
     eos_token_id: Optional[int] = None
     assume_causal: bool = False
     idle_wait_s: float = 0.005
@@ -609,9 +621,14 @@ class GenerationRequest:
         self.max_new_tokens = int(max_new_tokens)
         self.submitted_t = time.monotonic()
         self.deadline = self.submitted_t + float(deadline_s)
+        self.admitted_t: Optional[float] = None  # last slot admission
         self.first_token_t: Optional[float] = None
         self.finished_t: Optional[float] = None
         self.generation = 0  # bumped on failover requeue
+        # flight recorder (obs/request_trace.py): ReplicaSet.submit /
+        # AdmissionQueue.offer mint a sampled context; the shared null
+        # object keeps the unsampled path allocation-free
+        self.trace = NULL_REQUEST_TRACE
         self._event = threading.Event()
         self._lock = threading.Lock()
         self.tokens: Optional[np.ndarray] = None
@@ -730,6 +747,11 @@ class AdmissionQueue:
                       help="requests waiting for a decode slot")
 
     def offer(self, req: GenerationRequest) -> None:
+        if req.trace is NULL_REQUEST_TRACE:
+            # direct-queue callers (no ReplicaSet) still get a flight
+            # recorder; the mint is deterministic per id, so a request
+            # already judged unsampled stays unsampled
+            req.trace = mint_request_trace(req.id)
         now = time.monotonic()
         if now >= req.deadline:
             err = DeadlineExceededError(
@@ -737,6 +759,7 @@ class AdmissionQueue:
                 f"({now - req.deadline:.3f}s past deadline)", stage="enqueue",
             )
             _shed("deadline")
+            req.trace.shed("deadline", stage="enqueue")
             req._finish(error=err)
             raise err
         with self._lock:
@@ -745,8 +768,10 @@ class AdmissionQueue:
                     f"admission queue at capacity ({self.max_depth})"
                 )
                 _shed("queue_full")
+                req.trace.shed("queue_full", stage="enqueue")
                 req._finish(error=full)
                 raise full
+            req.trace.queue_begin(depth=len(self._q))
             self._q.append(req)
             self._nonempty.notify()
         self._export_depth()
@@ -771,6 +796,7 @@ class AdmissionQueue:
                     now = time.monotonic()
                     if now >= req.deadline:
                         _shed("deadline")
+                        req.trace.shed("deadline", stage="dequeue")
                         req._finish(error=DeadlineExceededError(
                             f"request {req.id} expired in queue "
                             f"({now - req.deadline:.3f}s past deadline)",
@@ -849,7 +875,8 @@ class ContinuousBatcher:
                  fault_injector=None,
                  monitor=None,
                  on_dead: Optional[Callable] = None,
-                 device_lock: Optional[threading.RLock] = None):
+                 device_lock: Optional[threading.RLock] = None,
+                 slo: Optional[SLOMonitor] = None):
         if model.executor is None:
             raise NotCompiledError("compile() the model first")
         if len(model._fit_input_tensors) != 1:
@@ -865,6 +892,7 @@ class ContinuousBatcher:
         self.fault_injector = fault_injector
         self.monitor = monitor
         self.on_dead = on_dead
+        self.slo = slo  # shared SLOMonitor (ReplicaSet-owned), or None
         self.pool = pool or PagePool(config.kv_config(),
                                      fault_injector=fault_injector)
         # ALL in-process replicas must funnel device work through one
@@ -955,6 +983,7 @@ class ContinuousBatcher:
                 f"{self.config.max_len}", reason="too_long",
             )
             _shed("too_long")
+            req.trace.shed("too_long", stage="admit", replica=self.name)
             req._finish(error=err)
             return True
         # early shed: with a warmed service-time estimate, a request
@@ -963,6 +992,8 @@ class ContinuousBatcher:
             eta = now + req.max_new_tokens * self._token_ewma_s
             if eta > req.deadline:
                 _shed("deadline")
+                req.trace.shed("deadline", stage="admit",
+                               replica=self.name)
                 req._finish(error=DeadlineExceededError(
                     f"request {req.id} cannot meet its deadline: needs "
                     f"~{req.max_new_tokens * self._token_ewma_s:.3f}s, has "
@@ -972,12 +1003,16 @@ class ContinuousBatcher:
         generation = req.generation
         self._admit_seq += 1
         seq_key = f"{req.id}:{generation}:{self.name}:{self._admit_seq}"
+        reserve_pages = 0
         try:
-            self.pool.reserve(seq_key, self._reserve_tokens(
+            reserve_pages = self.pool.reserve(seq_key, self._reserve_tokens(
                 plen, req.max_new_tokens))
         except KVCacheExhaustedError as e:
             if e.never_fits:
                 _shed("kv_exhausted")
+                req.trace.shed("kv_exhausted", stage="admit",
+                               replica=self.name,
+                               pages_needed=e.pages_needed)
                 req._finish(error=RequestShedError(
                     f"request {req.id} can never fit the KV page pool: "
                     f"{e}", reason="kv_exhausted",
@@ -985,17 +1020,30 @@ class ContinuousBatcher:
                 return True
             # backpressure: put it back and wait for retirements
             self.queue.requeue(req)
+            req.trace.event("kv_backpressure", replica=self.name,
+                            pages_needed=e.pages_needed,
+                            pages_free=e.pages_free)
             obs.event("serving_kv_backpressure", cat="serving",
                       replica=self.name, request=req.id,
                       pages_needed=e.pages_needed, pages_free=e.pages_free)
             return False
         slot_idx = self.slots.index(None)
+        bucket = self._bucket(plen)
+        req.admitted_t = time.monotonic()
+        req.trace.admitted(self.name, generation=generation,
+                           slot=slot_idx, prompt_len=plen)
+        if req.trace.sampled:
+            req.trace.event("kv_reserve", replica=self.name,
+                            pages=reserve_pages, **self.pool.snapshot())
+        prefill_span = req.trace.span("prefill", replica=self.name,
+                                      bucket=bucket, prompt_len=plen)
         try:
             first, caches1 = self._prefill(req, plen)
         except BaseException:
             self.pool.release(seq_key)
             raise
         self._insert_slot(slot_idx, caches1)
+        prefill_span.done()
         req.first_token_t = time.monotonic()
         obs.observe("ff_serving_ttft_seconds",
                     req.first_token_t - req.submitted_t,
@@ -1003,7 +1051,7 @@ class ContinuousBatcher:
         slot = _Slot(req=req, generation=generation, seq_key=seq_key,
                      tokens=list(req.prompt.tolist()) + [first],
                      prompt_len=plen, pos=plen)
-        self.pool.touch(seq_key, self._bucket(plen))
+        self.pool.touch(seq_key, bucket)
         self.slots[slot_idx] = slot
         self.stats["admitted"] += 1
         self.stats["prefills"] += 1
@@ -1070,13 +1118,17 @@ class ContinuousBatcher:
     def _release(self, slot_idx: int) -> None:
         slot = self.slots[slot_idx]
         if slot is not None:
-            self.pool.release(slot.seq_key)
+            freed = self.pool.release(slot.seq_key)
+            if slot.req.trace.sampled:
+                slot.req.trace.event("kv_release", replica=self.name,
+                                     pages=freed, **self.pool.snapshot())
         self.slots[slot_idx] = None
 
     def _finish_slot(self, slot_idx: int) -> None:
         from .. import obs
 
         slot = self.slots[slot_idx]
+        generated = len(slot.tokens) - slot.prompt_len
         ok = slot.req._finish(tokens=np.asarray(slot.tokens, self._id_dt),
                               generation=slot.generation)
         if ok:
@@ -1085,10 +1137,15 @@ class ContinuousBatcher:
                         help="end-to-end serving request latency")
             obs.count("ff_serving_requests_total",
                       help="serving requests answered")
-            obs.count("ff_serving_tokens_total",
-                      len(slot.tokens) - slot.prompt_len,
+            obs.count("ff_serving_tokens_total", generated,
                       help="tokens generated by the serving runtime")
             self.stats["finished"] += 1
+            stages = record_request_stages(slot.req, generated=generated,
+                                           slo=self.slo)
+            slot.req.trace.completed(
+                self.name, generation=slot.generation, tokens=generated,
+                **{f"{k}_s": round(v, 6) for k, v in stages.items()},
+            )
         self._release(slot_idx)
 
     def _maybe_retire(self, slot_idx: int) -> None:
@@ -1102,6 +1159,9 @@ class ContinuousBatcher:
         if now > slot.req.deadline:
             _shed("deadline")
             self.stats["shed_decode"] += 1
+            slot.req.trace.shed("deadline", stage="decode",
+                                replica=self.name,
+                                tokens=len(slot.tokens) - slot.prompt_len)
             slot.req._finish(error=DeadlineExceededError(
                 f"request {slot.req.id} blew its deadline mid-decode "
                 f"after {len(slot.tokens) - slot.prompt_len} token(s)",
@@ -1123,24 +1183,42 @@ class ContinuousBatcher:
         t_vec = np.zeros(self.config.slots, np.int32)
         toks = np.zeros((self.config.slots, 1), self._id_dt)
         active = []
+        sampled_any = False
         for i, slot in enumerate(self.slots):
             if slot is None:
                 continue
             active.append(i)
+            sampled_any = sampled_any or slot.req.trace.sampled
             t_vec[i] = slot.pos
             toks[i, 0] = slot.tokens[slot.pos]
+        span_t0 = time.perf_counter() if sampled_any else 0.0
         with self._device_lock:
             logits, self._caches = self._stepB(
                 self.model.state.params, self._caches, jnp.asarray(t_vec),
                 [jnp.asarray(toks)],
             )
             logits = np.asarray(logits)
+        span_dur = (time.perf_counter() - span_t0) if sampled_any else 0.0
+        occupancy = len(active)
         for i in active:
             slot = self.slots[i]
             slot.tokens.append(int(logits[i, 0].argmax(-1)))
             slot.pos += 1
-            self.pool.touch(slot.seq_key,
-                            max(self._bucket(slot.prompt_len), slot.pos))
+            new_pages = self.pool.touch(
+                slot.seq_key, max(self._bucket(slot.prompt_len), slot.pos))
+            if slot.req.trace.sampled:
+                # one completed span per sampled slot per iteration:
+                # slot occupancy + position make decode stalls and
+                # batch-sharing visible per request in the Perfetto lane
+                slot.req.trace.iteration(
+                    self.name, t0=span_t0, dur_s=span_dur,
+                    iteration=self._iteration, slot=i, pos=slot.pos,
+                    occupancy=occupancy,
+                )
+                if new_pages:
+                    slot.req.trace.event("kv_touch", replica=self.name,
+                                         pages=len(new_pages),
+                                         pos=slot.pos)
             self._maybe_retire(i)
 
     def _warmup_compiles(self) -> None:
@@ -1186,11 +1264,16 @@ class ContinuousBatcher:
                 continue  # finished meanwhile
             if time.monotonic() >= slot.req.deadline:
                 _shed("deadline")
+                slot.req.trace.shed("deadline", stage="failover",
+                                    replica=self.name)
                 slot.req._finish(error=DeadlineExceededError(
                     f"request {slot.req.id} expired during replica "
                     "failover", stage="failover",
                 ))
                 continue
+            slot.req.trace.requeued(self.name, generation=gen,
+                                    tokens_done=len(slot.tokens)
+                                    - slot.prompt_len)
             self.queue.requeue(slot.req)
             requeued += 1
         if requeued:
@@ -1341,6 +1424,11 @@ class ReplicaSet:
         self.bucket: Optional[TokenBucket] = None
         if config.rate_limit is not None:
             self.bucket = TokenBucket(config.rate_limit, config.rate_burst)
+        # one SLO monitor across every replica: completion verdicts come
+        # from the batchers' _finish_slot, the autoscaler and adaptive
+        # admission read it back (obs/request_trace.SLOMonitor)
+        self.slo = SLOMonitor(ttft_target_s=config.slo_ttft_s,
+                              latency_p99_target_s=config.slo_p99_s)
         self._lock = threading.Lock()
         self._replicas: Dict[str, _Replica] = {}
         self._counter = 0
@@ -1443,6 +1531,7 @@ class ReplicaSet:
             fault_injector=self.fault_injector,
             on_dead=self._on_batcher_dead,
             device_lock=self._device_lock,
+            slo=self.slo,
         )
 
     def _activate(self, batcher: ContinuousBatcher) -> _Replica:
@@ -1645,7 +1734,9 @@ class ReplicaSet:
             # over-provision, and the later idle scale-down would drain
             # a replica that real traffic still needs
             n = self.replica_count() + pending
-            if depth >= self.scale_up_queue_depth and n < self.max_replicas:
+            slo_pressure = self.slo.should_scale_up()
+            if ((depth >= self.scale_up_queue_depth or slo_pressure)
+                    and n < self.max_replicas):
                 try:
                     rep = self._add_replica(allow_spare=True)
                 except BaseException as e:
@@ -1654,7 +1745,12 @@ class ReplicaSet:
                     continue
                 self.stats["scale_ups"] += 1
                 obs.event("replica_scale_up", cat="serving",
-                          replica=rep.name, queue_depth=depth)
+                          replica=rep.name, queue_depth=depth,
+                          cause=("slo" if slo_pressure
+                                 and depth < self.scale_up_queue_depth
+                                 else "queue_depth"),
+                          slo_violation_rate=round(
+                              self.slo.violation_rate(), 4))
                 self._idle_since = None
                 continue
             busy = depth > 0 or any(
@@ -1695,6 +1791,8 @@ class ReplicaSet:
             gen = slot.req._requeue_bump()
             self.pool_release_quiet(rep.batcher, slot)
             if gen is not None:
+                slot.req.trace.requeued(rep.name, generation=gen,
+                                        scale_down=True)
                 self.queue.requeue(slot.req)
                 self.stats["requeued"] += 1
         rep.batcher.stop(timeout=5.0)
@@ -1708,6 +1806,13 @@ class ReplicaSet:
     def _latency_p95(self) -> float:
         from .. import obs
 
+        # the SLO monitor's window is fed by EVERY completed request
+        # (record_request_stages), not just blocking generate() callers,
+        # so it is the preferred signal when populated
+        if self.slo.sample_count > 0:
+            q = self.slo.latency_quantile(0.95)
+            if q == q:  # not NaN
+                return q
         tel = obs.active()
         if tel is not None:
             h = tel.metrics.find("ff_serving_latency_seconds")
@@ -1734,6 +1839,7 @@ class ReplicaSet:
             deadline_s=(deadline_s if deadline_s is not None
                         else self.config.default_deadline_s),
         )
+        req.trace = mint_request_trace(req.id)
         if self.bucket is not None:
             if self.config.adaptive_rate:
                 self._rate_check += 1
@@ -1746,6 +1852,7 @@ class ReplicaSet:
                     f"({self.bucket.rate:.1f} req/s)"
                 )
                 _shed("rate_limited")
+                req.trace.shed("rate_limited", stage="submit")
                 req._finish(error=err)
                 raise err
         self.queue.offer(req)  # sheds typed on full/dead-on-arrival

@@ -307,6 +307,30 @@ class CostModel:
         # optional on-device microbenchmark oracle (search/measure.py,
         # reference: Simulator::measure_operator_cost's real timing path)
         self.measure_fn = None
+        # provenance: where the measured oracle came from (set by
+        # obs.explain.attach_profiled_costs — an on-disk calibration
+        # store's path or "profiled(in-memory)"), and how often the
+        # search actually priced an op from measurement vs the analytic
+        # roofline — the ratio perf audits report so "calibrated" is a
+        # checked claim, not an assumption
+        self.calibration_source: Optional[str] = None
+        self.measured_hits = 0
+        self.analytic_hits = 0
+
+    def provenance(self) -> dict:
+        """How this oracle priced ops so far: measurement vs analytic
+        roofline (cache-cold queries only — memoized repeats don't
+        re-count). analysis/perf.py attaches this to its report when a
+        measured source is present."""
+        total = self.measured_hits + self.analytic_hits
+        return {
+            "source": self.calibration_source,
+            "measured_ops": len(self.measured),
+            "measured_hits": self.measured_hits,
+            "analytic_hits": self.analytic_hits,
+            "measured_fraction": (self.measured_hits / total)
+            if total else 0.0,
+        }
 
     def _calibration_class(self, op_type, flops=None,
                            membytes=None) -> Optional[dict]:
@@ -375,8 +399,10 @@ class CostModel:
             if m_fwd == m_fwd:  # not NaN -> measurable on device
                 self.measured[key] = (m_fwd, m_bwd)
         if key in self.measured:
+            self.measured_hits += 1
             fwd, bwd = self.measured[key]
         else:
+            self.analytic_hits += 1
             mxu_eff, hbm_eff = self._calibrated_efficiencies(
                 op.op_type, flops, membytes
             )

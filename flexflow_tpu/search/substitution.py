@@ -378,6 +378,11 @@ def fsdp_shard_weights(degree: int) -> Substitution:
     def apply(graph: Graph) -> Iterator[Graph]:
         from ..parallel.weight_sharding import insert_weight_shard, shardable_dim
 
+        if degree < 2:
+            # single-device search passes degree 1 (generate_all_pcg_xfers
+            # falls back to [1]); a 1-way shard is a no-op that
+            # insert_weight_shard rejects with ValueError
+            return
         for op in graph.ops:
             if op.is_parallel_op or not op.weights or not op.outputs:
                 continue
@@ -412,6 +417,9 @@ def fsdp_zero_shard(degree: int) -> Substitution:
 
     def apply(graph: Graph) -> Iterator[Graph]:
         from ..parallel.weight_sharding import insert_weight_shard, shardable_dim
+
+        if degree < 2:
+            return  # 1-way shard is a no-op; insert_weight_shard rejects it
 
         def eligible(op) -> bool:
             return (not op.is_parallel_op and bool(op.weights)

@@ -1,0 +1,104 @@
+#!/usr/bin/env python3
+"""Warn-only bench-regression gate: compare a measured bench value against
+the published baseline in BASELINE.json with a tolerance band.
+
+Reads the measurement from (first match wins):
+  --bench-json FILE   a bench.py JSON line, or a driver BENCH_r*.json
+                      artifact (the {"parsed": {...}} wrapper)
+  stdin ("-")         a bench.py JSON line piped in
+  BENCH_r*.json       the newest committed round artifact in the repo root
+
+Exit code is 0 unless --strict: CI wires this as a warn-only step (a perf
+regression should page a human through the workflow annotation, not block
+an unrelated lint PR — CPU runners can't reproduce TPU numbers anyway).
+The ::warning:: line is the GitHub Actions annotation format; locally it
+just prints.
+
+Usage:
+  python scripts/bench_regression.py                      # newest round
+  python bench.py | python scripts/bench_regression.py -  # fresh run
+  python scripts/bench_regression.py --tolerance 0.10 --strict
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_measurement(src):
+    """-> (value, metric, where) from a bench.py line or driver artifact."""
+    if src == "-":
+        doc = json.loads(sys.stdin.read())
+        where = "stdin"
+    elif src:
+        with open(src) as f:
+            doc = json.load(f)
+        where = src
+    else:
+        rounds = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+        if not rounds:
+            return None, None, None
+        with open(rounds[-1]) as f:
+            doc = json.load(f)
+        where = os.path.basename(rounds[-1])
+    if "parsed" in doc:  # driver artifact wraps the bench line
+        doc = doc["parsed"] or {}
+    v = doc.get("value")
+    if not isinstance(v, (int, float)) or v <= 0:
+        return None, None, where
+    return float(v), doc.get("metric", "transformer_train_throughput"), where
+
+
+def load_baseline(metric):
+    try:
+        with open(os.path.join(REPO, "BASELINE.json")) as f:
+            published = json.load(f).get("published", {}) or {}
+    except (OSError, ValueError):
+        return None
+    for key in (metric, "transformer_train_throughput"):
+        v = published.get(key)
+        if isinstance(v, (int, float)) and v > 0:
+            return float(v)
+    return None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="warn-only bench vs BASELINE.json comparison")
+    ap.add_argument("bench_json", nargs="?", default=None,
+                    help="bench JSON line file, driver artifact, or - for "
+                         "stdin (default: newest BENCH_r*.json)")
+    ap.add_argument("--tolerance", type=float, default=0.15,
+                    help="allowed fractional drop below baseline before "
+                         "warning (default 0.15)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on regression instead of warn-only")
+    args = ap.parse_args(argv)
+
+    value, metric, where = load_measurement(args.bench_json)
+    if value is None:
+        print(f"bench_regression: no measurement found "
+              f"({where or 'no BENCH_r*.json rounds'}); nothing to compare")
+        return 0
+    baseline = load_baseline(metric)
+    if baseline is None:
+        print(f"bench_regression: BASELINE.json has no published value for "
+              f"{metric}; nothing to compare")
+        return 0
+
+    ratio = value / baseline
+    line = (f"bench_regression: {metric} = {value:.3f} vs baseline "
+            f"{baseline:.3f} ({where}); ratio {ratio:.3f}, "
+            f"tolerance -{args.tolerance:.0%}")
+    if ratio < 1.0 - args.tolerance:
+        print(f"::warning title=bench regression::{line}")
+        return 1 if args.strict else 0
+    print(f"{line} — OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

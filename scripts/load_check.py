@@ -14,11 +14,18 @@ and asserts the overload-robustness contract:
   3. **failover completes** — the killed replica's in-flight work is
      requeued onto its sibling and a replacement comes back through the
      elastic-restore path (checkpoint resharded onto the live
-     topology), so the run ends at full replica strength.
+     topology), so the run ends at full replica strength;
+  4. **(with --telemetry-dir) the flight recorder is coherent** — the
+     session's events.jsonl is schema-valid, at least one sampled
+     request carries the full queue -> admit -> prefill -> decode ->
+     complete lifecycle, and when the kill fired, some requeued request
+     finished under its ORIGINAL trace id with exactly one complete
+     event (obs/request_trace.py).
 
-Exit 0 with a JSON summary on stdout when all three hold; exit 1 (with
-the failed criterion) otherwise. scripts/serving_check.sh runs this on
-8- and 4-device CPU meshes in CI.
+Exit 0 with a JSON summary on stdout when all criteria hold; exit 1
+(with the failed criterion) otherwise. scripts/serving_check.sh runs
+this on 8- and 4-device CPU meshes in CI; scripts/obs_check.sh runs the
+telemetry-enabled leg.
 """
 import argparse
 import json
@@ -114,6 +121,71 @@ def offered_load(rs, args, records, stop_evt, killed_evt, fi):
             time.sleep(period)
 
 
+def verify_request_trace(tel_dir, *, expect_requeue):
+    """Criterion 4: reconstruct per-request lifecycles from the finished
+    session's events.jsonl and judge the flight-recorder contract.
+    Returns (verdict-dict-for-summary, failure-strings)."""
+    from flexflow_tpu.obs.tracer import read_events_jsonl
+
+    failures = []
+    events_path = os.path.join(tel_dir, "events.jsonl")
+    trace_path = os.path.join(tel_dir, "trace.json")
+    events, problems = read_events_jsonl(events_path)
+    if problems:
+        failures.append(
+            f"events.jsonl has {len(problems)} schema-invalid line(s): "
+            + "; ".join(problems[:3])
+        )
+    by_req = {}
+    for e in events:
+        if e.get("cat") != "requests":
+            continue
+        rid = e.get("args", {}).get("request")
+        if rid is not None:
+            by_req.setdefault(rid, []).append(e["name"])
+    lifecycle = ("queue", "admit", "prefill", "decode", "complete")
+    full = [rid for rid, names in by_req.items()
+            if all(s in names for s in lifecycle)]
+    requeued_ok = [
+        rid for rid, names in by_req.items()
+        if "requeue" in names and names.count("complete") == 1
+    ]
+    double_complete = [rid for rid, names in by_req.items()
+                       if names.count("complete") > 1]
+    verdict = {
+        "traced_requests": len(by_req),
+        "full_lifecycle": len(full),
+        "requeued_completed": len(requeued_ok),
+        "schema_problems": len(problems),
+        "perfetto_trace": trace_path,
+    }
+    if not by_req:
+        failures.append("telemetry enabled but no request events recorded")
+    elif not full:
+        failures.append(
+            "no traced request carries the full queue->admit->prefill->"
+            "decode->complete lifecycle"
+        )
+    if double_complete:
+        failures.append(
+            f"{len(double_complete)} request(s) completed more than once "
+            f"in the trace: {double_complete[:3]}"
+        )
+    if expect_requeue and not requeued_ok:
+        failures.append(
+            "replica kill fired but no requeued request finished under "
+            "its original trace id"
+        )
+    if not os.path.exists(trace_path):
+        failures.append(f"missing Perfetto export {trace_path}")
+    else:
+        with open(trace_path) as f:
+            tr = json.load(f)
+        if "traceEvents" not in tr:
+            failures.append("trace.json is not Chrome-trace shaped")
+    return verdict, failures
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--replicas", type=int, default=2)
@@ -157,6 +229,13 @@ def main():
                     help="also write the summary JSON to this path")
     ap.add_argument("--no-kill", action="store_true",
                     help="skip the replica kill (latency-only run)")
+    ap.add_argument("--telemetry-dir", type=str, default=None,
+                    help="run under a telemetry session writing to this "
+                         "dir and verify the request flight recorder "
+                         "(criterion 4)")
+    ap.add_argument("--request-sample-rate", type=float, default=1.0,
+                    help="head-based request trace sampling rate for the "
+                         "telemetry session")
     args = ap.parse_args()
 
     from flexflow_tpu.runtime.resilience import FaultInjector, InferenceTimeout
@@ -168,6 +247,19 @@ def main():
     ndev = len(jax.devices())
     print(f"[load_check] {ndev} device(s), {args.replicas} replica(s), "
           f"{args.slots} slot(s) each", file=sys.stderr)
+
+    telemetry = None
+    if args.telemetry_dir:
+        import flexflow_tpu.obs as obs
+        from flexflow_tpu import TelemetryConfig
+
+        telemetry = obs.start(TelemetryConfig(
+            dir=args.telemetry_dir,
+            request_sample_rate=args.request_sample_rate,
+        ))
+        print(f"[load_check] telemetry session -> {args.telemetry_dir} "
+              f"(request_sample_rate={args.request_sample_rate})",
+              file=sys.stderr)
 
     fi = FaultInjector()
     cfg = ServingConfig(
@@ -313,6 +405,19 @@ def main():
             )
 
     rs.stop()
+
+    # criterion 4: the request flight recorder is coherent
+    if telemetry is not None:
+        import flexflow_tpu.obs as obs
+
+        obs.finish()  # flush events.jsonl + trace.json
+        verdict, trace_failures = verify_request_trace(
+            args.telemetry_dir,
+            expect_requeue=killed_evt.is_set() and not args.no_kill,
+        )
+        summary["trace"] = verdict
+        failures.extend(trace_failures)
+
     print(json.dumps(summary, indent=2, default=str))
     if args.json:
         with open(args.json, "w") as f:

@@ -8,6 +8,11 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 export JAX_NUM_CPU_DEVICES="${JAX_NUM_CPU_DEVICES:-4}"
+# jax<0.5 ignores JAX_NUM_CPU_DEVICES; the XLA flag is what actually
+# multiplies the host platform (same fallback as tests/conftest.py)
+case "${XLA_FLAGS:-}" in *xla_force_host_platform_device_count*) ;; *)
+    export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=$JAX_NUM_CPU_DEVICES"
+;; esac
 TELDIR="$(mktemp -d)"
 trap 'rm -rf "$TELDIR"' EXIT
 
@@ -77,3 +82,79 @@ python -m flexflow_tpu.obs summary "$TEL/events.jsonl" >/dev/null
 python -m flexflow_tpu.obs trace "$TEL/events.jsonl" -o "$TELDIR/t.json"
 python -m flexflow_tpu.obs prom "$TEL/metrics.jsonl" >/dev/null
 echo "obs_check: CLI OK"
+
+# request flight recorder: a short traced serving run (no kill — the
+# failover leg lives in serving_check.sh / tests); load_check's own
+# criterion 4 validates the trace schema + lifecycle coverage
+REQTEL="$TELDIR/reqtel"
+python scripts/load_check.py --no-kill --replicas 1 --slots 2 \
+    --warm-s 2 --ramp-s 2 --post-s 1 --base-rate 4 --ramp 3 \
+    --search-budget 1 --layers 1 \
+    --telemetry-dir "$REQTEL" --request-sample-rate 1.0 \
+    --json "$TELDIR/load.json" >/dev/null
+python -m flexflow_tpu.obs requests "$REQTEL/events.jsonl" --slowest 3 \
+    >/dev/null
+echo "obs_check: request tracing OK"
+
+# calibration store: explain -> apply persists; a FRESH process loads
+# the store through compile(calibration=...) without re-profiling and
+# prices serial-view ops from the measurement
+CALIB="$TELDIR/calib.json"
+python - "$CALIB" <<'EOF'
+import sys
+
+import flexflow_tpu.obs as obs
+from flexflow_tpu import (
+    ActiMode, DataType, FFConfig, FFModel, LossType, MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.obs.calibration import CalibrationStore
+
+
+def model():
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    m = FFModel(cfg)
+    x = m.create_tensor((8, 8), DataType.DT_FLOAT)
+    t = m.dense(x, 32, ActiMode.AC_MODE_RELU)
+    t = m.softmax(m.dense(t, 3))
+    m.compile(SGDOptimizer(lr=0.1),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    return m
+
+
+ex = obs.explain_strategy(model(), repeats=1, warmup=1)
+n = ex.apply(model(), store=CalibrationStore(sys.argv[1]))
+assert n > 0, "explain produced no measured rows"
+print(f"obs_check: calibration store saved ({n} ops)")
+EOF
+python - "$CALIB" <<'EOF'
+import sys
+
+from flexflow_tpu import (
+    ActiMode, DataType, FFConfig, FFModel, LossType, MetricsType,
+    SGDOptimizer,
+)
+
+cfg = FFConfig()
+cfg.batch_size = 8
+m = FFModel(cfg)
+x = m.create_tensor((8, 8), DataType.DT_FLOAT)
+t = m.dense(x, 32, ActiMode.AC_MODE_RELU)
+t = m.softmax(m.dense(t, 3))
+m.compile(SGDOptimizer(lr=0.1),
+          LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+          [MetricsType.METRICS_ACCURACY], calibration=sys.argv[1])
+cm = m._build_cost_model()
+assert cm.calibration_source == sys.argv[1], cm.calibration_source
+from flexflow_tpu.pcg.machine_view import MachineView
+
+v1 = MachineView(start_device_id=0, dim=(1,), stride=(1,))
+op = next(o for o in m.graph.ops if not o.is_parallel_op)
+cm.measure_operator_cost(op, v1)
+assert cm.measured_hits >= 1, "calibrated op not priced from measurement"
+print("obs_check: fresh-process calibration load OK")
+EOF
+python -m flexflow_tpu.obs calibrate inspect "$CALIB" >/dev/null
+echo "obs_check: calibration round-trip OK"

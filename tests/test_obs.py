@@ -244,6 +244,45 @@ def test_metrics_registry_prometheus_roundtrip():
                for r in snap)
 
 
+def test_metrics_registry_concurrent_hammer():
+    """Regression: concurrent observes/incs across threads — series
+    creation races and reservoir appends must never lose updates or
+    corrupt the sample list (each series carries its own lock; the
+    registry lock guards family/label-map creation only)."""
+    import threading
+
+    reg = MetricsRegistry()
+    threads, per_thread = 8, 500
+    errors = []
+
+    def hammer(tid):
+        try:
+            for i in range(per_thread):
+                reg.counter("ff_hammer_total").inc()
+                reg.gauge("ff_hammer_gauge", worker=str(tid)).set(i)
+                reg.histogram("ff_hammer_seconds").observe(i * 1e-4)
+                reg.histogram("ff_hammer_seconds",
+                              worker=str(tid)).observe(i * 1e-4)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    ts = [threading.Thread(target=hammer, args=(t,)) for t in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors
+    assert reg.counter("ff_hammer_total").value == threads * per_thread
+    h = reg.histogram("ff_hammer_seconds")
+    assert h.count == threads * per_thread
+    assert sum(h.counts) == h.count  # bucket counts consistent
+    for t in range(threads):
+        assert reg.histogram("ff_hammer_seconds",
+                             worker=str(t)).count == per_thread
+    # export is parseable mid-flight state included
+    parse_prometheus(reg.to_prometheus())
+
+
 # ----------------------------------------------------------------------
 # search trajectory
 # ----------------------------------------------------------------------

@@ -1,0 +1,469 @@
+"""Request-level distributed tracing + the persistent calibration store
+(flexflow_tpu/obs/request_trace.py, obs/calibration.py).
+
+The contract: a trace context minted at submit follows ONE request
+through queue -> admission -> prefill -> per-iteration decode ->
+completion, across replica failover, under the SAME trace id — with
+head-based sampling whose off path is the shared allocation-free null
+object. Independently, measured per-op costs persist across processes
+through a fingerprint-checked on-disk store that compile(calibration=)
+attaches without re-profiling.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import flexflow_tpu.obs as obs
+from flexflow_tpu import TelemetryConfig
+from flexflow_tpu.obs.calibration import (
+    CalibrationStore,
+    CalibrationStoreError,
+    op_key_str,
+    resolve_calibration,
+)
+from flexflow_tpu.obs.request_trace import (
+    NULL_REQUEST_TRACE,
+    SLOMonitor,
+    _sampled,
+    mint_request_trace,
+    record_request_stages,
+)
+from flexflow_tpu.obs.tracer import lanes_from_events, read_events_jsonl
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_session():
+    obs.finish()
+    yield
+    obs.finish()
+
+
+def _request_events(events, rid):
+    return [e for e in events
+            if e.get("cat") == "requests"
+            and e.get("args", {}).get("request") == rid]
+
+
+# ----------------------------------------------------------------------
+# sampling + the null fast path
+# ----------------------------------------------------------------------
+def test_no_session_mints_shared_null_trace():
+    t1 = mint_request_trace("a")
+    t2 = mint_request_trace("b")
+    assert t1 is NULL_REQUEST_TRACE and t2 is NULL_REQUEST_TRACE
+    assert not t1.sampled
+    # every lifecycle method is a no-op, including the span protocol
+    t1.queue_begin()
+    t1.admitted("r0")
+    sp = t1.span("prefill", replica="r0")
+    sp.set(x=1)
+    sp.done()
+    t1.iteration("r0", t0=0.0, dur_s=0.0)
+    t1.requeued("r0", generation=1)
+    t1.shed("deadline", stage="decode")
+    t1.completed("r0")
+
+
+def test_sampling_is_deterministic_and_rate_shaped(tmp_path):
+    ids = [f"req-{i}" for i in range(400)]
+    # same id -> same verdict, across calls (failover re-mint safety)
+    for rid in ids[:20]:
+        assert _sampled(rid, 0.5) == _sampled(rid, 0.5)
+    assert all(_sampled(rid, 1.0) for rid in ids)
+    assert not any(_sampled(rid, 0.0) for rid in ids)
+    hit = sum(1 for rid in ids if _sampled(rid, 0.25))
+    assert 0.10 * len(ids) < hit < 0.40 * len(ids)
+    with obs.session(TelemetryConfig(dir=str(tmp_path),
+                                     request_sample_rate=0.0)):
+        assert mint_request_trace("anything") is NULL_REQUEST_TRACE
+    with obs.session(TelemetryConfig(dir=str(tmp_path / "on"),
+                                     request_sample_rate=1.0)):
+        tr = mint_request_trace("req-9")
+        assert tr.sampled and tr.trace_id == "req-9"
+
+
+# ----------------------------------------------------------------------
+# stage decomposition + SLO monitor
+# ----------------------------------------------------------------------
+class _FakeReq:
+    def __init__(self, *, submitted, admitted, first, finished,
+                 max_new_tokens=8):
+        self.submitted_t = submitted
+        self.admitted_t = admitted
+        self.first_token_t = first
+        self.finished_t = finished
+        self.max_new_tokens = max_new_tokens
+
+
+def test_record_request_stages_decomposition(tmp_path):
+    t0 = time.monotonic() - 10.0
+    req = _FakeReq(submitted=t0, admitted=t0 + 1.0, first=t0 + 1.5,
+                   finished=t0 + 5.5)
+    with obs.session(TelemetryConfig(dir=str(tmp_path))) as tel:
+        stages = record_request_stages(req, generated=5)
+        assert stages["queue"] == pytest.approx(1.0)
+        assert stages["prefill"] == pytest.approx(0.5)
+        assert stages["decode"] == pytest.approx(4.0)
+        assert stages["total"] == pytest.approx(5.5)
+        assert stages["stall"] == pytest.approx(0.0)
+        assert stages["tpot"] == pytest.approx(1.0)  # 4s / (5-1) tokens
+        for stage in ("queue", "prefill", "decode", "total", "tpot"):
+            h = tel.metrics.find("ff_request_stage_seconds", stage=stage)
+            assert h is not None and h.count == 1
+    # a failover-delayed request: the lost first attempt shows as stall
+    req2 = _FakeReq(submitted=t0, admitted=t0 + 4.0, first=t0 + 4.5,
+                    finished=t0 + 6.5)
+    stages2 = record_request_stages(req2, generated=3)
+    assert stages2["stall"] == pytest.approx(0.0)
+    assert stages2["queue"] == pytest.approx(4.0)
+
+
+def test_slo_monitor_targets_and_scale_signal(tmp_path):
+    inert = SLOMonitor()
+    assert not inert.enabled
+    inert.observe(ttft_s=99.0, latency_s=99.0)
+    assert not inert.should_scale_up()
+    assert inert.violation_rate() != inert.violation_rate()  # NaN
+
+    with obs.session(TelemetryConfig(dir=str(tmp_path))) as tel:
+        m = SLOMonitor(ttft_target_s=0.1, latency_p99_target_s=1.0)
+        for _ in range(10):
+            m.observe(ttft_s=0.05, latency_s=0.5)  # all within target
+        assert not m.should_scale_up()
+        assert m.violation_rate("ttft") == 0.0
+        for _ in range(5):
+            m.observe(ttft_s=0.3, latency_s=0.5)  # ttft violations
+        assert m.should_scale_up()
+        assert m.violation_rate("ttft") == pytest.approx(5 / 15)
+        assert m.violation_rate("p99_latency") == 0.0
+        assert m.violation_rate() == pytest.approx(5 / 15)  # worst window
+        c = tel.metrics.find("ff_slo_violations_total", slo="ttft")
+        assert c is not None and c.value == 5.0
+        assert m.latency_quantile(0.5) == 0.5
+        assert m.sample_count == 15
+        snap = m.snapshot()
+        assert snap["violations"]["ttft"] == 5
+
+
+# ----------------------------------------------------------------------
+# end-to-end: spans across replica tracks + failover propagation
+# ----------------------------------------------------------------------
+def _build_lm():
+    from tests.test_serving import build_lm
+
+    return build_lm()
+
+
+def test_request_spans_render_across_replica_tracks(tmp_path):
+    """Acceptance: a sampled request's life — queue -> admit -> prefill
+    -> decode iterations -> complete — lands as schema-valid events on a
+    named per-replica lane, and the exported trace.json carries the
+    Perfetto thread_name metadata for that lane."""
+    from flexflow_tpu.runtime.serving import ReplicaSet
+    from tests.test_serving import VOCAB, build_lm
+    from tests.test_serving import _serve_cfg
+
+    tel_dir = tmp_path / "tel"
+    rng = np.random.RandomState(11)
+    with obs.session(TelemetryConfig(dir=str(tel_dir),
+                                     request_sample_rate=1.0)):
+        rs = ReplicaSet(build_lm, _serve_cfg(), replicas=1,
+                        health_timeout_s=60.0).start()
+        try:
+            reqs = [rs.submit(rng.randint(0, VOCAB, 3).astype(np.int32),
+                              max_new_tokens=4, deadline_s=120.0)
+                    for _ in range(3)]
+            for r in reqs:
+                r.result(timeout=120.0)
+                assert r.trace.sampled and r.trace.trace_id == r.id
+        finally:
+            rs.stop()
+    events, problems = read_events_jsonl(str(tel_dir / "events.jsonl"))
+    assert not problems  # request events obey the tracer schema
+    rid = reqs[0].id
+    mine = _request_events(events, rid)
+    names = [e["name"] for e in mine]
+    for expected in ("queue", "admit", "prefill", "decode", "complete"):
+        assert expected in names, f"missing {expected} for {rid}: {names}"
+    assert names.count("complete") == 1
+    # decode iterations are spans with occupancy/pos payloads
+    decode = [e for e in mine if e["name"] == "decode"]
+    assert all(e["ph"] == "X" for e in decode)
+    assert all(e["args"]["occupancy"] >= 1 for e in decode)
+    # the kv accounting shows up on the sampled trace
+    assert any(e["name"] == "kv_reserve" for e in mine)
+    # the replica lane is named, and events actually sit on it
+    lanes = lanes_from_events(events)
+    rep_lanes = {name: tid for (cat, name), tid in lanes.items()
+                 if cat == "requests" and name != "admission"}
+    assert rep_lanes, f"no replica lane recorded: {lanes}"
+    admit = next(e for e in mine if e["name"] == "admit")
+    assert admit["tid"] in rep_lanes.values()
+    # exported trace is Perfetto-loadable with named tracks
+    trace = json.load(open(tel_dir / "trace.json"))
+    assert "traceEvents" in trace
+    tnames = [m["args"]["name"] for m in trace["traceEvents"]
+              if m.get("ph") == "M" and m.get("name") == "thread_name"]
+    assert set(rep_lanes) <= set(tnames)
+    # per-stage histograms populated for every completed request
+    metrics = open(tel_dir / "metrics.prom").read()
+    assert "ff_request_stage_seconds" in metrics
+
+
+def test_trace_context_survives_replica_failover(tmp_path):
+    """Kill a replica mid-decode (replica_death fault site): every
+    requeued request must finish under its ORIGINAL trace id, with a
+    requeue event carrying the new generation tag and exactly one
+    complete event."""
+    from flexflow_tpu.runtime.resilience import FaultInjector
+    from flexflow_tpu.runtime.serving import ReplicaDeathError, ReplicaSet
+    from tests.test_serving import VOCAB, _serve_cfg, build_lm
+
+    fi = FaultInjector()
+    fi.inject("replica_death", at_step=2, replica="replica0",
+              exc=ReplicaDeathError("injected"))
+    tel_dir = tmp_path / "tel"
+    rng = np.random.RandomState(12)
+    with obs.session(TelemetryConfig(dir=str(tel_dir),
+                                     request_sample_rate=1.0)):
+        rs = ReplicaSet(build_lm, _serve_cfg(), replicas=2,
+                        ckpt_dir=str(tmp_path / "ckpt"),
+                        fault_injector=fi, health_timeout_s=60.0,
+                        restart_backoff_s=0.05).start()
+        try:
+            reqs = [rs.submit(rng.randint(0, VOCAB, 3).astype(np.int32),
+                              max_new_tokens=5, deadline_s=120.0)
+                    for _ in range(6)]
+            for r in reqs:
+                r.result(timeout=180.0)
+        finally:
+            rs.stop()
+    assert fi.fired["replica_death"] == 1
+    events, problems = read_events_jsonl(str(tel_dir / "events.jsonl"))
+    assert not problems
+    requeued = {e["args"]["request"]: e for e in events
+                if e.get("cat") == "requests" and e["name"] == "requeue"}
+    assert requeued, "the death stranded no request — fault not exercised"
+    for rid, ev in requeued.items():
+        assert ev["args"]["generation"] >= 1
+        mine = _request_events(events, rid)
+        names = [e["name"] for e in mine]
+        # exactly-once completion under the original trace id
+        assert names.count("complete") == 1
+        # the requeued request waited in queue again, then re-admitted
+        assert names.count("queue") >= 2
+        assert names.count("admit") >= 2
+        done = next(e for e in mine if e["name"] == "complete")
+        assert done["args"]["generation"] >= 1  # finished by the 2nd owner
+
+
+# ----------------------------------------------------------------------
+# calibration store
+# ----------------------------------------------------------------------
+KEY = ("OP_LINEAR", (("out_dim", 16),), (("DT_FLOAT", (8, 4)),),
+       (("DT_FLOAT", (4, 16)),))
+
+
+def test_calibration_store_roundtrip_and_table(tmp_path):
+    p = str(tmp_path / "calib.json")
+    st = CalibrationStore(p)
+    assert st.record_op(KEY, 1e-3, 2e-3)
+    assert not st.record_op(KEY, float("nan"), 1.0)  # NaN skipped
+    st.record_globals(overlap_efficiency=0.66,
+                      collectives={"all_reduce": 1e10})
+    assert st.dirty
+    st.save()
+    assert not st.dirty
+    st2 = CalibrationStore(p)
+    assert st2.globals["overlap_efficiency"] == 0.66
+    tbl = st2.table()
+    assert len(tbl) == 1
+    assert tbl.get(KEY) == (1e-3, 2e-3)
+    assert tbl.get(("OP_RELU", (), (), ())) is None
+    assert tbl.source == p
+    assert op_key_str(KEY) in st2.ops
+    # same-process fingerprint/backend: usable
+    assert st2.problems() == []
+
+
+def test_calibration_store_rejects_mismatch_and_staleness(tmp_path):
+    p = str(tmp_path / "calib.json")
+    st = CalibrationStore(p)
+    st.record_op(KEY, 1e-3, 2e-3)
+    st.save()
+    doc = json.load(open(p))
+    # a different topology: rejected with the differing keys named
+    doc["fingerprint"] = {"num_devices": 4096, "platform": "tpu"}
+    json.dump(doc, open(p, "w"))
+    st2 = CalibrationStore(p)
+    probs = st2.problems()
+    assert any("fingerprint mismatch" in s for s in probs)
+    tbl, glb = resolve_calibration(p)
+    assert tbl is None and glb == {}
+    # stale entries: rejected, then prunable
+    doc["fingerprint"] = {}
+    doc["ops"][op_key_str(KEY)]["recorded_at"] = time.time() - 90 * 86400
+    json.dump(doc, open(p, "w"))
+    st3 = CalibrationStore(p)
+    assert any("stale" in s for s in st3.problems())
+    assert st3.prune(max_age_s=30 * 86400) == 1
+    assert len(st3.ops) == 0
+    assert any("empty" in s for s in st3.problems())
+    # schema mismatch is a typed error
+    json.dump({"schema_version": 999}, open(p, "w"))
+    with pytest.raises(CalibrationStoreError):
+        CalibrationStore(p)
+    tbl, glb = resolve_calibration(p)  # rejected, not raised
+    assert tbl is None
+
+
+def test_calibration_store_diff(tmp_path):
+    a = CalibrationStore(str(tmp_path / "a.json"))
+    b = CalibrationStore(str(tmp_path / "b.json"))
+    a.record_op(KEY, 1e-3, 2e-3)
+    b.record_op(KEY, 2e-3, 4e-3)
+    b.record_op(("OP_RELU", (), (), ()), 1e-4, 1e-4)
+    delta = a.diff(b)
+    changed = [d for d in delta if d["status"] == "changed"]
+    assert len(changed) == 1 and changed[0]["ratio"] == pytest.approx(2.0)
+    assert any(d["status"] == "only_in_b" for d in delta)
+
+
+def test_explain_apply_persists_and_compile_loads(tmp_path):
+    """The acceptance loop in-process: explain -> apply persists measured
+    costs; a later compile(calibration=path) attaches them so the cost
+    model prices serial views from measurement WITHOUT re-profiling."""
+    from flexflow_tpu.pcg.machine_view import MachineView
+    from tests.test_obs import small_model
+
+    p = str(tmp_path / "calib.json")
+    m = small_model()
+    ex = obs.explain_strategy(m, repeats=1, warmup=1)
+    store = CalibrationStore(p)
+    n = ex.apply(m, store=store)
+    assert n == len(ex.rows) and os.path.exists(p)
+    assert store.globals.get("overlap_efficiency") is not None
+
+    # "fresh model" standing in for a fresh process: calibration by path
+    m2 = small_model()
+    tbl, glb = resolve_calibration(p)
+    assert tbl is not None and len(tbl) == len(ex.rows)
+    m2._profiled_op_costs = tbl
+    cm = m2._build_cost_model()
+    assert cm.calibration_source == p
+    v1 = MachineView(start_device_id=0, dim=(1,), stride=(1,))
+    op = next(o for o in m2.graph.ops if not o.is_parallel_op)
+    row = next(r for r in ex.rows if r["name"] == op.name)
+    got = cm.measure_operator_cost(op, v1)
+    assert got.forward_time == pytest.approx(row["meas_fwd_s"])
+    assert cm.measured_hits >= 1
+    prov = cm.provenance()
+    assert prov["source"] == p and prov["measured_hits"] >= 1
+
+
+def test_compile_calibration_kwarg_and_perf_provenance(tmp_path):
+    """compile(calibration=path) is the public seam: the searched model's
+    cost model resolves measured costs and perf_diagnostics reports the
+    oracle's provenance as the FFA500 INFO line."""
+    from flexflow_tpu import (
+        ActiMode,
+        DataType,
+        FFConfig,
+        FFModel,
+        LossType,
+        MetricsType,
+        SGDOptimizer,
+    )
+    from flexflow_tpu.analysis.perf import perf_diagnostics
+    from tests.test_obs import small_model
+
+    p = str(tmp_path / "calib.json")
+    m = small_model()
+    ex = obs.explain_strategy(m, repeats=1, warmup=1)
+    ex.apply(m, store=CalibrationStore(p))
+
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.search_budget = -1
+    m2 = FFModel(cfg)
+    x = m2.create_tensor((8, 4), DataType.DT_FLOAT)
+    t = m2.dense(x, 16, ActiMode.AC_MODE_RELU)
+    t = m2.dense(t, 3)
+    t = m2.softmax(t)
+    m2.compile(SGDOptimizer(lr=0.1),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], calibration=p)
+    cm = m2._build_cost_model()
+    assert cm.calibration_source == p
+    rep = perf_diagnostics(m2.graph,
+                           views=getattr(m2, "searched_views", None),
+                           cost_model=cm)
+    info = [d for d in rep.diagnostics if d.code == "FFA500"]
+    assert len(info) == 1 and p in info[0].message
+
+
+# ----------------------------------------------------------------------
+# CLI: requests + calibrate subcommands
+# ----------------------------------------------------------------------
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "flexflow_tpu.obs", *argv],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def test_cli_requests_report(tmp_path):
+    from flexflow_tpu.runtime.serving import ReplicaSet
+    from tests.test_serving import VOCAB, _serve_cfg, build_lm
+
+    tel_dir = tmp_path / "tel"
+    rng = np.random.RandomState(13)
+    with obs.session(TelemetryConfig(dir=str(tel_dir),
+                                     request_sample_rate=1.0)):
+        rs = ReplicaSet(build_lm, _serve_cfg(), replicas=1,
+                        health_timeout_s=60.0).start()
+        try:
+            reqs = [rs.submit(rng.randint(0, VOCAB, 3).astype(np.int32),
+                              max_new_tokens=3, deadline_s=120.0)
+                    for _ in range(2)]
+            for r in reqs:
+                r.result(timeout=120.0)
+        finally:
+            rs.stop()
+    r = _run_cli("requests", str(tel_dir / "events.jsonl"), "--slowest", "5")
+    assert r.returncode == 0, r.stderr
+    assert "traced request(s)" in r.stdout
+    assert "2 completed" in r.stdout
+    assert reqs[0].id[:14] in r.stdout
+    # empty log is a loud non-zero exit
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    r2 = _run_cli("requests", str(empty))
+    assert r2.returncode == 1
+
+
+def test_cli_calibrate_inspect_prune_diff(tmp_path):
+    pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    a, b = CalibrationStore(pa), CalibrationStore(pb)
+    a.record_op(KEY, 1e-3, 2e-3)
+    b.record_op(KEY, 3e-3, 6e-3)
+    a.save(), b.save()
+    r = _run_cli("calibrate", "inspect", pa)
+    assert r.returncode == 0, r.stderr
+    assert '"ops": 1' in r.stdout and "usable" in r.stdout
+    r = _run_cli("calibrate", "diff", pa, pb)
+    assert r.returncode == 0 and "x3.000" in r.stdout
+    r = _run_cli("calibrate", "prune", pa, "--max-age-h", "0")
+    assert r.returncode == 0 and "pruned 1" in r.stdout
+    r = _run_cli("calibrate", "inspect", pa)
+    assert r.returncode == 1  # now empty -> unusable, exit 1
+    r = _run_cli("calibrate", "diff", pa)
+    assert r.returncode == 2  # missing second path -> argparse error
