@@ -36,6 +36,20 @@ These passes audit the CHOSEN strategy before it executes:
     unknown collective kinds are a typed warning instead of a silent
     estimate skip, and the all-to-all kind is modelled + exported).
 
+The FFA6xx family audits fault-domain ROBUSTNESS of the strategy on
+multi-slice machines (search/survivability.py; runtime counterpart in
+runtime/fault_domains.py):
+
+  * FFA600 — survivability summary (INFO): the strategy spans slices
+    and only data-parallel replicas cross the boundary — a whole-slice
+    loss shrinks the run instead of forcing a full reshard.
+  * FFA601 — slice-loss survivability (WARNING): an op shards weights
+    across the slice boundary; losing any one slice takes shard pieces
+    that exist nowhere else and recovery needs a full reshard/restore
+    from checkpoint. The search's configurable penalty
+    (config.search_survivability_penalty) biases away from this; the
+    lint reports what remains.
+
 Entry: ``perf_diagnostics(graph, views, cost_model=..., executor=...)``;
 wired into ``analyze_graph``/``analyze_model`` as the "perf" and
 "schedule" passes, into ``compile()`` (core/model.py warns on errors
@@ -95,6 +109,10 @@ def perf_diagnostics(
     _padding_roofline_diagnostics(graph, views, machine, rep)
     if machine is not None:
         _topology_cost_diagnostics(graph, views, machine, rep)
+        if machine.num_nodes > 1:
+            # FFA6xx fires only where a slice boundary exists — single-
+            # node machines have no fault domain to lose
+            _survivability_diagnostics(graph, views, machine, rep)
     if executor is not None:
         sched = executor.overlap_schedule()
         if sched is not None:
@@ -342,6 +360,50 @@ def _topology_cost_diagnostics(graph, views, machine,
                     "placement would be cheaper)",
                     op=op,
                 )
+
+
+# ----------------------------------------------------------------------
+# FFA600/FFA601 — slice-loss survivability
+# ----------------------------------------------------------------------
+def _survivability_diagnostics(graph, views, machine,
+                               rep: AnalysisReport) -> None:
+    from ..search.survivability import (
+        CROSS_SLICE_SHARDED,
+        strategy_survivability,
+    )
+
+    s = strategy_survivability(graph, views or {}, machine=machine)
+    for o in s.ops:
+        if o.status != CROSS_SLICE_SHARDED:
+            continue
+        op = next((x for x in graph.topo_order() if x.guid == o.guid), None)
+        rep.add(
+            Severity.WARNING, "FFA601",
+            f"strategy not slice-loss-survivable: op {o.name} shards "
+            f"weights {o.partition_degree}-way across slices "
+            f"{list(o.spanned_slices)} (per-slice devices "
+            f"{list(o.per_slice_devices)}, "
+            f"{o.weight_bytes / 1e6:.2f} MB of parameters); losing any "
+            "one slice destroys weight shards held nowhere else — "
+            "recovery requires a full reshard/restore from checkpoint "
+            "instead of dropping a data-parallel replica",
+            op=op,
+            fix_hint="confine the model/FSDP sharding within one slice "
+                     f"(weight partition degree <= "
+                     f"{machine.workers_per_node} devices/slice) and let "
+                     "only data-parallel replication cross the DCN "
+                     "boundary; search_survivability_penalty > 0 biases "
+                     "the search this way",
+        )
+    if s.survivable and s.spans_slices and s.total_weight_bytes > 0:
+        rep.add(
+            Severity.INFO, "FFA600",
+            f"strategy is slice-loss-survivable: every weight shard set "
+            f"is complete within one slice across all "
+            f"{s.num_slices} slices — a whole-slice loss only drops "
+            "data-parallel replicas and the run shrinks onto the "
+            "survivors",
+        )
 
 
 # ----------------------------------------------------------------------

@@ -45,6 +45,17 @@ class FFConfig:
     # reproducible against earlier rounds; --overlap-backward-update
     # turns both sides on.
     search_overlap_backward_update: bool = False
+    # Slice-loss survivability bias (search/survivability.py): on
+    # hierarchical multi-slice machines the search multiplies a
+    # candidate's cost by 1 + penalty * (fraction of weight bytes whose
+    # shards cross the slice boundary), preferring strategies where only
+    # data-parallel replicas span slices — a preemption then shrinks the
+    # run instead of forcing a full reshard (FFA601 lints what remains).
+    # -1.0 = auto: 0.25 on hierarchical multi-node machines, 0 elsewhere.
+    # 0 disables; larger = stronger preference (still not a hard
+    # constraint — a cross-slice strategy that is MUCH faster per step
+    # can outbid the penalty).
+    search_survivability_penalty: float = -1.0
     # Executed-step side (reference config.h:133 overlap_backward_update):
     # decompose the data-parallel gradient all-reduce into per-weight
     # reduce-scatter + sharded optimizer update + all-gather of updated

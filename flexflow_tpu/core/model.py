@@ -11,6 +11,7 @@ end_trace — here one fused jitted step per iteration).
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 import warnings
@@ -859,6 +860,21 @@ class FFModel:
 
         # 4. Build executor + initialize weights (reference: optimizer->init,
         #    NCCL communicator setup — here: jit + shardings)
+        plan_cost_model = self._build_cost_model()
+        # Slice fault domains (runtime/fault_domains.py): on a multi-node
+        # machine each node (slice) is a failure domain — recorded on the
+        # model so the checkpoint sidecar, the health monitor and fit()'s
+        # failure classification all share one map. None on single-node
+        # machines (no slice boundary exists) or when the machine model
+        # doesn't describe the actual mesh.
+        _machine = plan_cost_model.machine
+        if (_machine.num_nodes > 1
+                and _machine.num_workers == int(mesh.devices.size)):
+            from ..runtime.fault_domains import FaultDomainMap
+
+            self.fault_domains = FaultDomainMap.from_machine(_machine)
+        else:
+            self.fault_domains = None
         compute_dtype = (
             jnp.bfloat16 if self.config.allow_mixed_precision else None
         )
@@ -890,7 +906,7 @@ class FFModel:
             input_order=ordered_inputs,
             remat=self.config.remat,
             constants=constants,
-            plan_cost_model=self._build_cost_model(),
+            plan_cost_model=plan_cost_model,
             overlap_grad_sync=self.config.overlap_backward_update,
         )
         self.search_trajectory.phase("executor_build", _t_phase)
@@ -930,9 +946,15 @@ class FFModel:
                 dcn_bandwidth=machine.dcn_bandwidth,
                 chip=machine.chip,
             )
+        pen = cfg.search_survivability_penalty
+        if pen < 0:
+            # auto: bias toward slice-loss-survivable strategies only
+            # where slices exist as failure domains (multi-node machine)
+            pen = 0.25 if machine.num_nodes > 1 else 0.0
         cm = CostModel(
             machine, bf16=cfg.allow_mixed_precision,
             overlap_backward_update=cfg.search_overlap_backward_update,
+            survivability_penalty=pen,
         )
         profiled = getattr(self, "_profiled_op_costs", None)
         if profiled:
@@ -1491,21 +1513,76 @@ class FFModel:
             # elastic/health_monitor, the elastic runtime's topology-
             # change resume and hang watchdog ride along
             # (runtime/elastic.py)
-            return self._fit_resilient(
-                xs, y, bs, ep, verbose,
-                checkpoint_dir=checkpoint_dir,
-                checkpoint_every_n_steps=checkpoint_every_n_steps,
-                keep_last_n=keep_last_n, resume=resume,
-                skip_nonfinite_steps=skip_nonfinite_steps,
-                step_guard=step_guard,
-                max_consecutive_skips=max_consecutive_skips,
-                fault_injector=fault_injector,
-                preemption_signal=preemption_signal,
-                elastic=elastic,
-                health_monitor=health_monitor,
-                canary=canary,
-                tel=tel,
-            )
+            from ..runtime import resilience as _rz
+            from ..runtime.elastic import shrunk_devices as _shrunk_devices
+
+            failover_stack = contextlib.ExitStack()
+            failovers = 0
+            try:
+                while True:
+                    try:
+                        return self._fit_resilient(
+                            xs, y, bs, ep, verbose,
+                            checkpoint_dir=checkpoint_dir,
+                            checkpoint_every_n_steps=checkpoint_every_n_steps,
+                            keep_last_n=keep_last_n, resume=resume,
+                            skip_nonfinite_steps=skip_nonfinite_steps,
+                            step_guard=step_guard,
+                            max_consecutive_skips=max_consecutive_skips,
+                            fault_injector=fault_injector,
+                            preemption_signal=preemption_signal,
+                            elastic=elastic,
+                            health_monitor=health_monitor,
+                            canary=canary,
+                            tel=tel,
+                        )
+                    except (_rz.SliceLossError, _rz.SliceDrained) as e:
+                        # slice-granular failover: a SIMULATED whole-slice
+                        # loss / drained preemption carries the surviving
+                        # device count, so an elastic fit can shrink the
+                        # visible device set in-process, re-search for the
+                        # survivors and resume from the checkpoint the
+                        # handler just flushed. Real (non-simulated)
+                        # losses re-raise for the orchestrator, whose
+                        # restart lands in restore_elastic.
+                        surv = getattr(e, "surviving_devices", None)
+                        if (not elastic or checkpoint_dir is None
+                                or surv is None
+                                or not getattr(e, "simulated", False)
+                                or failovers >= 3):
+                            raise
+                        failovers += 1
+                        obs.event(
+                            "slice_failover", cat="runtime", step=e.step,
+                            kind=type(e).__name__,
+                            surviving_devices=surv, attempt=failovers,
+                        )
+                        obs.count(
+                            "ff_slice_failovers_total",
+                            help="in-process shrink-onto-survivors "
+                                 "failovers (fit elastic=True)",
+                        )
+                        obs.progress(
+                            f"[elastic] {type(e).__name__} at step "
+                            f"{e.step}: shrinking onto {surv} device(s), "
+                            "re-searching and resuming from "
+                            f"{e.checkpoint_path or 'last checkpoint'}",
+                            verbose=verbose, name="slice_failover",
+                            cat="runtime", step=e.step,
+                            surviving_devices=surv,
+                        )
+                        if surv < len(jax.devices()):
+                            failover_stack.enter_context(
+                                _shrunk_devices(surv)
+                            )
+                        if preemption_signal is not None:
+                            preemption_signal.clear()
+                        # the drain checkpoint is the resume point
+                        resume = True
+                        # loop: re-entry sees mesh_is_live() False ->
+                        # recompile_for_topology + checkpoint restore
+            finally:
+                failover_stack.close()
         # guard residue from a previous resilient fit would change the
         # step signature; drop it for the fast unguarded paths
         if self.executor.step_guard is not None:
@@ -1825,8 +1902,21 @@ class FFModel:
             )
         every = checkpoint_every_n_steps or steps_per_epoch
         preempt = preemption_signal or rz.PreemptionSignal()
+        # drain-protocol state: how many steps ran inside a preemption
+        # notice's grace window, whether the notice came from the fault
+        # injector (simulated -> in-process failover may shrink devices
+        # itself), and the last measured checkpoint-flush duration (feeds
+        # the executor's drain-window estimate)
+        drain_steps = 0
+        drain_simulated = False
+        drain_max_steps = None
+        last_ckpt_dur_s = None
         mon = health_monitor
         if mon is not None:
+            if getattr(mon, "fault_domains", None) is None:
+                # share compile()'s fault-domain map so peer staleness
+                # classifies per slice (host loss vs whole-slice loss)
+                mon.fault_domains = getattr(self, "fault_domains", None)
             mon.start()
 
         # the canary re-executes steps from the pre-step state, which
@@ -1905,11 +1995,143 @@ class FFModel:
                             preempt.trigger(
                                 graceful=plan.get("graceful", True)
                             )
-                    if preempt.triggered():
+                    if fault_injector is not None:
+                        plan = fault_injector.fire("preemption_notice",
+                                                   global_step)
+                        if plan is not None:
+                            # deadline-bearing drain notice (simulated pod
+                            # manager grace): arm the signal WITH its
+                            # deadline; the drain protocol below uses the
+                            # grace window instead of stopping immediately
+                            preempt.trigger(
+                                graceful=True,
+                                deadline_s=plan.get("deadline_s", 30.0),
+                                leaving_slice=plan.get("slice"),
+                                surviving_devices=plan.get(
+                                    "surviving_devices"
+                                ),
+                            )
+                            drain_simulated = True
+                            if plan.get("max_drain_steps") is not None:
+                                drain_max_steps = int(
+                                    plan["max_drain_steps"]
+                                )
+                    if preempt.triggered() and not preempt.draining:
                         raise rz.TrainingPreempted(
                             f"preempted before step {global_step}",
                             step=global_step, graceful=preempt.graceful,
                         )
+                    if preempt.draining:
+                        # -- drain protocol: the notice granted a grace
+                        # deadline. Keep training while the remaining
+                        # grace comfortably exceeds one more step + a
+                        # checkpoint flush (executor drain window), then
+                        # flush a final checkpoint and hand off to the
+                        # slice failover (fit(elastic=True)) / the
+                        # orchestrator BEFORE the deadline lands.
+                        remaining = preempt.deadline_remaining()
+                        window = self.executor.drain_window_s(
+                            checkpoint_s=last_ckpt_dur_s
+                        )
+                        if drain_steps == 0:
+                            obs.event(
+                                "preemption_notice", cat="runtime",
+                                step=global_step,
+                                deadline_s=preempt.deadline_s,
+                                leaving_slice=preempt.leaving_slice,
+                                surviving_devices=preempt.surviving_devices,
+                            )
+                            obs.progress(
+                                f"[resilience] preemption notice: "
+                                f"{preempt.deadline_s:.1f}s grace"
+                                + (f", slice {preempt.leaving_slice} "
+                                   "leaving"
+                                   if preempt.leaving_slice is not None
+                                   else "")
+                                + f"; draining (window {window:.2f}s)",
+                                verbose=verbose, name="preemption_notice",
+                                cat="runtime", step=global_step,
+                            )
+                        if remaining <= window or (
+                            drain_max_steps is not None
+                            and drain_steps >= drain_max_steps
+                        ):
+                            exc = rz.SliceDrained(
+                                f"drained {drain_steps} step(s) under a "
+                                f"{preempt.deadline_s:.1f}s preemption "
+                                f"deadline before step {global_step}",
+                                step=global_step,
+                                deadline_s=preempt.deadline_s,
+                                drained_steps=drain_steps,
+                                leaving_slice=preempt.leaving_slice,
+                                surviving_devices=preempt.surviving_devices,
+                            )
+                            exc.simulated = drain_simulated
+                            if manager is not None:
+                                exc.checkpoint_path = \
+                                    self._save_resilient_ckpt(
+                                        manager, global_step, epoch, bi
+                                    )
+                            left = preempt.deadline_remaining()
+                            exc.met_deadline = (left is None or left >= 0.0)
+                            self.search_trajectory.event(
+                                "slice_drain", step=global_step,
+                                deadline_s=preempt.deadline_s,
+                                drained_steps=drain_steps,
+                                met_deadline=exc.met_deadline,
+                                leaving_slice=preempt.leaving_slice,
+                            )
+                            obs.event(
+                                "slice_drain", cat="runtime",
+                                step=global_step,
+                                drained_steps=drain_steps,
+                                met_deadline=exc.met_deadline,
+                                checkpoint=exc.checkpoint_path,
+                            )
+                            raise exc
+                        drain_steps += 1
+                    if fault_injector is not None:
+                        plan = fault_injector.fire("slice_loss", global_step)
+                        if plan is not None:
+                            # an entire fault domain vanished at once —
+                            # the slice-granular analog of host_loss. The
+                            # TrainingPreempted handler below flushes the
+                            # final checkpoint; fit(elastic=True) then
+                            # shrinks onto the survivors and resumes.
+                            lost = plan.get("slice")
+                            surv = plan.get("surviving_devices")
+                            if surv is None and lost is not None and \
+                                    getattr(self, "fault_domains", None):
+                                surv = len(self.fault_domains
+                                           .surviving_devices([lost]))
+                            err = rz.SliceLossError(
+                                f"slice {lost} lost before step "
+                                f"{global_step}",
+                                step=global_step,
+                                graceful=plan.get("graceful", True),
+                                lost_slice=lost,
+                                surviving_devices=surv,
+                            )
+                            err.simulated = True
+                            self.search_trajectory.event(
+                                "slice_lost", step=global_step,
+                                slice=lost, surviving_devices=surv,
+                            )
+                            obs.event("slice_lost", cat="runtime",
+                                      step=global_step, slice=lost,
+                                      surviving_devices=surv)
+                            obs.count(
+                                "ff_slice_losses_total",
+                                help="whole-slice losses (real + injected)",
+                            )
+                            if lost is not None:
+                                obs.gauge_set(
+                                    "ff_slice_healthy", 0.0,
+                                    help="1 while a fault domain's hosts "
+                                         "all heartbeat, 0 once lost",
+                                    slice=lost,
+                                )
+                            raise err
                     if fault_injector is not None:
                         plan = fault_injector.fire("host_loss", global_step)
                         if plan is not None:
@@ -1933,15 +2155,42 @@ class FFModel:
                             # watchdog detects the stall and releases us
                             mon.simulate_hang()
                         if mon.hang_detected:
+                            info = mon.hang_info
+                            if info.get("kind") == "slice_loss":
+                                # every host of a slice stopped
+                                # heartbeating: whole-slice loss, not a
+                                # straggler — flush-and-exit through the
+                                # slice-granular error so recovery shrinks
+                                # onto the survivors instead of waiting
+                                # for the dead slice
+                                lost = (info.get("lost_slices")
+                                        or [None])[0]
+                                err = rz.SliceLossError(
+                                    "health watchdog: whole-slice loss "
+                                    f"detected before step {global_step} "
+                                    f"({info.get('classification', info)})",
+                                    step=global_step, lost_slice=lost,
+                                    surviving_devices=info.get(
+                                        "surviving_devices"
+                                    ),
+                                )
+                                self.search_trajectory.event(
+                                    "slice_lost", step=global_step,
+                                    slice=lost,
+                                    surviving_devices=info.get(
+                                        "surviving_devices"
+                                    ),
+                                )
+                                raise err
                             raise rz.CollectiveTimeout(
                                 "health watchdog: "
-                                f"{mon.hang_info.get('kind', 'hang')} "
+                                f"{info.get('kind', 'hang')} "
                                 f"detected before step {global_step} "
-                                f"({mon.hang_info})",
-                                step=global_step, info=mon.hang_info,
+                                f"({info})",
+                                step=global_step, info=info,
                             )
                         mon.step_started(global_step)
-                    t0 = time.perf_counter() if tel is not None else 0.0
+                    t0 = time.perf_counter()
                     bx = [
                         self.executor.shard_batch(
                             pt, np.asarray(a, pt.data_type.np_dtype)
@@ -1970,6 +2219,15 @@ class FFModel:
                         # hang detection (documented in docs/resilience.md)
                         jax.block_until_ready(partials["loss"])
                         mon.step_finished(global_step)
+                    if mon is not None or preempt.draining:
+                        # feed the executor's step-time EMA (drain-window
+                        # estimate) — only from synced steps, where the
+                        # wall time measures the step and not a dispatch
+                        if mon is None:
+                            jax.block_until_ready(partials["loss"])
+                        self.executor.note_step_duration(
+                            time.perf_counter() - t0
+                        )
                     if canary is not None:
                         prev_pnorm, prev_loss = self._canary_check(
                             vfy, canary, prev_state, args, step_fn,
@@ -2014,9 +2272,11 @@ class FFModel:
                                 f"{float(_fetch_global(self.state.guard.loss_scale)):g}"
                             )
                     if manager is not None and global_step % every == 0:
+                        _ck0 = time.perf_counter()
                         self._save_resilient_ckpt(
                             manager, global_step, epoch, bi + 1
                         )
+                        last_ckpt_dur_s = time.perf_counter() - _ck0
                 if device_partials:
                     folded = jax.tree_util.tree_map(
                         lambda *vs: sum(
@@ -2046,9 +2306,12 @@ class FFModel:
                         loss=last_loss, skipped_steps=int(skipped),
                     )
         except rz.TrainingPreempted as e:
-            if manager is not None and e.graceful:
+            if manager is not None and e.graceful \
+                    and e.checkpoint_path is None:
                 # SIGTERM grace period: flush a final checkpoint so the
                 # resumed run continues exactly where this one stopped
+                # (the drain protocol already wrote SliceDrained's —
+                # don't save twice)
                 e.checkpoint_path = self._save_resilient_ckpt(
                     manager, global_step, epoch, bi
                 )

@@ -628,6 +628,30 @@ class PCGExecutor:
             return False
         return all(d in live for d in self.mesh.devices.flat)
 
+    def note_step_duration(self, dur_s: float) -> None:
+        """Feed the step-time EMA behind `drain_window_s`. fit() calls
+        this only for SYNCED steps (health monitor / drain mode), where
+        the wall time measured a whole step rather than an async
+        dispatch."""
+        if dur_s <= 0:
+            return
+        ema = getattr(self, "_step_dur_ema", None)
+        self._step_dur_ema = (dur_s if ema is None
+                              else 0.5 * ema + 0.5 * dur_s)
+
+    def drain_window_s(self, checkpoint_s: Optional[float] = None,
+                       safety: float = 2.0) -> float:
+        """How much of a preemption deadline must remain for fit() to
+        risk ONE more step: the expected step time plus the expected
+        checkpoint flush, with a safety factor (steps and flushes
+        jitter; blowing the deadline means a hard kill mid-write, which
+        costs a whole checkpoint interval of replay). The drain protocol
+        keeps training while deadline_remaining() > this window, then
+        flushes and leaves."""
+        step = getattr(self, "_step_dur_ema", None) or 0.0
+        ckpt = checkpoint_s or 0.0
+        return safety * (step + ckpt) + 0.25
+
     def invalidate_step_cache(self, train_only: bool = False) -> None:
         """Drop cached jitted steps so the next build re-traces.
 

@@ -131,7 +131,10 @@ def save_checkpoint(model, path: str, *, step: Optional[int] = None,
     if getattr(model, "executor", None) is not None:
         from .elastic import topology_fingerprint
 
-        meta["topology"] = topology_fingerprint(model.executor.mesh)
+        meta["topology"] = topology_fingerprint(
+            model.executor.mesh,
+            fault_domains=getattr(model, "fault_domains", None),
+        )
     if extra_meta:
         meta.update(extra_meta)
     host_state = _to_host(state)

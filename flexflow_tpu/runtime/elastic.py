@@ -56,11 +56,19 @@ class ElasticRestoreError(RuntimeError):
 # ----------------------------------------------------------------------
 # topology fingerprinting
 # ----------------------------------------------------------------------
-def topology_fingerprint(mesh=None) -> dict:
+def topology_fingerprint(mesh=None, fault_domains=None) -> dict:
     """A JSON-serializable description of the device topology a model is
     compiled against (the checkpoint sidecar's ``topology`` entry). With
     a mesh, describes THAT mesh (what the executable actually spans);
-    without, the process-visible device set."""
+    without, the process-visible device set.
+
+    Beyond the aggregate counts, the fingerprint records *structure*:
+    ``per_process_devices`` (device ids grouped by owning process) and —
+    when a FaultDomainMap is given — ``slices`` (device ids per fault
+    domain), so `topology_matches`/`topology_diff` can tell "same device
+    count, different failure-domain shape" apart (2 slices x 8 devices
+    is NOT 1 x 16: a strategy searched for one shape may shard state
+    across a boundary the other doesn't have)."""
     import jax
 
     if mesh is not None:
@@ -77,7 +85,12 @@ def topology_fingerprint(mesh=None) -> dict:
             "recording num_processes=1", e,
         )
         nproc = 1
-    return {
+    per_process: Dict[str, List[int]] = {}
+    for d in devs:
+        per_process.setdefault(
+            str(getattr(d, "process_index", 0)), []
+        ).append(int(getattr(d, "id", 0)))
+    fp = {
         "num_devices": len(devs),
         "num_processes": nproc,
         "platform": devs[0].platform if devs else "unknown",
@@ -85,36 +98,120 @@ def topology_fingerprint(mesh=None) -> dict:
             str(getattr(d, "device_kind", "unknown")) for d in devs
         }),
         "mesh_axes": axes,
+        "per_process_devices": {k: sorted(v)
+                                for k, v in sorted(per_process.items())},
     }
+    if fault_domains is not None:
+        fp["slices"] = [list(s) for s in fault_domains.slices]
+    return fp
 
 
 def topology_matches(saved: Optional[dict], live: Optional[dict]) -> bool:
     """Whether a checkpoint's recorded topology still describes the live
     machine (device count / process count / platform — mesh axis layout
-    may legally differ between equally-sized searches)."""
+    may legally differ between equally-sized searches). When BOTH sides
+    recorded fault-domain structure, the slice shape must match too:
+    2x8 and 1x16 have the same device count but different failure
+    domains, and the searched strategy depends on which one it is. Old
+    sidecars without structure compare on counts alone."""
     if not saved or not live:
         return True  # old sidecars carry no fingerprint: assume unchanged
-    return all(
+    if not all(
         saved.get(k) == live.get(k)
         for k in ("num_devices", "num_processes", "platform")
-    )
+    ):
+        return False
+    if saved.get("slices") is not None and live.get("slices") is not None:
+        shape = lambda fp: sorted(len(s) for s in fp["slices"])  # noqa: E731
+        if shape(saved) != shape(live):
+            return False
+    return True
 
 
-def validate_machine_views(views: Dict, num_devices: int) -> List[str]:
-    """Check every searched MachineView addresses only live devices.
-    Returns a list of violation strings (empty = valid)."""
+def topology_diff(saved: Optional[dict], live: Optional[dict]) -> List[str]:
+    """Human-readable differences between two topology fingerprints —
+    what elastic restore logs so the operator knows WHICH fault domain
+    disappeared, not just that a count changed."""
+    if not saved or not live:
+        return []
+    out: List[str] = []
+    for key, noun in (("num_devices", "device"), ("num_processes", "process")):
+        a, b = saved.get(key), live.get(key)
+        if a is not None and b is not None and a != b:
+            out.append(f"{noun} count {a} -> {b}")
+    if saved.get("platform") != live.get("platform") and saved.get("platform"):
+        out.append(
+            f"platform {saved.get('platform')} -> {live.get('platform')}"
+        )
+    s_slices = saved.get("slices")
+    l_slices = live.get("slices")
+    if s_slices is not None and l_slices is not None:
+        live_devs = {d for s in l_slices for d in s}
+        for i, devs in enumerate(s_slices):
+            gone = sorted(set(devs) - live_devs)
+            if not gone:
+                continue
+            if len(gone) == len(devs):
+                out.append(
+                    f"slice {i} ({len(devs)} device(s) "
+                    f"{devs[0]}-{devs[-1]}) disappeared"
+                )
+            else:
+                out.append(
+                    f"slice {i} lost device(s) {gone} of {len(devs)}"
+                )
+        if sorted(len(s) for s in s_slices) != sorted(
+            len(s) for s in l_slices
+        ) and saved.get("num_devices") == live.get("num_devices"):
+            out.append(
+                "failure-domain shape changed: "
+                f"{'x'.join(str(len(s)) for s in s_slices) or '0'} -> "
+                f"{'x'.join(str(len(s)) for s in l_slices) or '0'} "
+                "(same device count)"
+            )
+    return out
+
+
+def validate_machine_views(views: Dict, num_devices: int,
+                           fault_domains=None) -> List[str]:
+    """Check every searched MachineView addresses only live devices —
+    every device each view enumerates, not just its bounding ids (a
+    strided view can step OVER a dead device while its first/last ids
+    look fine). Given a FaultDomainMap, violations name the slice a
+    stale view still points into. Returns violation strings (empty =
+    valid)."""
     bad = []
     for guid, view in (views or {}).items():
         if view is None:
             continue
-        last = view.start_device_id + sum(
-            (d - 1) * s for d, s in zip(view.dim, view.stride)
-        )
-        if view.start_device_id < 0 or last >= num_devices:
-            bad.append(
-                f"op {guid}: view {view!r} addresses device {last} of "
-                f"{num_devices}"
+        try:
+            ids = sorted(view.device_ids())
+        except Exception:  # malformed view: fall back to bound arithmetic
+            last = view.start_device_id + sum(
+                (d - 1) * s for d, s in zip(view.dim, view.stride)
             )
+            ids = [view.start_device_id, last]
+        dead = [d for d in ids if d < 0 or d >= num_devices]
+        if not dead:
+            continue
+        msg = (
+            f"op {guid}: view {view!r} addresses device"
+            f"{'s' if len(dead) > 1 else ''} "
+            f"{dead if len(dead) > 1 else dead[0]} of {num_devices}"
+        )
+        if fault_domains is not None:
+            lost = sorted({
+                s for s in (fault_domains.slice_of(d) for d in dead)
+                if s is not None
+            })
+            if lost:
+                msg += (
+                    f" (in lost slice{'s' if len(lost) > 1 else ''} "
+                    f"{lost if len(lost) > 1 else lost[0]})"
+                )
+            else:
+                msg += " (outside every known fault domain)"
+        bad.append(msg)
     return bad
 
 
@@ -187,15 +284,20 @@ def restore_elastic(model_fn: Callable[[], "FFModel"], ckpt_dir: str,
             f"no restorable checkpoint under {ckpt_dir!r}"
         )
     saved_topo = (info.meta or {}).get("topology")
-    live_topo = topology_fingerprint(model.executor.mesh)
+    live_topo = topology_fingerprint(
+        model.executor.mesh,
+        fault_domains=getattr(model, "fault_domains", None),
+    )
     if not topology_matches(saved_topo, live_topo) and verbose:
+        diff = topology_diff(saved_topo, live_topo)
         logger.warning(
             "[elastic] topology changed: checkpoint step %d was written on "
             "%s device(s), resuming on %s — strategy re-searched and "
-            "parameters resharded",
+            "parameters resharded%s",
             info.step,
             (saved_topo or {}).get("num_devices", "?"),
             live_topo["num_devices"],
+            ("; " + "; ".join(diff)) if diff else "",
         )
     report = getattr(model, "_restore_report", None)
     if report and report["unmatched_model"] and verbose:
@@ -310,8 +412,15 @@ class HealthMonitor:
                  heartbeat_interval_s: float = 5.0,
                  on_hang: Optional[Callable[[dict], None]] = None,
                  exit_on_hang: bool = False,
-                 compile_grace_s: Optional[float] = None):
+                 compile_grace_s: Optional[float] = None,
+                 fault_domains=None):
         self.timeout_s = timeout_s
+        # slice-granular failure classification: with a FaultDomainMap
+        # (runtime/fault_domains.py), stale heartbeat peers aggregate per
+        # slice — every host of a slice stale escalates "slice_loss"
+        # (shrink onto the survivors) instead of a flat "straggler", and
+        # per-slice health is exported as ff_slice_healthy{slice} gauges
+        self.fault_domains = fault_domains
         # until the FIRST step completes, the step is probably inside
         # XLA compilation — which takes minutes at production scale, not
         # timeout_s — so the hung-step check gets extra slack; a timeout
@@ -458,7 +567,22 @@ class HealthMonitor:
                 self._escalate("heartbeat_error", {"error": repr(e)})
                 return
             if bad:
-                self._escalate("straggler", {"peers": list(bad)})
+                detail: dict = {"peers": list(bad)}
+                kind = "straggler"
+                if self.fault_domains is not None:
+                    cls = self.fault_domains.classify_stale(list(bad))
+                    detail["classification"] = cls.describe()
+                    detail["lost_slices"] = list(cls.lost_slices)
+                    detail["degraded_slices"] = list(cls.degraded_slices)
+                    detail["surviving_devices"] = cls.surviving_devices
+                    if cls.kind == "slice_loss":
+                        kind = "slice_loss"
+                    for s in cls.lost_slices:
+                        obs.gauge_set("ff_slice_healthy", 0.0,
+                                      help="1 while a fault domain's hosts "
+                                           "all heartbeat, 0 once lost",
+                                      slice=s)
+                self._escalate(kind, detail)
                 return
             with self._lock:
                 self._last_beat_ok = time.monotonic()
@@ -466,6 +590,12 @@ class HealthMonitor:
             # duration is a cheap interconnect-health signal
             obs.count("ff_heartbeats_total",
                       help="successful health-monitor heartbeats")
+            if self.fault_domains is not None:
+                for s in range(self.fault_domains.num_slices):
+                    obs.gauge_set("ff_slice_healthy", 1.0,
+                                  help="1 while a fault domain's hosts "
+                                       "all heartbeat, 0 once lost",
+                                  slice=s)
             obs.gauge_set("ff_heartbeat_seconds",
                           time.monotonic() - t0,
                           help="duration of the last heartbeat probe")

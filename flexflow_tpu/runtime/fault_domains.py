@@ -1,0 +1,206 @@
+"""Slice-granular fault domains.
+
+On multi-slice TPU machines the slice — not the host — is the unit that
+fails: a preemption notice or a DCN partition takes out ALL chips of one
+slice at once, while the other slices keep running. The machine model
+(search/machine_model.py, search/network.py) already prices that
+hierarchy for the *search*; this module gives the *runtime* the same
+shape so failures can be classified by the domain they hit:
+
+  * **host loss within a slice** — some but not all of a slice's hosts
+    went stale. The slice is degraded but its peers are fine; the right
+    move is to restart the lost host in place (orchestrator concern) or
+    shrink within the slice.
+  * **whole-slice loss** — every host of a slice is stale (or a
+    preemption notice named the slice). Model state sharded across
+    slices would now be unrecoverable from the survivors; pure
+    data-parallel replicas just drop. fit()'s failover shrinks onto the
+    surviving slices and re-searches (runtime/elastic.py).
+
+`FaultDomainMap` is the shared vocabulary: slice index -> device ids
+(plus an optional host -> slice mapping for heartbeat transports that
+see hosts, not devices). It is derived from the searched machine model
+(`from_machine`), a machine-config file (`from_config`), or given
+explicitly (`from_devices`); consumers are `HealthMonitor` /
+`FileHeartbeat` staleness classification, `topology_fingerprint` /
+`validate_machine_views` (runtime/elastic.py), the checkpoint sidecar,
+and the survivability lint (search/survivability.py, FFA6xx).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class FailureClassification:
+    """What a set of stale hosts means in fault-domain terms.
+
+    kind is one of:
+      * ``"ok"``         — nothing stale.
+      * ``"host_loss"``  — stale hosts, but every affected slice still
+                           has at least one live host (restart in place).
+      * ``"slice_loss"`` — at least one slice lost ALL of its hosts
+                           (shrink onto the survivors).
+    """
+
+    kind: str
+    stale_hosts: Tuple[str, ...] = ()
+    lost_slices: Tuple[int, ...] = ()
+    degraded_slices: Tuple[int, ...] = ()
+    surviving_devices: int = 0
+
+    def describe(self) -> str:
+        if self.kind == "ok":
+            return "all fault domains healthy"
+        if self.kind == "slice_loss":
+            return (
+                f"whole-slice loss: slice(s) {list(self.lost_slices)} lost all "
+                f"hosts ({list(self.stale_hosts)}); {self.surviving_devices} "
+                "device(s) survive"
+            )
+        return (
+            f"host loss within slice(s) {list(self.degraded_slices)}: "
+            f"stale host(s) {list(self.stale_hosts)}; slice peers still alive"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultDomainMap:
+    """Slice index -> device ids (and optionally host id -> slice index).
+
+    Device ids are the flat global ids the machine model and MachineViews
+    use (0..num_devices-1). Slices are disjoint; together they cover the
+    machine. Immutable — derive a new map with `with_hosts` to attach a
+    host mapping."""
+
+    slices: Tuple[Tuple[int, ...], ...]
+    hosts: Optional[Mapping[str, int]] = None  # host id -> slice index
+
+    # -- constructors ----------------------------------------------------
+    @classmethod
+    def from_machine(cls, machine) -> "FaultDomainMap":
+        """Derive from a MachineModel: each node (slice, in multi-slice
+        configs) is one fault domain."""
+        per = machine.workers_per_node
+        slices = tuple(
+            tuple(range(n * per, (n + 1) * per))
+            for n in range(machine.num_nodes)
+        )
+        return cls(slices=slices)
+
+    @classmethod
+    def from_config(cls, path: str) -> "FaultDomainMap":
+        """Derive from a machine-config file (e.g.
+        ``machine_config_multislice``) via parse_machine_config."""
+        from ..search.machine_model import parse_machine_config
+
+        return cls.from_machine(parse_machine_config(path))
+
+    @classmethod
+    def from_devices(cls, num_devices: int,
+                     devices_per_slice: int) -> "FaultDomainMap":
+        """Partition ``num_devices`` flat ids into equal contiguous
+        slices of ``devices_per_slice``."""
+        if devices_per_slice <= 0 or num_devices % devices_per_slice:
+            raise ValueError(
+                f"{num_devices} devices do not divide into slices of "
+                f"{devices_per_slice}"
+            )
+        return cls(slices=tuple(
+            tuple(range(s, s + devices_per_slice))
+            for s in range(0, num_devices, devices_per_slice)
+        ))
+
+    def with_hosts(self, hosts: Mapping[str, int]) -> "FaultDomainMap":
+        """Attach a host-id -> slice-index mapping (for heartbeat
+        transports like FileHeartbeat that identify hosts, not devices)."""
+        return dataclasses.replace(self, hosts=dict(hosts))
+
+    # -- queries ---------------------------------------------------------
+    @property
+    def num_slices(self) -> int:
+        return len(self.slices)
+
+    @property
+    def num_devices(self) -> int:
+        return sum(len(s) for s in self.slices)
+
+    def devices_in_slice(self, slice_idx: int) -> Tuple[int, ...]:
+        return self.slices[slice_idx]
+
+    def slice_of(self, device_id: int) -> Optional[int]:
+        """Slice index holding ``device_id`` (None when outside the map —
+        e.g. a stale view addressing a device that no longer exists)."""
+        for i, devs in enumerate(self.slices):
+            if device_id in devs:
+                return i
+        return None
+
+    def slice_of_host(self, host_id: str) -> Optional[int]:
+        if self.hosts is None:
+            return None
+        return self.hosts.get(host_id)
+
+    def surviving_devices(self, lost_slices: Iterable[int]) -> Tuple[int, ...]:
+        lost = set(lost_slices)
+        out: List[int] = []
+        for i, devs in enumerate(self.slices):
+            if i not in lost:
+                out.extend(devs)
+        return tuple(out)
+
+    # -- failure classification ------------------------------------------
+    def classify_stale(
+        self, stale_hosts: Sequence[str]
+    ) -> FailureClassification:
+        """Aggregate per-host staleness (HealthMonitor heartbeat output)
+        into fault-domain terms. Hosts map to slices via `hosts`; a host
+        the map doesn't know counts as a degraded unknown domain
+        (conservative: host_loss, never silently ignored)."""
+        if not stale_hosts:
+            return FailureClassification(
+                kind="ok", surviving_devices=self.num_devices)
+        stale_by_slice: Dict[int, set] = {}
+        unknown: List[str] = []
+        for h in stale_hosts:
+            s = self.slice_of_host(h)
+            if s is None:
+                unknown.append(h)
+            else:
+                stale_by_slice.setdefault(s, set()).add(h)
+        hosts_by_slice: Dict[int, set] = {}
+        for h, s in (self.hosts or {}).items():
+            hosts_by_slice.setdefault(s, set()).add(h)
+        lost = tuple(sorted(
+            s for s, stale in stale_by_slice.items()
+            if hosts_by_slice.get(s) and stale >= hosts_by_slice[s]
+        ))
+        degraded = tuple(sorted(
+            s for s in stale_by_slice if s not in lost
+        ))
+        kind = "slice_loss" if lost else "host_loss"
+        return FailureClassification(
+            kind=kind,
+            stale_hosts=tuple(stale_hosts),
+            lost_slices=lost,
+            degraded_slices=degraded,
+            surviving_devices=len(self.surviving_devices(lost)),
+        )
+
+    # -- (de)serialization (checkpoint sidecar) --------------------------
+    def to_json(self) -> dict:
+        out: dict = {"slices": [list(s) for s in self.slices]}
+        if self.hosts is not None:
+            out["hosts"] = dict(self.hosts)
+        return out
+
+    @classmethod
+    def from_json(cls, data: Optional[dict]) -> Optional["FaultDomainMap"]:
+        if not data or "slices" not in data:
+            return None
+        return cls(
+            slices=tuple(tuple(int(d) for d in s) for s in data["slices"]),
+            hosts={str(k): int(v) for k, v in data["hosts"].items()}
+            if data.get("hosts") else None,
+        )

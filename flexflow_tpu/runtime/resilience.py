@@ -77,6 +77,54 @@ class HostLossError(TrainingPreempted):
                  surviving_devices: Optional[int] = None):
         super().__init__(msg, step=step, graceful=graceful)
         self.surviving_devices = surviving_devices
+        # True when the loss came from a FaultInjector plan (CPU
+        # simulation): fit()'s in-process failover may then shrink the
+        # visible device set itself (elastic.shrunk_devices) instead of
+        # deferring to the orchestrator.
+        self.simulated = False
+
+
+class SliceLossError(HostLossError):
+    """An entire slice (fault domain) dropped out between steps — every
+    host of the slice went stale, or the ``slice_loss`` fault-injection
+    site fired. Unlike a single host loss, NOTHING of the slice
+    survives: strategies that shard model/optimizer state across slices
+    cannot recover by shrinking and need a full restore-from-checkpoint;
+    pure data-parallel-across-slices strategies just drop the replicas
+    (search/survivability.py classifies which case a strategy is in).
+
+    fit(elastic=True) catches this, shrinks onto the surviving slices,
+    re-searches and resumes from the last checkpoint (simulated losses
+    in-process; real ones via the orchestrator + restore_elastic)."""
+
+    def __init__(self, msg: str = "slice lost", *, step: int = 0,
+                 graceful: bool = True, lost_slice: Optional[int] = None,
+                 surviving_devices: Optional[int] = None):
+        super().__init__(msg, step=step, graceful=graceful,
+                         surviving_devices=surviving_devices)
+        self.lost_slice = lost_slice
+
+
+class SliceDrained(TrainingPreempted):
+    """A deadline-bearing preemption notice was drained to completion:
+    fit() kept stepping while the remaining grace exceeded the drain
+    window (one step + a checkpoint flush), then wrote a final
+    checkpoint and stopped. Carries everything failover needs to resume
+    on the surviving slices without the leaving one."""
+
+    def __init__(self, msg: str = "slice drained", *, step: int = 0,
+                 deadline_s: Optional[float] = None,
+                 met_deadline: bool = True,
+                 drained_steps: int = 0,
+                 leaving_slice: Optional[int] = None,
+                 surviving_devices: Optional[int] = None):
+        super().__init__(msg, step=step, graceful=True)
+        self.deadline_s = deadline_s
+        self.met_deadline = met_deadline
+        self.drained_steps = drained_steps
+        self.leaving_slice = leaving_slice
+        self.surviving_devices = surviving_devices
+        self.simulated = False
 
 
 class CollectiveTimeout(ResilienceError, TimeoutError):
@@ -180,23 +228,63 @@ class StepGuardConfig:
 class PreemptionSignal:
     """A between-steps stop flag. Real deployments arm it from SIGTERM
     (install_sigterm_handler — what a preemptible TPU pod sends with a
-    grace period); the fault-injection harness arms it directly."""
+    grace period); the fault-injection harness arms it directly.
+
+    Two shapes of trigger:
+
+    * **bare** (`trigger()`) — legacy stop-now: fit() flushes a final
+      checkpoint (graceful) and raises TrainingPreempted.
+    * **deadline-bearing** (`trigger(deadline_s=...)`) — a drain notice:
+      the pod manager granted `deadline_s` seconds of grace, optionally
+      naming the `leaving_slice` and the `surviving_devices` count that
+      remain after it goes. fit() keeps training while the remaining
+      grace comfortably exceeds one step + a checkpoint flush, then
+      checkpoints and raises SliceDrained so failover can shrink onto
+      the survivors (the *drain protocol*; see docs/resilience.md)."""
 
     def __init__(self):
         self._event = threading.Event()
         self.graceful = True
         self._prev_handler = None
+        self.deadline_at: Optional[float] = None  # time.monotonic()
+        self.deadline_s: Optional[float] = None
+        self.leaving_slice: Optional[int] = None
+        self.surviving_devices: Optional[int] = None
 
-    def trigger(self, graceful: bool = True) -> None:
+    def trigger(self, graceful: bool = True, *,
+                deadline_s: Optional[float] = None,
+                leaving_slice: Optional[int] = None,
+                surviving_devices: Optional[int] = None) -> None:
         self.graceful = graceful
+        if deadline_s is not None:
+            self.deadline_s = float(deadline_s)
+            self.deadline_at = time.monotonic() + float(deadline_s)
+        self.leaving_slice = leaving_slice
+        self.surviving_devices = surviving_devices
         self._event.set()
 
     def triggered(self) -> bool:
         return self._event.is_set()
 
+    @property
+    def draining(self) -> bool:
+        """Armed WITH a deadline — fit() drains instead of stopping."""
+        return self._event.is_set() and self.deadline_at is not None
+
+    def deadline_remaining(self) -> Optional[float]:
+        """Seconds of grace left (negative = deadline blown); None when
+        the signal carries no deadline."""
+        if self.deadline_at is None:
+            return None
+        return self.deadline_at - time.monotonic()
+
     def clear(self) -> None:
         self._event.clear()
         self.graceful = True
+        self.deadline_at = None
+        self.deadline_s = None
+        self.leaving_slice = None
+        self.surviving_devices = None
 
     def install_sigterm_handler(self) -> bool:
         """Arm on SIGTERM (graceful: the grace period is for the final
@@ -242,6 +330,22 @@ class FaultInjector:
                                the elastic-restart test to rebuild on);
                                pair with elastic.shrunk_devices(N) to
                                shrink what jax.devices() reports.
+      * ``slice_loss``       — fit() raises SliceLossError between steps:
+                               an entire fault domain (slice) vanished at
+                               once. Extras: ``slice=K`` names the lost
+                               slice, ``surviving_devices=N`` the count
+                               left; with ``elastic=True`` fit() shrinks
+                               onto the survivors in-process
+                               (elastic.shrunk_devices) and resumes from
+                               the last checkpoint.
+      * ``preemption_notice`` — arms the preemption signal WITH a drain
+                               deadline (``deadline_s=`` grace seconds;
+                               ``slice=``/``surviving_devices=`` ride
+                               along): fit() finishes the in-flight
+                               step(s), checkpoints before the deadline
+                               and raises SliceDrained; with
+                               ``elastic=True`` it then shrinks and
+                               resumes on the survivors.
       * ``replica_death``    — raised inside a ContinuousBatcher serve
                                loop (runtime/serving.py): the replica
                                dies, the ReplicaSet requeues its

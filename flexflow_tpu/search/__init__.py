@@ -10,6 +10,12 @@ from .machine_model import (  # noqa: F401
     parse_machine_config,
 )
 from .mcmc import MCMCSearch, simulate_runtime  # noqa: F401
+from .survivability import (  # noqa: F401
+    OpSurvivability,
+    StrategySurvivability,
+    strategy_survivability,
+    survivability_cost_factor,
+)
 from .substitution import (  # noqa: F401
     GraphSearchHelper,
     Substitution,

@@ -268,9 +268,17 @@ class CostModel:
 
     def __init__(self, machine: MachineModel, *, bf16: bool = True,
                  calibration=None, overlap_backward_update: bool = False,
-                 overlap_efficiency: Optional[float] = None):
+                 overlap_efficiency: Optional[float] = None,
+                 survivability_penalty: float = 0.0):
         self.machine = machine
         self.bf16 = bf16
+        # slice-loss survivability bias (search/survivability.py, config
+        # knob search_survivability_penalty): >0 on hierarchical
+        # machines makes DP/MCMC multiply a candidate's cost by
+        # 1 + penalty * (cross-slice-sharded weight fraction), steering
+        # the search toward strategies where only data-parallel replicas
+        # cross the slice boundary. 0 disables the bias entirely.
+        self.survivability_penalty = float(survivability_penalty)
         # "overlappable" discount (config.search_overlap_backward_update):
         # a weight-gradient sync collective is statically independent of
         # the backward critical path — the gradient it reduces feeds ONLY

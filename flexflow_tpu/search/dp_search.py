@@ -210,7 +210,22 @@ class SearchHelper:
     # -- DP ---------------------------------------------------------------
     def graph_cost(self, graph: Graph, res: MachineResource) -> GraphCostResult:
         ops = graph.topo_order()
-        return self._cost_of(tuple(ops), {}, {}, res, graph)
+        result = self._cost_of(tuple(ops), {}, {}, res, graph)
+        pen = getattr(self.cost_model, "survivability_penalty", 0.0)
+        if pen and result.cost != float("inf"):
+            # slice-loss survivability bias (search/survivability.py):
+            # applied on the COMPLETE assignment, outside the memoized
+            # DP — whether a shard set crosses a slice boundary is a
+            # whole-strategy property, not a subproblem one. Every
+            # graph_cost consumer (best-first substitution search,
+            # memory search, elastic research_views) inherits the bias.
+            from .survivability import survivability_cost_factor
+
+            f = survivability_cost_factor(graph, result.views,
+                                          self.cost_model)
+            if f != 1.0:
+                result = GraphCostResult(result.cost * f, result.views)
+        return result
 
     def _guids(self, ops) -> Tuple:
         ent = self._guid_tuples.get(id(ops))

@@ -192,7 +192,24 @@ class MCMCSearch:
         use_native: bool = True,
     ) -> Tuple[Dict[int, MachineView], float]:
         machine = self.cost_model.machine
-        if use_native:
+        # slice-loss survivability bias (search/survivability.py): on
+        # hierarchical machines with the penalty armed, every proposal's
+        # simulated runtime is scaled by the cross-slice-sharded weight
+        # fraction — which also forces the Python annealer (the native
+        # one costs proposals in C++ and cannot see the bias)
+        pen = getattr(self.cost_model, "survivability_penalty", 0.0)
+        biased = bool(pen) and machine.num_nodes > 1
+        if biased:
+            from .survivability import survivability_cost_factor
+
+            def cost_of(vs):
+                return simulate_runtime(
+                    graph, vs, self.cost_model
+                ) * survivability_cost_factor(graph, vs, self.cost_model)
+        else:
+            def cost_of(vs):
+                return simulate_runtime(graph, vs, self.cost_model)
+        if use_native and not biased:
             result = self._optimize_native(graph, budget, start)
             if result is not None:
                 if self.trajectory is not None:
@@ -202,7 +219,7 @@ class MCMCSearch:
                                           budget=budget)
                 return result
         views = dict(start) if start else self.data_parallel_start(graph)
-        cur = simulate_runtime(graph, views, self.cost_model)
+        cur = cost_of(views)
         best_views, best = dict(views), cur
         traj = self.trajectory
         if traj is not None:
@@ -216,7 +233,7 @@ class MCMCSearch:
             nxt = dict(views)
             proposed = self.rng.choice(cands)
             nxt[op.guid] = proposed
-            c = simulate_runtime(graph, nxt, self.cost_model)
+            c = cost_of(nxt)
             delta = c - cur
             accept = (delta < 0
                       or self.rng.random() < math.exp(-self.alpha * delta * 1e6))

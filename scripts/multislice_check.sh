@@ -1,0 +1,150 @@
+#!/usr/bin/env bash
+# Slice fault-domain rehearsal (ISSUE 12 satellite): the whole
+# slice-granular resilience matrix — classification, drain protocol,
+# FFA6xx survivability lint, whole-slice-loss failover — hardware-free.
+#
+# Leg 1 runs ALL of tests/test_fault_domains.py on the tier-1-shaped
+# 8-device mesh (2 slices x 4). Legs 2 and 3 then scale the chaos
+# stories up to a 16-device 2x8 mesh whose machine description is
+# DERIVED from machine_config_multislice (same chip and DCN/ICI
+# constants, 8 chips per slice so the file describes the live CPU
+# mesh): leg 2 kills slice 1 mid-run and requires the same fit() call
+# to finish on the 8 survivors; leg 3 delivers a deadline-bearing
+# preemption notice and requires a drain (extra steps + final
+# checkpoint) before the failover. Use before touching
+# runtime/fault_domains.py, the drain path in fit(), or
+# search/survivability.py:
+#
+#   scripts/multislice_check.sh              # all three legs
+#   scripts/multislice_check.sh -k drain     # filter leg 1's pytest
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== multislice leg 1: fault-domain suite (8-device 2x4 mesh) ==="
+env JAX_PLATFORMS=cpu \
+    JAX_NUM_CPU_DEVICES=8 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_fault_domains.py -v -p no:cacheprovider "$@"
+
+run16() {
+    env JAX_PLATFORMS=cpu \
+        JAX_NUM_CPU_DEVICES=16 \
+        XLA_FLAGS="--xla_force_host_platform_device_count=16" \
+        python - "$@"
+}
+
+export MULTISLICE_TMP="$(mktemp -d)"
+trap 'rm -rf "$MULTISLICE_TMP"' EXIT
+
+# 2x8 machine file with machine_config_multislice's hardware constants
+run16 <<'PY'
+import os
+from flexflow_tpu.search import parse_machine_config
+
+base = parse_machine_config("machine_config_multislice")
+assert base.num_nodes == 2
+with open(os.path.join(os.environ["MULTISLICE_TMP"], "m2x8.cfg"), "w") as f:
+    f.write(f"""# 2x8 derivation of machine_config_multislice (live CPU mesh)
+machine_model_version = 1
+num_nodes = 2
+workers_per_node = 8
+peak_flops_bf16 = {base.chip.peak_flops_bf16}
+hbm_bandwidth = {base.chip.hbm_bandwidth}
+hbm_capacity = {base.chip.hbm_capacity}
+ici_bandwidth = {base.ici_bandwidth}
+dcn_bandwidth = {base.dcn_bandwidth}
+""")
+print("wrote", f.name)
+PY
+
+echo "=== multislice leg 2: whole-slice loss -> failover (16-device 2x8) ==="
+run16 <<'PY'
+import os
+
+import jax
+import numpy as np
+
+from flexflow_tpu import (
+    ActiMode, DataType, FFConfig, FFModel, FaultInjector, LossType,
+    SGDOptimizer,
+)
+from flexflow_tpu.search.survivability import strategy_survivability
+
+assert len(jax.devices()) == 16, jax.devices()
+tmp = os.environ["MULTISLICE_TMP"]
+cfg = FFConfig()
+cfg.batch_size = 32
+cfg.machine_model_file = os.path.join(tmp, "m2x8.cfg")
+m = FFModel(cfg)
+x = m.create_tensor((32, 4), DataType.DT_FLOAT)
+t = m.dense(x, 16, ActiMode.AC_MODE_RELU)
+t = m.dense(t, 3)
+t = m.softmax(t)
+m.compile(SGDOptimizer(lr=0.1), LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+assert m.fault_domains is not None and m.fault_domains.num_slices == 2, \
+    m.fault_domains
+assert m.fault_domains.devices_in_slice(1) == tuple(range(8, 16))
+cm = m._build_cost_model()
+assert cm.survivability_penalty > 0  # auto-armed on the 2-slice machine
+s = strategy_survivability(m.graph, getattr(m, "searched_views", None),
+                           machine=cm.machine)
+assert s.survivable, [o for o in s.ops if not o.survivable]
+
+rng = np.random.RandomState(0)
+xd = rng.randn(64, 4).astype(np.float32)
+yd = rng.randint(0, 3, (64, 1)).astype(np.int32)
+fi = FaultInjector().inject("slice_loss", at_step=1, slice=1)
+m.fit(xd, yd, epochs=3, verbose=False,
+      checkpoint_dir=os.path.join(tmp, "ckpt_loss"),
+      checkpoint_every_n_steps=1, fault_injector=fi, elastic=True)
+assert fi.fired.get("slice_loss") == 1
+assert int(m.executor.mesh.devices.size) == 8, m.executor.mesh
+assert {d.id for d in m.executor.mesh.devices.flat} == set(range(8))
+assert m.state.step == 6, m.state.step
+print("leg 2 OK: slice 1 lost at step 1, run finished on devices 0-7")
+PY
+
+echo "=== multislice leg 3: preemption drain -> failover (16-device 2x8) ==="
+run16 <<'PY'
+import os
+
+import numpy as np
+
+from flexflow_tpu import (
+    ActiMode, DataType, FFConfig, FFModel, FaultInjector, LossType,
+    SGDOptimizer,
+)
+
+tmp = os.environ["MULTISLICE_TMP"]
+cfg = FFConfig()
+cfg.batch_size = 32
+cfg.machine_model_file = os.path.join(tmp, "m2x8.cfg")
+m = FFModel(cfg)
+x = m.create_tensor((32, 4), DataType.DT_FLOAT)
+t = m.dense(x, 16, ActiMode.AC_MODE_RELU)
+t = m.dense(t, 3)
+t = m.softmax(t)
+m.compile(SGDOptimizer(lr=0.1), LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+
+rng = np.random.RandomState(0)
+xd = rng.randn(64, 4).astype(np.float32)
+yd = rng.randint(0, 3, (64, 1)).astype(np.int32)
+fi = FaultInjector().inject(
+    "preemption_notice", at_step=1, deadline_s=60.0,
+    max_drain_steps=2, slice=1, surviving_devices=8,
+)
+traj = m.search_trajectory  # failover recompile swaps in a fresh one
+m.fit(xd, yd, epochs=3, verbose=False,
+      checkpoint_dir=os.path.join(tmp, "ckpt_drain"),
+      checkpoint_every_n_steps=2, fault_injector=fi, elastic=True)
+assert fi.fired.get("preemption_notice") == 1
+drains = [e for e in traj.events if e.get("kind") == "slice_drain"]
+assert drains and drains[0]["drained_steps"] == 2, drains
+assert drains[0]["met_deadline"], drains
+assert int(m.executor.mesh.devices.size) == 8, m.executor.mesh
+assert m.state.step == 6, m.state.step
+print("leg 3 OK: drained 2 steps inside the 60s notice, "
+      "failed over to slice 0")
+PY
+
+echo "multislice_check: all legs passed"

@@ -1,0 +1,471 @@
+"""Slice-granular fault domain tests (runtime/fault_domains.py,
+search/survivability.py, the drain protocol and slice failover in
+fit()): FaultDomainMap classification, the structural topology
+fingerprint/validate satellites, preemption-drain deadlines, the FFA6xx
+survivability lint, and the 2-slice chaos stories (whole-slice loss and
+preemption drain, both resuming on the surviving slice in-process).
+
+Everything runs on the CPU mesh (8 virtual devices, conftest.py) with a
+2-slice x 4-device machine file; the 16-device multislice legs run
+standalone via scripts/multislice_check.sh."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexflow_tpu import (
+    ActiMode,
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.analysis.diagnostics import Severity
+from flexflow_tpu.analysis.perf import perf_diagnostics
+from flexflow_tpu.pcg.machine_view import MachineView
+from flexflow_tpu.runtime.elastic import (
+    FileHeartbeat,
+    HealthMonitor,
+    topology_diff,
+    topology_fingerprint,
+    topology_matches,
+    validate_machine_views,
+)
+from flexflow_tpu.runtime.fault_domains import FaultDomainMap
+from flexflow_tpu.runtime.resilience import (
+    FaultInjector,
+    PreemptionSignal,
+    SliceDrained,
+)
+from flexflow_tpu.search import MachineModel
+from flexflow_tpu.search.survivability import (
+    CROSS_SLICE_SHARDED,
+    strategy_survivability,
+    survivability_cost_factor,
+)
+
+NDEV = len(jax.devices())
+needs8 = pytest.mark.skipif(
+    NDEV != 8, reason="encodes the 8-device tier-1 mesh (2 slices x 4)"
+)
+
+
+def two_slice_machine(tmp_path, num_nodes=2, workers=4):
+    """A hierarchical 2-slice machine file matching the 8-device CPU
+    mesh: slice = fault domain = 4 devices."""
+    p = str(tmp_path / "two_slice.cfg")
+    with open(p, "w") as f:
+        f.write(f"num_nodes = {num_nodes}\n"
+                f"workers_per_node = {workers}\n"
+                "machine_model_version = 1\n"
+                "peak_flops_bf16 = 1e9\nhbm_bandwidth = 1e9\n"
+                "ici_bandwidth = 1e12\nici_latency = 1e-9\n"
+                "dcn_bandwidth = 2.5e10\n")
+    return p
+
+
+def small_model(machine_file=None, batch=32, search_budget=None):
+    cfg = FFConfig()
+    cfg.batch_size = batch
+    if machine_file is not None:
+        cfg.machine_model_file = machine_file
+    if search_budget is not None:
+        cfg.search_budget = search_budget
+    m = FFModel(cfg)
+    x = m.create_tensor((batch, 4), DataType.DT_FLOAT)
+    t = m.dense(x, 16, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, 3)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.1, momentum=0.9),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY])
+    return m
+
+
+def dataset(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = rng.randint(0, 3, (n, 1)).astype(np.int32)
+    return x, y
+
+
+# ----------------------------------------------------------------------
+# FaultDomainMap
+# ----------------------------------------------------------------------
+def test_fault_domain_map_from_machine():
+    fd = FaultDomainMap.from_machine(
+        MachineModel(num_nodes=2, workers_per_node=4)
+    )
+    assert fd.num_slices == 2 and fd.num_devices == 8
+    assert fd.devices_in_slice(1) == (4, 5, 6, 7)
+    assert fd.slice_of(3) == 0 and fd.slice_of(4) == 1
+    assert fd.slice_of(99) is None
+    assert fd.surviving_devices([1]) == (0, 1, 2, 3)
+    # sidecar round trip
+    again = FaultDomainMap.from_json(fd.to_json())
+    assert again == fd
+
+
+def test_fault_domain_map_from_devices_validates():
+    fd = FaultDomainMap.from_devices(16, 8)
+    assert fd.num_slices == 2
+    with pytest.raises(ValueError):
+        FaultDomainMap.from_devices(10, 4)
+
+
+def test_classify_stale_host_loss_vs_slice_loss():
+    fd = FaultDomainMap.from_devices(8, 4).with_hosts(
+        {"h0": 0, "h1": 0, "h2": 1, "h3": 1}
+    )
+    assert fd.classify_stale([]).kind == "ok"
+    partial = fd.classify_stale(["h2"])
+    assert partial.kind == "host_loss"
+    assert partial.degraded_slices == (1,) and not partial.lost_slices
+    whole = fd.classify_stale(["h2", "h3"])
+    assert whole.kind == "slice_loss"
+    assert whole.lost_slices == (1,)
+    assert whole.surviving_devices == 4
+    assert "slice" in whole.describe()
+    # an unknown host never silently disappears
+    unknown = fd.classify_stale(["mystery-host"])
+    assert unknown.kind == "host_loss"
+
+
+# ----------------------------------------------------------------------
+# satellite: structural topology fingerprint
+# ----------------------------------------------------------------------
+def test_fingerprint_distinguishes_failure_domain_shape():
+    """Same device count, different slice shape (2x8 vs 1x16) must NOT
+    match — the searched strategy depends on where the boundary is."""
+    fd_2x8 = FaultDomainMap.from_devices(16, 8)
+    fd_1x16 = FaultDomainMap.from_devices(16, 16)
+    base = {"num_devices": 16, "num_processes": 1, "platform": "cpu"}
+    a = dict(base, slices=[list(s) for s in fd_2x8.slices])
+    b = dict(base, slices=[list(s) for s in fd_1x16.slices])
+    assert topology_matches(a, dict(a))
+    assert not topology_matches(a, b)
+    # aggregate-only sidecars (old checkpoints) still match on counts
+    assert topology_matches(base, a)
+    diff = topology_diff(a, b)
+    assert any("failure-domain shape" in d for d in diff), diff
+
+
+def test_topology_diff_names_disappeared_slice():
+    saved = {
+        "num_devices": 8, "num_processes": 1, "platform": "cpu",
+        "slices": [[0, 1, 2, 3], [4, 5, 6, 7]],
+    }
+    live = {
+        "num_devices": 4, "num_processes": 1, "platform": "cpu",
+        "slices": [[0, 1, 2, 3]],
+    }
+    diff = topology_diff(saved, live)
+    assert any("slice 1" in d and "disappeared" in d for d in diff), diff
+
+
+def test_fingerprint_records_slices_and_processes():
+    fd = FaultDomainMap.from_devices(NDEV, max(1, NDEV // 2))
+    fp = topology_fingerprint(fault_domains=fd)
+    assert fp["slices"] == [list(s) for s in fd.slices]
+    assert sum(len(v) for v in fp["per_process_devices"].values()) \
+        == fp["num_devices"]
+
+
+# ----------------------------------------------------------------------
+# satellite: full-enumeration view validation
+# ----------------------------------------------------------------------
+def test_validate_machine_views_enumerates_strided_views():
+    # stride 2 from device 0: addresses {0, 2, 4, 6}; first/last-only
+    # arithmetic sees last=6 < 8 OK, but on a 5-device machine the view
+    # addresses dead device 6 — and a strided view over 4 devices
+    # (0,2,4,6) hides its dead interior ids from bound checks
+    views = {7: MachineView(start_device_id=0, dim=(4,), stride=(2,))}
+    assert validate_machine_views(views, 8) == []
+    bad = validate_machine_views(views, 5)
+    assert bad and "op 7" in bad[0] and "6" in bad[0]
+
+
+def test_validate_machine_views_names_lost_slice():
+    fd = FaultDomainMap.from_devices(8, 4)
+    views = {2: MachineView(start_device_id=4, dim=(4,), stride=(1,))}
+    bad = validate_machine_views(views, 4, fault_domains=fd)
+    assert bad and "op 2" in bad[0]
+    assert "slice 1" in bad[0], bad[0]
+
+
+# ----------------------------------------------------------------------
+# deadline-bearing preemption signal
+# ----------------------------------------------------------------------
+def test_preemption_signal_deadline_fields():
+    sig = PreemptionSignal()
+    assert not sig.draining
+    sig.trigger()  # legacy bare trigger: no deadline
+    assert sig.triggered() and not sig.draining
+    assert sig.deadline_remaining() is None
+    sig.clear()
+    sig.trigger(deadline_s=5.0, leaving_slice=1, surviving_devices=4)
+    assert sig.draining
+    rem = sig.deadline_remaining()
+    assert rem is not None and 4.0 < rem <= 5.0
+    assert sig.leaving_slice == 1 and sig.surviving_devices == 4
+    sig.clear()
+    assert not sig.draining and sig.deadline_remaining() is None
+    assert sig.leaving_slice is None
+
+
+# ----------------------------------------------------------------------
+# monitor: per-slice staleness classification
+# ----------------------------------------------------------------------
+def test_health_monitor_classifies_whole_slice_loss(tmp_path):
+    fd = FaultDomainMap.from_devices(8, 4).with_hosts(
+        {"host0": 0, "host1": 1}
+    )
+    hb = FileHeartbeat(str(tmp_path), "host0", stale_after_s=30.0,
+                       expected_peers=["host1"])  # host1 never beats
+    mon = HealthMonitor(timeout_s=5.0, heartbeat_interval_s=0.05,
+                        heartbeat_fn=hb, fault_domains=fd)
+    try:
+        mon.start()
+        deadline = time.monotonic() + 5.0
+        while not mon.hang_detected and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert mon.hang_detected
+        assert mon.hang_info["kind"] == "slice_loss"
+        assert mon.hang_info["lost_slices"] == [1]
+        assert mon.hang_info["surviving_devices"] == 4
+    finally:
+        mon.stop()
+
+
+def test_health_monitor_partial_slice_is_straggler(tmp_path):
+    fd = FaultDomainMap.from_devices(8, 4).with_hosts(
+        {"host0": 0, "host1": 1, "host2": 1}
+    )
+    hb = FileHeartbeat(str(tmp_path), "host0", stale_after_s=30.0,
+                       expected_peers=["host1", "host2"])
+    hb2 = FileHeartbeat(str(tmp_path), "host2")
+    hb2.beat()  # host2 alive: slice 1 degraded, not lost
+    mon = HealthMonitor(timeout_s=5.0, heartbeat_interval_s=0.05,
+                        heartbeat_fn=hb, fault_domains=fd)
+    try:
+        mon.start()
+        deadline = time.monotonic() + 5.0
+        while not mon.hang_detected and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert mon.hang_detected
+        assert mon.hang_info["kind"] == "straggler"
+        assert mon.hang_info["degraded_slices"] == [1]
+        assert not mon.hang_info["lost_slices"]
+    finally:
+        mon.stop()
+
+
+# ----------------------------------------------------------------------
+# drain protocol: deadline-bearing preemption in fit()
+# ----------------------------------------------------------------------
+@needs8
+def test_preemption_drain_meets_deadline(tmp_path):
+    """A notice with generous grace drains: training continues inside
+    the window, a final checkpoint lands, and SliceDrained reports the
+    deadline as met."""
+    x, y = dataset(64)
+    m = small_model(machine_file=two_slice_machine(tmp_path))
+    fi = FaultInjector().inject(
+        "preemption_notice", at_step=1, deadline_s=30.0,
+        max_drain_steps=2, slice=1, surviving_devices=4,
+    )
+    t0 = time.monotonic()
+    with pytest.raises(SliceDrained) as ei:
+        m.fit(x, y, epochs=4, verbose=False,
+              checkpoint_dir=str(tmp_path / "ckpt"), fault_injector=fi)
+    e = ei.value
+    assert e.met_deadline
+    assert e.drained_steps == 2  # kept training under the notice
+    assert e.leaving_slice == 1 and e.surviving_devices == 4
+    assert e.checkpoint_path is not None and os.path.isdir(e.checkpoint_path)
+    assert time.monotonic() - t0 < 30.0  # drained long before the deadline
+    # the drain is a trajectory event (slice_drain), and the sidecar
+    # carries the 2-slice fingerprint
+    kinds = [ev.get("kind") for ev in m.search_trajectory.events]
+    assert "slice_drain" in kinds
+    import json
+
+    with open(e.checkpoint_path + ".meta.json") as f:
+        meta = json.load(f)
+    assert meta["topology"]["slices"] == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+
+@needs8
+def test_preemption_drain_tight_deadline_flushes_immediately(tmp_path):
+    """Zero grace: no extra steps, checkpoint flushed at once."""
+    x, y = dataset(64)
+    m = small_model(machine_file=two_slice_machine(tmp_path))
+    fi = FaultInjector().inject("preemption_notice", at_step=1,
+                                deadline_s=0.0)
+    with pytest.raises(SliceDrained) as ei:
+        m.fit(x, y, epochs=4, verbose=False,
+              checkpoint_dir=str(tmp_path / "ckpt"), fault_injector=fi)
+    assert ei.value.drained_steps == 0
+    assert ei.value.checkpoint_path is not None
+
+
+def test_bare_preemption_still_raises_training_preempted(tmp_path):
+    """The legacy site keeps its contract: no deadline -> immediate
+    TrainingPreempted (not SliceDrained)."""
+    from flexflow_tpu.runtime.resilience import TrainingPreempted
+
+    x, y = dataset(64)
+    m = small_model()
+    fi = FaultInjector().inject("preempt", at_step=1)
+    with pytest.raises(TrainingPreempted) as ei:
+        m.fit(x, y, epochs=2, verbose=False,
+              checkpoint_dir=str(tmp_path), fault_injector=fi)
+    assert not isinstance(ei.value, SliceDrained)
+    assert ei.value.checkpoint_path is not None
+
+
+# ----------------------------------------------------------------------
+# chaos stories: whole-slice loss / drain -> in-process failover
+# ----------------------------------------------------------------------
+@needs8
+def test_slice_loss_failover_resumes_on_survivors(tmp_path):
+    """The tentpole story: 2-slice mesh, slice 1 dies mid-run via the
+    ``slice_loss`` site, fit(elastic=True) shrinks onto the surviving
+    slice within the same call and finishes training there."""
+    x, y = dataset(64)
+    m = small_model(machine_file=two_slice_machine(tmp_path))
+    assert m.fault_domains is not None and m.fault_domains.num_slices == 2
+    fi = FaultInjector().inject("slice_loss", at_step=1, slice=1)
+    traj = m.search_trajectory  # failover recompile swaps in a fresh one
+    m.fit(x, y, epochs=3, verbose=False,
+          checkpoint_dir=str(tmp_path / "ckpt"),
+          checkpoint_every_n_steps=1, fault_injector=fi, elastic=True)
+    assert fi.fired.get("slice_loss") == 1
+    # resumed + finished on the 4 surviving devices of slice 0
+    assert int(m.executor.mesh.devices.size) == 4
+    assert {d.id for d in m.executor.mesh.devices.flat} == {0, 1, 2, 3}
+    assert m.state.step == 6  # 3 epochs x 2 steps, nothing lost
+    kinds = [ev.get("kind") for ev in traj.events]
+    assert "slice_lost" in kinds
+
+
+@needs8
+def test_preemption_drain_then_failover(tmp_path):
+    """Drain + shrink in one fit() call: the notice names the leaving
+    slice, fit drains (step -> checkpoint) before the deadline, then
+    resumes on the survivors."""
+    x, y = dataset(64)
+    m = small_model(machine_file=two_slice_machine(tmp_path))
+    fi = FaultInjector().inject(
+        "preemption_notice", at_step=1, deadline_s=30.0,
+        max_drain_steps=1, slice=1, surviving_devices=4,
+    )
+    traj = m.search_trajectory  # failover recompile swaps in a fresh one
+    m.fit(x, y, epochs=3, verbose=False,
+          checkpoint_dir=str(tmp_path / "ckpt"),
+          checkpoint_every_n_steps=2, fault_injector=fi, elastic=True)
+    assert int(m.executor.mesh.devices.size) == 4
+    assert m.state.step == 6
+    kinds = [ev.get("kind") for ev in traj.events]
+    assert "slice_drain" in kinds
+
+
+# ----------------------------------------------------------------------
+# survivability classification + FFA6xx lint
+# ----------------------------------------------------------------------
+@needs8
+def test_searched_strategy_is_survivable_and_ffa601_clean(tmp_path):
+    """On the 2-slice machine the search (with the survivability
+    penalty) picks a strategy whose cross-slice traffic is pure data
+    parallelism — the FFA601 lint is clean on it."""
+    m = small_model(machine_file=two_slice_machine(tmp_path),
+                    search_budget=10)
+    cm = m._build_cost_model()
+    assert cm.survivability_penalty > 0  # auto-armed on 2 slices
+    s = strategy_survivability(m.graph, getattr(m, "searched_views", None),
+                               machine=cm.machine)
+    assert s.survivable, [o for o in s.ops if not o.survivable]
+    rep = perf_diagnostics(m.graph, getattr(m, "searched_views", None),
+                           machine=cm.machine)
+    assert not rep.by_code("FFA601"), rep.summary()
+
+
+def _seeded_linear(weight_degrees):
+    """One 8-device Linear spanning both slices of a 2x4 machine, its
+    weight sharded per ``weight_degrees`` (test_perf_analysis.py graph
+    style: no compile, no devices)."""
+    from flexflow_tpu.ff_types import OperatorType
+    from flexflow_tpu.ops.linear import LinearParams
+    from flexflow_tpu.pcg.graph import Graph
+    from flexflow_tpu.pcg.op import PCGOp
+    from flexflow_tpu.pcg.parallel_tensor import ParallelTensor, make_dims
+
+    g = Graph()
+    x = ParallelTensor(dims=make_dims([32, 1024], [8, 1]),
+                       data_type=DataType.DT_FLOAT)
+    out = ParallelTensor(dims=make_dims([32, 4096], [8, 1]),
+                         data_type=DataType.DT_FLOAT)
+    op = PCGOp(OperatorType.OP_LINEAR, LinearParams(4096), [x])
+    out.owner_op = op
+    op.outputs.append(out)
+    op.machine_view = MachineView(start_device_id=0, dim=(8,), stride=(1,))
+    g.add_op(op)
+    w = ParallelTensor(dims=make_dims([1024, 4096], weight_degrees),
+                       data_type=DataType.DT_FLOAT)
+    w.owner_op = op
+    op.weights.append(w)
+    op.weight_names.append("kernel")
+    return g, op
+
+
+def test_ffa601_fires_on_seeded_cross_slice_sharding():
+    """Seeded defect: an 8-way weight shard over 2 slices of 4 devices
+    puts 4 of the 8 shard pieces in each slice — losing either slice is
+    unrecoverable without a checkpoint. FFA601 names the op; the search
+    penalty prices exactly the same strategy."""
+    from flexflow_tpu.search import CostModel
+
+    machine = MachineModel(num_nodes=2, workers_per_node=4)
+    g, _ = _seeded_linear([1, 8])
+    s = strategy_survivability(g, None, machine=machine)
+    assert not s.survivable
+    assert s.ops[0].status == CROSS_SLICE_SHARDED
+    assert s.ops[0].partition_degree == 8
+    assert s.ops[0].per_slice_devices == (4, 4)
+    rep = perf_diagnostics(g, machine=machine)
+    hits = rep.by_code("FFA601")
+    assert hits, rep.summary()
+    assert hits[0].severity is Severity.WARNING
+    assert "not slice-loss-survivable" in hits[0].message
+    assert "full reshard" in hits[0].message
+    assert "slice" in (hits[0].fix_hint or "")
+    cm = CostModel(machine, survivability_penalty=0.25)
+    assert survivability_cost_factor(g, None, cm) > 1.0
+    # contrast: same span, shards confined 4-way -> complete shard sets
+    # per slice, FFA600 INFO (survivable summary), no penalty
+    g2, _ = _seeded_linear([1, 4])
+    s2 = strategy_survivability(g2, None, machine=machine)
+    assert s2.survivable and s2.spans_slices
+    rep2 = perf_diagnostics(g2, machine=machine)
+    assert not rep2.by_code("FFA601"), rep2.summary()
+    assert rep2.by_code("FFA600")
+    assert survivability_cost_factor(g2, None, cm) == 1.0
+    # single-slice machine: the whole family is silent
+    flat = MachineModel(num_nodes=1, workers_per_node=8)
+    rep3 = perf_diagnostics(g, machine=flat)
+    assert not rep3.by_code("FFA601") and not rep3.by_code("FFA600")
+
+
+def test_survivability_factor_inert_on_single_node():
+    from flexflow_tpu.search import CostModel
+
+    m = small_model()
+    cm = CostModel(MachineModel(num_nodes=1, workers_per_node=NDEV),
+                   survivability_penalty=0.5)
+    assert survivability_cost_factor(
+        m.graph, getattr(m, "searched_views", None), cm) == 1.0
