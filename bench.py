@@ -252,6 +252,9 @@ def main():
                 "baseline": baseline,
                 "baseline_source": baseline_source,
                 "phases_s_per_step": phases,
+                "n_chips": n_chips,
+                "backend": jax.default_backend(),
+                "jax_version": jax.__version__,
             }
         )
     )

@@ -9,7 +9,10 @@ MachineView (or on every device when unplaced, i.e. replicated SPMD).
 Strategies that cannot fit are rejected before the simulator or the
 executor ever touches them.
 
-Codes: FFA301 over budget (error), FFA302 usage report (info).
+Codes: FFA301 over budget (error), FFA302 usage report (info),
+FFA303 measured reconciliation (info/warning — the step observatory's
+live watermarks audited against this module's static prediction,
+``memory_reconciliation_diagnostics``).
 """
 from __future__ import annotations
 
@@ -123,3 +126,54 @@ def memory_diagnostics(
             f"{peak_dev} ({len(per_dev)} device(s) used; no budget given)",
         )
     return rep, per_dev
+
+
+def memory_reconciliation_diagnostics(
+    static_per_dev: Dict[int, int],
+    measured_per_dev: Dict[int, int],
+    *,
+    source: str = "memory_stats",
+) -> Tuple[AnalysisReport, Optional[float]]:
+    """The measured counterpart of FFA301/FFA302: reconcile the step
+    observatory's live per-device watermarks (obs/step_profile.
+    HbmSampler) against this module's static prediction. Returns the
+    report plus the accuracy ratio static_peak / measured_peak
+    (``ff_hbm_static_accuracy``): >1 means the static model
+    over-provisions (safe, but it rejects strategies that would fit);
+    <1 means it UNDER-predicts — the direction that passes the FFA301
+    gate and then OOMs on device, reported as a WARNING. The
+    ``live_arrays`` source is an allocator estimate (it cannot see XLA
+    scratch), so under-prediction against it is still reported but the
+    message says which oracle measured."""
+    rep = AnalysisReport()
+    static_peak = max(static_per_dev.values(), default=0)
+    measured_peak = max(measured_per_dev.values(), default=0)
+    if static_peak <= 0 or measured_peak <= 0:
+        rep.add(
+            Severity.INFO, "FFA303",
+            "HBM reconciliation skipped: "
+            + ("no static estimate" if static_peak <= 0
+               else "no measured watermark")
+            + f" (source {source})",
+        )
+        return rep, None
+    ratio = static_peak / measured_peak
+    mib = 1024.0 ** 2
+    rep.add(
+        Severity.INFO, "FFA303",
+        f"measured peak HBM {measured_peak / mib:.1f} MiB vs static "
+        f"estimate {static_peak / mib:.1f} MiB — static accuracy "
+        f"{ratio:.2f} ({source}, {len(measured_per_dev)} device(s))",
+    )
+    if ratio < 0.9:
+        rep.add(
+            Severity.WARNING, "FFA303",
+            f"the static model under-predicts peak HBM by "
+            f"{(measured_peak - static_peak) / mib:.1f} MiB "
+            f"(accuracy {ratio:.2f}) — a strategy can pass the FFA301 "
+            "budget gate and still OOM on device",
+            fix_hint="raise the activation-stash accounting "
+                     "(estimate_per_device_bytes) or lower the budget "
+                     "headroom the search plans against",
+        )
+    return rep, ratio

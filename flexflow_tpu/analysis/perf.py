@@ -35,6 +35,13 @@ These passes audit the CHOSEN strategy before it executes:
     analysis/collectives.py next to the per-op collective checks:
     unknown collective kinds are a typed warning instead of a silent
     estimate skip, and the all-to-all kind is modelled + exported).
+  * FFA506 — overlap REALIZATION (the measured counterpart of FFA501):
+    given a step-observatory capture (obs/step_profile.py), compare the
+    measured hidden-vs-exposed split of the weight-grad collectives
+    against the discount's assumed overlap_efficiency — a realized
+    ratio materially below the assumption means the search priced
+    overlap the silicon does not deliver
+    (``overlap_realization_diagnostics``).
 
 The FFA6xx family audits fault-domain ROBUSTNESS of the strategy on
 multi-slice machines (search/survivability.py; runtime counterpart in
@@ -226,6 +233,74 @@ def _overlap_discount_diagnostics(graph, views, cost_model,
                      "graph or re-search with a calibrated "
                      "overlap_efficiency",
         )
+
+
+# ----------------------------------------------------------------------
+# FFA506 — overlap realization (measured, from the step observatory)
+# ----------------------------------------------------------------------
+def overlap_realization_diagnostics(profile,
+                                    cost_model=None) -> AnalysisReport:
+    """The measured counterpart of FFA501: audit a step-observatory
+    capture (obs/step_profile.StepProfile) against the overlap
+    discount's efficiency assumption. FFA501 proves the discount is
+    statically *schedulable*; this pass reports whether the fused step
+    actually *realized* it — INFO when measurement and assumption
+    agree, WARNING when the realized ratio falls materially below the
+    assumed overlap_efficiency (the search is pricing overlap the
+    hardware does not deliver), plus a WARNING naming the most-exposed
+    collective so the calibration loop has a worklist entry."""
+    rep = AnalysisReport()
+    assumed = float(getattr(cost_model, "overlap_efficiency",
+                            profile.assumed_efficiency)
+                    if cost_model is not None
+                    else profile.assumed_efficiency)
+    realized = profile.realized_ratio
+    if realized is None:
+        rep.add(
+            Severity.INFO, "FFA506",
+            "no weight-grad collectives measured (data degree "
+            f"{profile.data_degree}) — nothing for the overlap discount "
+            "to hide, realization not applicable",
+        )
+        return rep
+    rep.add(
+        Severity.INFO, "FFA506",
+        f"measured overlap realization {realized:.2f} "
+        f"(hidden {profile.hidden_sync_s * 1e3:.3f} ms of "
+        f"{profile.total_sync_s * 1e3:.3f} ms collective time; fused "
+        f"step {profile.step_wall_s * 1e3:.3f} ms vs serial "
+        f"{profile.serial_step_wall_s * 1e3:.3f} ms) against assumed "
+        f"overlap_efficiency {assumed:.2f} [{profile.mode}, "
+        f"{profile.backend}]",
+    )
+    # hold back on noise: require both a relative shortfall and a
+    # measurable absolute amount of exposed time before accusing the
+    # discount of optimism
+    if realized < assumed - 0.1 and \
+            profile.total_sync_s - profile.hidden_sync_s > 1e-6:
+        rep.add(
+            Severity.WARNING, "FFA506",
+            f"the search prices overlap at efficiency {assumed:.2f} but "
+            f"the fused step realized only {realized:.2f} — "
+            f"{(profile.total_sync_s - profile.hidden_sync_s) * 1e3:.3f} "
+            "ms of collective time stays exposed that the simulated "
+            "step time omits",
+            fix_hint="write the measured efficiency through the "
+                     "calibration store (StepProfile.write_calibration) "
+                     "and re-search",
+        )
+        exposed = [c for c in profile.collectives if c.overlappable]
+        if exposed:
+            worst = max(exposed, key=lambda c: c.exposed_s)
+            if worst.exposed_s > 0:
+                rep.add(
+                    Severity.WARNING, "FFA506",
+                    f"most-exposed collective: {worst.op}.grad_sync "
+                    f"({worst.kind}, {worst.wire_bytes} wire bytes) — "
+                    f"{worst.exposed_s * 1e3:.3f} ms of its "
+                    f"{worst.sync_s * 1e3:.3f} ms stays exposed",
+                )
+    return rep
 
 
 # ----------------------------------------------------------------------

@@ -972,6 +972,15 @@ class FFModel:
             # the store's measured overlap efficiency overrides the
             # shipped calibration's for the discount soundness math
             cm.overlap_efficiency = float(glb["overlap_efficiency"])
+            cm.overlap_efficiency_source = "calibration_store"
+        if glb and glb.get("collective_bytes_per_s"):
+            # measured per-kind collective bandwidths (the step
+            # observatory's in-situ write-through) ride on the oracle so
+            # provenance() reports what the search was priced with
+            cm.calibrated_collective_bandwidths = {
+                k: float(v)
+                for k, v in glb["collective_bytes_per_s"].items()
+            }
         return cm
 
     def _run_strategy_search(self, ndev: int):
@@ -1438,6 +1447,21 @@ class FFModel:
                 verify_strategy=verify_strategy, canary=canary,
                 lint=lint, tel=tel,
             )
+        except Exception as e:
+            # OOM forensics (obs/step_profile.py): a step that dies with
+            # RESOURCE_EXHAUSTED leaves the static memory prediction,
+            # the live allocator stats and the top allocations behind —
+            # the post-mortem answers "what ate the HBM" offline
+            if tel is not None and "RESOURCE_EXHAUSTED" in str(e):
+                from ..obs.step_profile import dump_oom_forensics
+
+                try:
+                    path = dump_oom_forensics(self, tel.config.dir,
+                                              error=str(e))
+                    obs.event("oom_forensics", cat="obs", path=path)
+                except Exception as dump_err:  # fflint: disable=FFL002 — forensics must not mask the OOM
+                    warnings.warn(f"oom forensics dump failed: {dump_err}")
+            raise
         finally:
             if _own_session:
                 obs.finish()
@@ -1727,6 +1751,16 @@ class FFModel:
             f"THROUGHPUT = {num_samples / elapsed:.2f} samples/s",
             name="fit_done", elapsed_s=elapsed, samples=num_samples,
         )
+        if tel is not None and getattr(tel.config, "step_profile", False):
+            # in-situ step observatory (obs/step_profile.py): the step is
+            # warm, the batch shapes are live — capture the measured
+            # timeline + overlap/HBM reconciliation into this session
+            from ..obs.step_profile import capture_into_session
+
+            try:
+                capture_into_session(self, tel, xs, y, bs)
+            except Exception as e:  # fflint: disable=FFL002 — observability must not fail training
+                warnings.warn(f"step-profile capture failed: {e}")
         return self.perf_metrics
 
     # ------------------------------------------------------------------

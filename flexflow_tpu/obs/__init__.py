@@ -157,3 +157,10 @@ def explain_strategy(model, x=None, **kw):
     from .explain import explain_strategy as _impl
 
     return _impl(model, x, **kw)
+
+
+def capture_step_profile(model, x, y, **kw):
+    """See obs/step_profile.py (imported lazily: it pulls in jax)."""
+    from .step_profile import capture_step_profile as _impl
+
+    return _impl(model, x, y, **kw)

@@ -5,14 +5,20 @@ Usage:
     python -m flexflow_tpu.obs summary  <events.jsonl>
     python -m flexflow_tpu.obs prom     <metrics.jsonl> [-o metrics.prom]
     python -m flexflow_tpu.obs requests <events.jsonl> [--slowest K]
-    python -m flexflow_tpu.obs explain  [--top N] [model shape flags]
+    python -m flexflow_tpu.obs explain  [--top N] [--in-situ] [shape flags]
+    python -m flexflow_tpu.obs bench    [--src DIR] [--tolerance F]
     python -m flexflow_tpu.obs calibrate inspect <store.json>
     python -m flexflow_tpu.obs calibrate prune   <store.json> --max-age-h H
     python -m flexflow_tpu.obs calibrate diff    <a.json> <b.json>
 
 ``trace`` converts a structured event log to Chrome-trace JSON (open at
 https://ui.perfetto.dev). ``summary`` schema-validates the log and
-prints per-category/event counts plus step/search aggregates.
+prints per-category/event counts plus step/search aggregates — and,
+when the log carries a step-observatory capture, the overlap-
+realization/HBM numbers, per-collective hidden/exposed attribution and
+the measured-vs-simulated per-op drift from the overlay file.
+``bench`` prints the BENCH_r*.json round trajectory with the newest
+round's regression attributed per phase (fwd/bwd/opt/sync).
 ``prom`` re-renders the last metrics.jsonl snapshot as Prometheus text.
 ``requests`` reconstructs per-request lifecycles from the serving
 flight recorder's events (cat "requests"): stage breakdown, top-K
@@ -76,7 +82,78 @@ def _cmd_summary(args) -> int:
         best = sum(1 for e in cands if e.get("args", {}).get("best"))
         print(f"substitutions: {len(cands)} candidate(s), "
               f"{best} improved the best strategy")
+    _summarize_step_profile(args.events, events)
     return 1 if problems else 0
+
+
+def _summarize_step_profile(events_path: str, events) -> None:
+    """Step-observatory section of ``summary``: the capture's headline
+    numbers (overlap realization, HBM accuracy), per-collective
+    hidden/exposed attribution, and — when the overlay file sits next to
+    the event log — the measured-vs-simulated per-op drift."""
+    import os
+
+    from .step_profile import MEASURED_CAT, OVERLAY_FILE
+
+    sp = next((e for e in events
+               if e["name"] == "step_profile" and e["cat"] == MEASURED_CAT),
+              None)
+    if sp is None:
+        return
+    a = sp.get("args", {})
+    print("step observatory (obs.capture_step_profile):")
+    rr = a.get("realized_ratio")
+    print(f"  mode {a.get('mode')}/{a.get('backend')}, "
+          f"fused step {float(a.get('step_wall_s', 0)) * 1e3:.3f} ms "
+          f"(serial {float(a.get('serial_step_wall_s', 0)) * 1e3:.3f} ms)")
+    if rr is not None:
+        print(f"  overlap realization: {float(rr):.2f} measured vs "
+              f"{float(a.get('assumed_efficiency', 1.0)):.2f} assumed "
+              f"(hidden {float(a.get('hidden_sync_s', 0)) * 1e3:.3f} of "
+              f"{float(a.get('total_sync_s', 0)) * 1e3:.3f} ms sync)")
+    acc = a.get("hbm_static_accuracy")
+    if acc is not None:
+        print(f"  HBM: measured peak {int(a.get('hbm_peak_bytes', 0))} B "
+              f"({a.get('hbm_source')}), static accuracy {float(acc):.2f}")
+    syncs = [e for e in events
+             if e["cat"] == MEASURED_CAT and e["ph"] == "X"
+             and e["name"].endswith(".grad_sync")]
+    for e in syncs:
+        sa = e.get("args", {})
+        print(f"  {e['name']:<34} {sa.get('collective', '?'):<28} "
+              f"hidden {float(sa.get('hidden_s', 0)) * 1e3:>8.3f} ms  "
+              f"exposed {float(sa.get('exposed_s', 0)) * 1e3:>8.3f} ms")
+    overlay = os.path.join(os.path.dirname(os.path.abspath(events_path)),
+                           OVERLAY_FILE)
+    if not os.path.exists(overlay):
+        return
+    with open(overlay) as f:
+        tr = json.load(f).get("traceEvents", [])
+    pid_names = {e["pid"]: e["args"]["name"] for e in tr
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+    sim: dict = {}
+    meas: dict = {}
+    for e in tr:
+        if e.get("ph") != "X":
+            continue
+        group = pid_names.get(e.get("pid"))
+        name = e["name"].removesuffix(".bwd")
+        if name.endswith(".grad_sync"):
+            continue
+        bucket = sim if group == "simulated" else (
+            meas if group == "measured" else None)
+        if bucket is not None:
+            # dur is µs in the overlay; one span per device — keep max
+            bucket[name] = max(bucket.get(name, 0.0), e.get("dur", 0.0))
+    both = sorted(set(sim) & set(meas),
+                  key=lambda n: abs(meas[n] - sim[n]), reverse=True)
+    if both:
+        print(f"  measured-vs-simulated drift ({OVERLAY_FILE}, worst 5):")
+        print(f"    {'op':<28} {'sim ms':>9} {'meas ms':>9} {'drift':>7}")
+        for n in both[:5]:
+            s, m = sim[n] / 1e3, meas[n] / 1e3
+            drift = (m / s) if s > 0 else float("inf")
+            print(f"    {n[:28]:<28} {s:>9.4f} {m:>9.4f} {drift:>6.2f}x")
 
 
 def _cmd_prom(args) -> int:
@@ -264,7 +341,21 @@ def _cmd_explain(args) -> int:
         loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
         metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR],
     )
-    exp = explain_strategy(model, repeats=args.repeats)
+    prof = None
+    if args.in_situ:
+        import numpy as np
+
+        from .step_profile import capture_step_profile
+
+        rng = np.random.RandomState(0)
+        in_pt = model.executor.input_pts[0]
+        x = rng.rand(*in_pt.material_shape()).astype(np.float32)
+        y = rng.rand(*in_pt.material_shape()).astype(np.float32)
+        prof = capture_step_profile(model, x, y, batch_size=args.batch)
+        print(f"in-situ capture: mode={prof.mode}, "
+              f"fused step {prof.step_wall_s * 1e3:.3f} ms, "
+              f"realized overlap {prof.realized_ratio}")
+    exp = explain_strategy(model, repeats=args.repeats, step_profile=prof)
     print(exp.summary(args.top))
     print(f"kernel worklist (top {args.top} by |simulated - measured|):")
     for w in exp.worklist(args.top):
@@ -276,6 +367,53 @@ def _cmd_explain(args) -> int:
               f"sim {w['sim_total_s'] * 1e3:.4f} ms "
               f"(x{w['ratio']:.2f}) — {verdict}")
     return 0
+
+
+def _cmd_bench(args) -> int:
+    from .step_profile import bench_regression_attribution, load_bench_history
+
+    history = load_bench_history(args.src)
+    if not history:
+        print(f"bench: no BENCH_r*.json artifacts under {args.src}")
+        return 1
+    print(f"{len(history)} bench round(s) under {args.src}:")
+    print(f"  {'round':>5} {'value':>10} {'unit':<15} {'chips':>5} "
+          f"{'backend':<8} {'fwd ms':>8} {'bwd ms':>8} {'opt ms':>8} "
+          f"{'sync ms':>8}")
+    for r in history:
+        ph = r.get("phases") or {}
+
+        def ms(k, _ph=ph):
+            v = _ph.get(k)
+            return f"{v * 1e3:>8.3f}" if isinstance(v, (int, float)) \
+                else f"{'-':>8}"
+
+        print(f"  {r['round'] if r['round'] is not None else '?':>5} "
+              f"{r['value'] if r['value'] is not None else '-':>10} "
+              f"{(r['unit'] or '-')[:15]:<15} "
+              f"{r['n_chips'] if r['n_chips'] is not None else '-':>5} "
+              f"{(r['backend'] or '-')[:8]:<8} "
+              f"{ms('fwd')} {ms('bwd')} {ms('opt')} {ms('sync')}")
+    att = bench_regression_attribution(history, tolerance=args.tolerance)
+    if att.get("status") != "ok":
+        print(f"attribution: {att.get('status')} "
+              f"({att.get('rounds', 0)} usable round(s))")
+        return 0
+    print(f"newest r{att['cur_round']:02d} vs r{att['prev_round']:02d}: "
+          f"{att['cur_value']:.3f} vs {att['prev_value']:.3f} "
+          f"(ratio {att['throughput_ratio']:.3f}"
+          + (", REGRESSED" if att["regressed"] else "") + ")")
+    if att.get("phases"):
+        for ph, d in att["phases"].items():
+            share = d.get("share_of_regression", 0.0)
+            print(f"  {ph:<5} {d['prev_s'] * 1e3:>8.3f} -> "
+                  f"{d['cur_s'] * 1e3:>8.3f} ms "
+                  f"({d['delta_s'] * 1e3:+.3f}; "
+                  f"{share:.0%} of the regression)")
+        if att.get("dominant_phase"):
+            print(f"  dominant phase: {att['dominant_phase']} "
+                  f"(step {att['step_delta_s'] * 1e3:+.3f} ms)")
+    return 1 if att["regressed"] and args.strict else 0
 
 
 def main(argv=None) -> int:
@@ -325,14 +463,29 @@ def main(argv=None) -> int:
     e.add_argument("--layers", type=int, default=2)
     e.add_argument("--repeats", type=int, default=1)
     e.add_argument("--bf16", action="store_true")
+    e.add_argument("--in-situ", action="store_true",
+                   help="also capture a step profile of the fused jitted "
+                        "step and join its per-op seconds into the rows")
+    b = sub.add_parser(
+        "bench",
+        help="BENCH_r*.json round trajectory + newest-round regression "
+             "attribution per phase (fwd/bwd/opt/sync)",
+    )
+    b.add_argument("--src", default=".",
+                   help="directory holding BENCH_r*.json (default: .)")
+    b.add_argument("--tolerance", type=float, default=0.05,
+                   help="fractional throughput drop that counts as a "
+                        "regression (default 0.05)")
+    b.add_argument("--strict", action="store_true",
+                   help="exit 1 when the newest round regressed")
     args = p.parse_args(argv)
     if args.cmd == "calibrate" and args.action == "diff" \
             and not args.other:
         p.error("calibrate diff needs two store paths")
     return {"trace": _cmd_trace, "summary": _cmd_summary,
             "prom": _cmd_prom, "requests": _cmd_requests,
-            "calibrate": _cmd_calibrate,
-            "explain": _cmd_explain}[args.cmd](args)
+            "calibrate": _cmd_calibrate, "explain": _cmd_explain,
+            "bench": _cmd_bench}[args.cmd](args)
 
 
 if __name__ == "__main__":

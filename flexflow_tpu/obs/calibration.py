@@ -340,16 +340,23 @@ def resolve_calibration(calibration=None, *,
             logger.warning("calibration rejected: %s", e)
             return None, {}
     bad = store.problems(max_age_s=max_age_s)
-    if bad:
-        if not store.ops and len(bad) == 1:
-            # a fresh (about-to-be-written) session store is normal, not
-            # a rejection worth warning about
-            logger.debug("calibration store %s is empty; compiling "
-                         "uncalibrated", store.path or "<memory>")
-        else:
-            logger.warning(
-                "calibration store %s rejected: %s",
-                store.path or "<memory>", "; ".join(bad)
-            )
+    fatal = [p for p in bad if not p.startswith("empty:")]
+    if fatal:
+        logger.warning(
+            "calibration store %s rejected: %s",
+            store.path or "<memory>", "; ".join(fatal)
+        )
+        return None, {}
+    if not store.ops:
+        if store.globals:
+            # globals-only store: the step observatory's write-through
+            # (overlap_efficiency, collective bandwidths) records no
+            # per-op table, but its measured cost-model globals are
+            # fingerprint-checked above and still apply
+            return None, dict(store.globals)
+        # a fresh (about-to-be-written) session store is normal, not
+        # a rejection worth warning about
+        logger.debug("calibration store %s is empty; compiling "
+                     "uncalibrated", store.path or "<memory>")
         return None, {}
     return store.table(), dict(store.globals)

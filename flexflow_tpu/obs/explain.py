@@ -146,17 +146,26 @@ class StrategyExplanation:
             f"{sub.get('candidates', 0)} substitution candidate(s) "
             f"({sub.get('improved', 0)} improved the best)"
         )
+        insitu = any(r.get("insitu_total_s") is not None
+                     for r in self.rows[:n])
         hdr = (f"  {'op':<28} {'type':<20} {'sim ms':>9} {'meas ms':>9} "
-               f"{'|err| ms':>9} {'ratio':>7}  static")
+               + (f"{'insitu ms':>10} " if insitu else "")
+               + f"{'|err| ms':>9} {'ratio':>7}  static")
         lines.append(hdr)
         flagged = []
         for r in self.rows[:n]:
             codes = sorted({d["code"] for d in r.get("diagnostics", [])})
+            ins = ""
+            if insitu:
+                it = r.get("insitu_total_s")
+                ins = (f"{it * 1e3:>10.4f} " if it is not None
+                       else f"{'-':>10} ")
             lines.append(
                 f"  {r['name'][:28]:<28} {r['op_type'][:20]:<20} "
                 f"{r['sim_total_s'] * 1e3:>9.4f} "
                 f"{r['meas_total_s'] * 1e3:>9.4f} "
-                f"{r['abs_err_s'] * 1e3:>9.4f} "
+                + ins
+                + f"{r['abs_err_s'] * 1e3:>9.4f} "
                 f"{r['ratio']:>7.2f}"
                 + (f"  !{','.join(codes)}" if codes else "")
             )
@@ -176,7 +185,8 @@ class StrategyExplanation:
 
 
 def explain_strategy(model, x=None, *, repeats: int = 3, warmup: int = 1,
-                     cost_model=None) -> StrategyExplanation:
+                     cost_model=None,
+                     step_profile=None) -> StrategyExplanation:
     """Rank the compiled model's compute ops by cost-model
     miscalibration: simulated single-device (fwd + bwd) seconds from the
     search's cost oracle vs measured seconds from
@@ -184,7 +194,12 @@ def explain_strategy(model, x=None, *, repeats: int = 3, warmup: int = 1,
 
     `x`: batch input arrays (defaults to random data at the compiled
     input shapes). `cost_model`: the oracle to audit (defaults to the
-    model's own, the one the search used)."""
+    model's own, the one the search used). `step_profile`: a
+    obs.capture_step_profile() result — its per-op timeline (the real
+    jitted step's XLA trace on TPU) joins each row as insitu_*_s
+    seconds next to the isolated-op profile_ops numbers, so an op that
+    only misbehaves inside the fused step (layout change, lost fusion)
+    is visible against its isolated measurement."""
     import numpy as np
 
     from ..pcg.machine_view import MachineView
@@ -259,6 +274,30 @@ def explain_strategy(model, x=None, *, repeats: int = 3, warmup: int = 1,
                             for d in diags_by_guid.get(op.guid, [])],
             "_key": _op_cost_key(op),
         })
+    if step_profile is not None:
+        # in-situ seconds from the step observatory's timeline: one
+        # span per (op, device); devices run the same SPMD program, so
+        # the first span's duration stands for the op
+        insitu_f: Dict[str, float] = {}
+        insitu_b: Dict[str, float] = {}
+        for e in step_profile.events:
+            if e.get("ph") != "X":
+                continue
+            nm = e["name"]
+            if nm.endswith(".grad_sync"):
+                continue
+            if nm.endswith(".bwd"):
+                insitu_b.setdefault(nm[:-4], float(e.get("dur", 0.0)))
+            else:
+                insitu_f.setdefault(nm, float(e.get("dur", 0.0)))
+        for r in rows:
+            f, b = insitu_f.get(r["name"]), insitu_b.get(r["name"])
+            r["insitu_fwd_s"], r["insitu_bwd_s"] = f, b
+            r["insitu_total_s"] = (
+                (f or 0.0) + (b or 0.0)
+                if f is not None or b is not None else None
+            )
+            r["insitu_source"] = step_profile.mode
     rows.sort(key=lambda r: r["abs_err_s"], reverse=True)
     traj = getattr(model, "search_trajectory", None)
     tsum = traj.summary() if traj is not None else {}

@@ -51,6 +51,14 @@ class TelemetryConfig:
         (obs/calibration.py) — explain_strategy().apply() writes
         measured per-op costs through to it, and compile() under this
         session loads it back.
+    step_profile: capture an in-situ measured timeline of the real
+        jitted step after the training loop (obs/step_profile.py):
+        measured events + HBM counter tracks into this session's log,
+        the overlap-realization / HBM-reconciliation gauges, the
+        simulated-vs-measured overlay (``step_timeline.json``), and —
+        when calibration_path is set — the measured overlap efficiency
+        and collective bandwidths written through to the store.
+    step_profile_repeats: timed repeats per measurement in that capture.
     """
 
     dir: str
@@ -62,6 +70,8 @@ class TelemetryConfig:
     search_replay_limit: int = 20_000
     request_sample_rate: float = 1.0
     calibration_path: Optional[str] = None
+    step_profile: bool = False
+    step_profile_repeats: int = 2
     events_file: str = "events.jsonl"
     prom_file: str = "metrics.prom"
     metrics_jsonl_file: str = "metrics.jsonl"
@@ -89,8 +99,11 @@ class Telemetry:
         events_path = os.path.join(config.dir, config.events_file)
         # a fresh session truncates stale artifacts (the tracer appends,
         # and metrics.jsonl accumulates snapshots within ONE session)
+        from .step_profile import OOM_FORENSICS_FILE, OVERLAY_FILE
+
         for name in (config.events_file, config.metrics_jsonl_file,
-                     config.prom_file, config.trace_file):
+                     config.prom_file, config.trace_file,
+                     OVERLAY_FILE, OOM_FORENSICS_FILE):
             p = os.path.join(config.dir, name)
             if os.path.exists(p):
                 os.remove(p)

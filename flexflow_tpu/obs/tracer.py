@@ -12,7 +12,7 @@ one Perfetto view.
 Event schema (one JSON object per events.jsonl line):
 
     {"ts": <float, seconds since session start>,
-     "ph": "X" | "i",              # complete span | instant
+     "ph": "X" | "i" | "C",        # complete span | instant | counter
      "name": <str>,                # e.g. "step", "mcmc_iter"
      "cat": <str>,                 # "compile" | "search" | "train" |
                                    # "checkpoint" | "runtime" | "serving"
@@ -20,7 +20,10 @@ Event schema (one JSON object per events.jsonl line):
      "dur": <float, seconds>,      # spans only
      "tid": <int>,                 # lane within the category (device id
                                    # for simulated timelines, else 0)
-     "args": {...}}                # free-form structured payload
+     "args": {...}}                # free-form structured payload; for
+                                   # counters (ph=C) every value must be
+                                   # numeric — each key becomes a series
+                                   # on the Perfetto counter track
 
 Disabled-path cost is ~zero: when no telemetry session is active the
 module-level helpers in `flexflow_tpu.obs` hand out the shared
@@ -36,7 +39,7 @@ import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 EVENT_REQUIRED_KEYS = ("ts", "ph", "name", "cat")
-_PHASES = ("X", "i")
+_PHASES = ("X", "i", "C")
 
 
 def validate_event(obj) -> List[str]:
@@ -53,6 +56,15 @@ def validate_event(obj) -> List[str]:
         problems.append(f"ph={ph!r} not in {_PHASES}")
     if ph == "X" and not isinstance(obj.get("dur"), (int, float)):
         problems.append("span (ph=X) without numeric dur")
+    if ph == "C":
+        series = obj.get("args")
+        if not isinstance(series, dict) or not series:
+            problems.append("counter (ph=C) without args series")
+        else:
+            for k, v in series.items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    problems.append(
+                        f"counter (ph=C) series {k!r} value {v!r} not numeric")
     if not isinstance(obj.get("ts", 0.0), (int, float)):
         problems.append(f"ts={obj.get('ts')!r} not numeric")
     if "args" in obj and not isinstance(obj["args"], dict):
@@ -93,6 +105,9 @@ class NullTracer:
         return _NULL_SPAN
 
     def instant(self, name, cat="runtime", **args):
+        return None
+
+    def counter(self, name, cat="runtime", tid=0, **series):
         return None
 
     def emit(self, event):
@@ -209,6 +224,20 @@ class Tracer:
             "args": args,
         })
 
+    def counter(self, name, cat="runtime", tid=0, ts=None, **series) -> None:
+        """Record one sample of a Perfetto counter track. Each kwarg is a
+        series on the track named `name` (e.g. hbm_bytes per device);
+        values must be numeric — non-numeric samples are rejected by
+        `validate_event` and dropped at export."""
+        self.emit({
+            "ts": (time.perf_counter() - self.t0) if ts is None else ts,
+            "ph": "C",
+            "name": name,
+            "cat": cat,
+            "tid": tid,
+            "args": series,
+        })
+
     def emit(self, event: dict) -> None:
         with self._lock:
             if self._emitted >= self.max_events:
@@ -284,8 +313,9 @@ def to_chrome_trace(events: Iterable[dict],
         }
         if e["ph"] == "X":
             entry["dur"] = float(e.get("dur", 0.0)) * 1e6
-        else:
+        elif e["ph"] == "i":
             entry["s"] = "t"  # instant scope: thread
+        # ph=C needs nothing extra: args already hold the series values
         out.append(entry)
     meta = [
         {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,

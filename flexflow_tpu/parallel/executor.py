@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -1051,6 +1052,24 @@ class PCGExecutor:
             self._train_step = jax.jit(self._make_step(),
                                        donate_argnums=self._donate_state())
         return self._train_step
+
+    def time_train_step(self, state, batch_inputs, labels, rng, *,
+                        repeats: int = 3, warmup: int = 1) -> float:
+        """Wall-clock the REAL fused jitted training step (the step
+        observatory's in-situ probe, obs/step_profile.py): mean seconds
+        per step over `repeats` timed runs after `warmup` untimed ones.
+        Uses the non-donating step variant so the caller's state (and
+        the model's live params) survive the measurement untouched."""
+        step = self.build_train_step(donate=False)
+        parts = None
+        for _ in range(max(1, warmup)):
+            _, parts = step(state, batch_inputs, labels, rng)
+            jax.block_until_ready(parts["loss"])  # fflint: disable=FFL103 — timing harness, the sync IS the measurement
+        t0 = time.perf_counter()
+        for _ in range(max(1, repeats)):
+            _, parts = step(state, batch_inputs, labels, rng)
+        jax.block_until_ready(parts["loss"])  # fflint: disable=FFL103 — timing harness, the sync IS the measurement
+        return (time.perf_counter() - t0) / max(1, repeats)
 
     def build_train_scan(self) -> Callable:
         """Multi-step driver: lax.scan over pre-staged batches in ONE XLA

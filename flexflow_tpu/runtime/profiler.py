@@ -141,6 +141,57 @@ def profile_ops(
     return {name: p.forward_s for name, p in times.items()}
 
 
+def measured_timeline_events(model, batch_inputs, *, repeats: int = 2,
+                             warmup: int = 1) -> List[dict]:
+    """The deterministic instrumented capture behind the step
+    observatory's CPU fallback (obs/step_profile.py): the same eager
+    chunked topo walk as `profile_ops`, but laid out as obs-tracer
+    events — forward spans in topo order, per-op VJP backward spans
+    (`<op>.bwd`) in reverse topo order after them, every span
+    attributed to its PCG op guid with REAL perf_counter timestamps
+    rebased to the capture's start. Timestamps tile the ops back to
+    back (eager execution is serial), so the export reads as one
+    measured step; `ts`/`dur` are seconds, cat is "measured", tid is
+    the op's searched-view device (all of them, like the simulated
+    export, so the tracks align in Perfetto)."""
+    profs = profile_ops(model, batch_inputs, repeats=repeats,
+                        warmup=warmup, backward=True)
+    views = getattr(model, "searched_views", None) or {}
+    topo = model.executor.topo
+    events: List[dict] = []
+
+    def tids(op):
+        v = views.get(op.guid) or op.machine_view
+        return v.device_ids() if v is not None else [0]
+
+    cursor = 0.0
+    for op in topo:
+        p = profs.get(op.name)
+        if p is None:
+            continue
+        for d in tids(op):
+            events.append({
+                "ts": cursor, "ph": "X", "name": op.name,
+                "cat": "measured", "dur": p.forward_s, "tid": d,
+                "args": {"op_type": op.op_type.name, "guid": op.guid,
+                         "pass": "forward", "source": "instrumented"},
+            })
+        cursor += p.forward_s
+    for op in reversed(topo):
+        p = profs.get(op.name)
+        if p is None or p.backward_s <= 0:
+            continue
+        for d in tids(op):
+            events.append({
+                "ts": cursor, "ph": "X", "name": f"{op.name}.bwd",
+                "cat": "measured", "dur": p.backward_s, "tid": d,
+                "args": {"op_type": op.op_type.name, "guid": op.guid,
+                         "pass": "backward", "source": "instrumented"},
+            })
+        cursor += p.backward_s
+    return events
+
+
 def simulated_timeline_events(graph, views, cost_model,
                               *, backward: bool = False,
                               overlap_sync: bool = False) -> List[dict]:

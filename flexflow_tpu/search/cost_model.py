@@ -324,11 +324,23 @@ class CostModel:
         self.calibration_source: Optional[str] = None
         self.measured_hits = 0
         self.analytic_hits = 0
+        # in-situ calibrated globals (obs/step_profile.py write-through
+        # via the calibration store): where overlap_efficiency came from
+        # and the measured per-kind collective bandwidths the oracle was
+        # handed — provenance() reports both so "priced from reality" is
+        # a checkable claim
+        self.overlap_efficiency_source = (
+            "calibration" if (calibration or {}).get("overlap_efficiency")
+            is not None else "default"
+        )
+        self.calibrated_collective_bandwidths: Dict[str, float] = {}
 
     def provenance(self) -> dict:
         """How this oracle priced ops so far: measurement vs analytic
         roofline (cache-cold queries only — memoized repeats don't
-        re-count). analysis/perf.py attaches this to its report when a
+        re-count), plus the calibrated globals (overlap efficiency and
+        any measured collective bandwidths the calibration store fed
+        in). analysis/perf.py attaches this to its report when a
         measured source is present."""
         total = self.measured_hits + self.analytic_hits
         return {
@@ -338,6 +350,10 @@ class CostModel:
             "analytic_hits": self.analytic_hits,
             "measured_fraction": (self.measured_hits / total)
             if total else 0.0,
+            "overlap_efficiency": self.overlap_efficiency,
+            "overlap_efficiency_source": self.overlap_efficiency_source,
+            "collective_bytes_per_s":
+                dict(self.calibrated_collective_bandwidths),
         }
 
     def _calibration_class(self, op_type, flops=None,

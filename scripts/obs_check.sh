@@ -158,3 +158,85 @@ print("obs_check: fresh-process calibration load OK")
 EOF
 python -m flexflow_tpu.obs calibrate inspect "$CALIB" >/dev/null
 echo "obs_check: calibration round-trip OK"
+
+# step observatory: ONE fit(telemetry=) run captures the measured step
+# timeline, overlays it on the simulated schedule in a single Perfetto
+# file, exports the realization/HBM gauges + counter tracks, and writes
+# the measured overlap efficiency into the calibration store so a FRESH
+# process prices overlap from reality
+SPTEL="$TELDIR/sptel"
+SPCAL="$TELDIR/step_calib.json"
+python - "$SPTEL" "$SPCAL" <<'EOF'
+import json
+import sys
+
+import numpy as np
+
+from flexflow_tpu import (
+    ActiMode, DataType, FFConfig, FFModel, LossType, MetricsType,
+    SGDOptimizer, TelemetryConfig,
+)
+from flexflow_tpu.obs.metrics import parse_prometheus
+from flexflow_tpu.obs.tracer import read_events_jsonl
+
+teldir, calib = sys.argv[1], sys.argv[2]
+cfg = FFConfig()
+cfg.batch_size = 8  # manual lowering (no search) -> data degree = ndev
+m = FFModel(cfg)
+x = m.create_tensor((8, 8), DataType.DT_FLOAT)
+t = m.dense(x, 32, ActiMode.AC_MODE_RELU)
+t = m.softmax(m.dense(t, 3))
+m.compile(SGDOptimizer(lr=0.1),
+          LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+          [MetricsType.METRICS_ACCURACY])
+rng = np.random.RandomState(0)
+X = rng.randn(32, 8).astype(np.float32)
+Y = rng.randint(0, 3, (32, 1)).astype(np.int32)
+m.fit(X, Y, batch_size=8, epochs=1, verbose=False,
+      telemetry=TelemetryConfig(dir=teldir, step_profile=True,
+                                calibration_path=calib))
+
+events, problems = read_events_jsonl(f"{teldir}/events.jsonl")
+assert not problems, f"schema violations: {problems[:5]}"
+counters = [e for e in events if e["ph"] == "C"]
+assert counters, "no ph='C' counter events (hbm_bytes tracks missing)"
+overlay = json.load(open(f"{teldir}/step_timeline.json"))
+pids = {e["args"]["name"] for e in overlay["traceEvents"]
+        if e.get("ph") == "M"}
+assert {"simulated", "measured"} <= pids, f"overlay process groups: {pids}"
+assert min(e["ts"] for e in overlay["traceEvents"] if "ts" in e) == 0.0
+series = parse_prometheus(open(f"{teldir}/metrics.prom").read())
+assert "ff_overlap_realized_ratio" in series, sorted(series)
+hbm = [k for k in series if k.startswith("ff_hbm_peak_bytes")]
+assert hbm, "no ff_hbm_peak_bytes gauges"
+assert "ff_hbm_static_accuracy" in series, sorted(series)
+glb = json.load(open(calib)).get("globals", {})
+assert "overlap_efficiency" in glb, glb
+assert glb.get("collective_bytes_per_s"), glb
+print(f"obs_check: step observatory OK ({len(counters)} counter events, "
+      f"{len(hbm)} HBM gauges, realized="
+      f"{series['ff_overlap_realized_ratio']:.2f})")
+EOF
+python - "$SPCAL" <<'EOF'
+import sys
+
+from flexflow_tpu import (
+    ActiMode, DataType, FFConfig, FFModel, LossType, MetricsType,
+    SGDOptimizer,
+)
+
+cfg = FFConfig()
+cfg.batch_size = 8
+m = FFModel(cfg)
+x = m.create_tensor((8, 8), DataType.DT_FLOAT)
+t = m.dense(x, 32, ActiMode.AC_MODE_RELU)
+t = m.softmax(m.dense(t, 3))
+m.compile(SGDOptimizer(lr=0.1),
+          LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+          [MetricsType.METRICS_ACCURACY], calibration=sys.argv[1])
+prov = m._build_cost_model().provenance()
+assert prov["overlap_efficiency_source"] == "calibration_store", prov
+assert prov["collective_bytes_per_s"], prov
+print("obs_check: measured overlap calibration feeds a fresh compile OK")
+EOF
+echo "obs_check: step observatory round-trip OK"
