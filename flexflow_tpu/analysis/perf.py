@@ -42,6 +42,16 @@ These passes audit the CHOSEN strategy before it executes:
     ratio materially below the assumption means the search priced
     overlap the silicon does not deliver
     (``overlap_realization_diagnostics``).
+  * FFA507 — expert-capacity token dropping (WARNING): a group_by whose
+    declared capacity factor gives n_experts x capacity fewer slots than
+    the tokens x top_k assignments routed into it — the dispatch mask
+    statically drops the overflow every step (GShard-style token
+    dropping; fine if intended, silent accuracy loss if not).
+  * FFA508 — expert-capacity indivisibility (ERROR): the per-expert
+    capacity dim does not divide by the expert-parallel degree — either
+    a sharded capacity dim with a non-dividing degree, or a declared
+    config.expert_parallel_degree the strategy pass would silently skip
+    (parallel/strategies.apply_expert_parallel's divisibility guard).
 
 The FFA6xx family audits fault-domain ROBUSTNESS of the strategy on
 multi-slice machines (search/survivability.py; runtime counterpart in
@@ -97,6 +107,7 @@ def perf_diagnostics(
     machine=None,
     num_devices: Optional[int] = None,
     executor=None,
+    expert_degree: int = 1,
 ) -> AnalysisReport:
     """Run the FFA5xx static performance passes over a placed strategy.
 
@@ -105,6 +116,9 @@ def perf_diagnostics(
     machine: explicit MachineModel when no cost model is at hand.
     executor: a live PCGExecutor — its ``overlap_schedule()`` hook is
     audited for FFA502 races.
+    expert_degree: a declared config.expert_parallel_degree, audited
+    against expert capacities for FFA508 even when the strategy pass
+    skipped applying it.
     """
     rep = AnalysisReport()
     views = views or {}
@@ -114,6 +128,8 @@ def perf_diagnostics(
         _oracle_provenance_diagnostic(cost_model, rep)
         _overlap_discount_diagnostics(graph, views, cost_model, rep)
     _padding_roofline_diagnostics(graph, views, machine, rep)
+    _expert_capacity_diagnostics(graph, rep,
+                                 expert_degree=expert_degree)
     if machine is not None:
         _topology_cost_diagnostics(graph, views, machine, rep)
         if machine.num_nodes > 1:
@@ -382,6 +398,61 @@ def _padding_fix_hint(role: str, dim: int, size: int, degree: int,
     return (f"no divisor of {degree} shards {size} into {quantum}-"
             f"multiples; unshard dim {dim} or pad it to a multiple of "
             f"{quantum * degree}")
+
+
+# ----------------------------------------------------------------------
+# FFA507/FFA508 — expert capacity (token dropping + divisibility)
+# ----------------------------------------------------------------------
+def _expert_capacity_diagnostics(graph, rep: AnalysisReport, *,
+                                 expert_degree: int = 1) -> None:
+    """Audit every group_by dispatch for statically-decided capacity
+    hazards. Both verdicts read only the graph: capacity is baked into
+    the group_by output shape at build time, so dropped tokens and
+    non-dividing shards are knowable before a single step runs."""
+    for op in graph.ops:
+        if op.op_type != OperatorType.OP_GROUP_BY or not op.outputs:
+            continue
+        n = getattr(op.params, "n", len(op.outputs))
+        alpha = getattr(op.params, "alpha", 1.0)
+        cap = op.outputs[0].dims[0].size
+        if len(op.inputs) > 1 and len(op.inputs[1].dims) >= 2:
+            tokens = op.inputs[1].dims[0].size
+            top_k = op.inputs[1].dims[-1].size
+            routed = tokens * top_k
+            slots = n * cap
+            if slots < routed:
+                rep.add(
+                    Severity.WARNING, "FFA507",
+                    f"expert dispatch '{op.name}' declares capacity "
+                    f"factor {alpha:g}: {n} experts x {cap} slots = "
+                    f"{slots} for {routed} routed assignments "
+                    f"({tokens} tokens x top-{top_k}) — "
+                    f"{routed - slots} assignments are statically "
+                    "dropped every step",
+                    op=op,
+                    fix_hint="raise the capacity factor to >= 1.0 for "
+                             "dropless routing, or keep it if GShard-"
+                             "style token dropping is intended",
+                )
+        degrees = {expert_degree} if expert_degree > 1 else set()
+        degrees.update(t.dims[0].degree for t in op.outputs
+                       if t.dims and t.dims[0].degree > 1)
+        for deg in sorted(degrees):
+            if cap % deg != 0:
+                rep.add(
+                    Severity.ERROR, "FFA508",
+                    f"expert dispatch '{op.name}': per-expert capacity "
+                    f"{cap} does not divide by expert-parallel degree "
+                    f"{deg} — the capacity dim cannot be sharded "
+                    "evenly (strategies.apply_expert_parallel silently "
+                    "skips this op; a hand-placed shard would be "
+                    "ragged)",
+                    op=op,
+                    fix_hint=f"pick a capacity factor making the "
+                             f"capacity a multiple of {deg}, or lower "
+                             "the expert-parallel degree to a divisor "
+                             f"of {cap}",
+                )
 
 
 # ----------------------------------------------------------------------

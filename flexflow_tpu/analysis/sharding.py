@@ -202,10 +202,16 @@ def _check_degree_propagation(op, rep: AnalysisReport) -> None:
     if not op.inputs or not op.outputs:
         return
     in_t, out_t = op.inputs[0], op.outputs[0]
+    # compare MATERIAL dims only: a partial-sum output (row-parallel
+    # linear — reduce_linear_partition / partition_experts_alltoall)
+    # prepends a replica dim marking the pending Reduction, which must
+    # not shift the positional batch-dim comparison
+    in_dims = [d for d in in_t.dims if not d.is_replica_dim]
+    out_dims = [d for d in out_t.dims if not d.is_replica_dim]
     if op.op_type in _DEGREE_PRESERVING:
-        if len(in_t.dims) != len(out_t.dims):
+        if len(in_dims) != len(out_dims):
             return
-        for i, (di, do) in enumerate(zip(in_t.dims, out_t.dims)):
+        for i, (di, do) in enumerate(zip(in_dims, out_dims)):
             if di.degree != do.degree:
                 rep.add(
                     Severity.ERROR, "FFA104",
@@ -217,15 +223,17 @@ def _check_degree_propagation(op, rep: AnalysisReport) -> None:
     elif op.op_type == OperatorType.OP_LINEAR:
         # batch dims follow the input; the channel (last) dim may be
         # sharded by a column-parallel rewrite — but only with the weight
-        # actually sharded to match
-        n = min(len(in_t.dims), len(out_t.dims)) - 1
+        # actually sharded to match. A contraction-sharded input (row
+        # parallel) legitimately yields an unsharded-but-partial output,
+        # so the shared last/contraction dim is excluded either way.
+        n = min(len(in_dims), len(out_dims)) - 1
         for i in range(max(0, n)):
-            if in_t.dims[i].degree != out_t.dims[i].degree:
+            if in_dims[i].degree != out_dims[i].degree:
                 rep.add(
                     Severity.ERROR, "FFA104",
                     f"linear batch dim {i}: output degree "
-                    f"{out_t.dims[i].degree} != input degree "
-                    f"{in_t.dims[i].degree}", op=op,
+                    f"{out_dims[i].degree} != input degree "
+                    f"{in_dims[i].degree}", op=op,
                 )
         if out_t.dims and out_t.dims[-1].degree > 1:
             w_sharded = any(

@@ -184,6 +184,23 @@ def _transform(pat, in_states: List[_ShardState], ctx: _RuleCtx,
     if t == OperatorType.OP_CONV2D:
         st.over[1] = 1  # fresh NCHW channel dim
         return st
+    if t == OperatorType.OP_GROUP_BY:
+        # expert dispatch [tokens, d] -> n x [capacity, d]: the capacity
+        # dim is fresh (NOT the token dim — it must come out unsharded),
+        # the hidden dim keeps the token input's sharding
+        st.over[0] = 1
+        return st
+    if t == OperatorType.OP_AGGREGATE:
+        # expert combine: the token dim follows the gate input, the
+        # hidden dim follows the expert tensors, capacity disappears
+        exp = in_states[4] if len(in_states) > 4 else in_states[-1]
+        out = _ShardState()
+        out.over[0] = in_states[0].lookup(0)
+        out.over[1] = exp.lookup(1)
+        return out
+    if t == OperatorType.OP_TOPK:
+        st.over["last"] = 1  # fresh k dim
+        return st
     # rank-preserving default (activations, softmax, elementwise,
     # attention, embedding, split, noop, ...)
     return st

@@ -1033,6 +1033,7 @@ class FFModel:
             default_rules_path,
             load_rule_collection_from_path,
             rules_to_substitutions,
+            zoo_rules_path,
         )
 
         if cfg.substitution_json_path:
@@ -1040,9 +1041,11 @@ class FFModel:
             # silently fall back to the bundled defaults
             rules = load_rule_collection_from_path(cfg.substitution_json_path)
             xfers = xfers + rules_to_substitutions(rules)
-        elif _os.path.exists(default_rules_path()):
-            rules = load_rule_collection_from_path(default_rules_path())
-            xfers = xfers + rules_to_substitutions(rules)
+        else:
+            for rp in (default_rules_path(), zoo_rules_path()):
+                if _os.path.exists(rp):
+                    rules = load_rule_collection_from_path(rp)
+                    xfers = xfers + rules_to_substitutions(rules)
         res = MachineResource(
             num_nodes=machine.num_nodes,
             all_procs_per_node=machine.workers_per_node,
@@ -1127,6 +1130,7 @@ class FFModel:
         perf_rep = perf_diagnostics(
             self.graph, views=self.searched_views, cost_model=cost_model,
             num_devices=ndev,
+            expert_degree=getattr(cfg, "expert_parallel_degree", 1),
         )
         if perf_rep.errors:
             warnings.warn(

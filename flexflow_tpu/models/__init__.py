@@ -11,3 +11,7 @@ from .misc import (  # noqa: F401
 )
 from .resnet import build_resnet, build_resnext50  # noqa: F401
 from .transformer import build_transformer  # noqa: F401
+from .zoo import (  # noqa: F401
+    build_long_context_transformer,
+    build_moe_transformer,
+)

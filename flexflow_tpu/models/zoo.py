@@ -1,0 +1,105 @@
+"""Workload-zoo builders beyond the dense flagship transformer.
+
+Two model classes ROADMAP item 5 asks for (docs/models.md has the zoo
+table):
+
+* build_moe_transformer — a Mixtral-style sparse transformer: each block
+  is the reference's attention encoder with the dense MLP replaced by a
+  top-k gated mixture of expert FFNs built from the existing
+  Group_by/Aggregate ops (GShard-style dense dispatch/combine einsums,
+  ops/moe.py). The aggregate's lambda_bal auxiliary load-balance loss
+  rides ctx.add_aux_loss into the training objective.
+
+* build_long_context_transformer — the flagship encoder sized for 32k
+  sequence positions at modest batch, the shape where sequence/context
+  parallelism (ring attention, ops/attention.py) is the only way past
+  per-chip activation memory and where pure data parallelism can't even
+  fill a mesh (batch < devices).
+
+Default sizes are the real workloads; tests and bench pass CPU-sized
+overrides. The MoE defaults deliberately make the per-expert capacity
+(ops/moe.py _capacity: ceil(alpha * k / n * tokens)) NOT divisible by
+the mesh size while tokens/hidden are — pure data parallelism leaves
+the expert block unsharded, which is exactly the gap the expert-routing
+substitutions (search/substitution.py partition_experts_alltoall) win.
+"""
+from __future__ import annotations
+
+from ..core.model import FFModel
+from ..ff_types import DataType
+from .transformer import create_attention_encoder
+
+
+def build_moe_transformer(
+    model: FFModel,
+    batch_size: int,
+    seq_length: int = 4,
+    hidden_size: int = 256,
+    num_heads: int = 4,
+    num_layers: int = 2,
+    num_experts: int = 4,
+    top_k: int = 2,
+    capacity_factor: float = 1.2,
+    lambda_bal: float = 0.04,
+    num_classes: int = 10,
+):
+    """Mixtral-style MoE encoder: MHA -> top-k gated expert FFNs.
+
+    The MoE block operates on flattened (batch*seq, hidden) tokens —
+    group_by's dispatch einsum is rank-2 (ops/moe.py _gb_forward) — so
+    each block reshapes around model.moe and back. Experts project to
+    hidden_size so the block is residual-shaped for the next layer.
+    """
+    input_t = model.create_tensor(
+        (batch_size, seq_length, hidden_size), DataType.DT_FLOAT, name="tokens"
+    )
+    t = input_t
+    kdim = hidden_size // num_heads
+    tokens = batch_size * seq_length
+    for _ in range(num_layers):
+        t = model.multihead_attention(
+            t, t, t, hidden_size, num_heads, kdim, kdim
+        )
+        t = model.reshape(t, (tokens, hidden_size))
+        t = model.moe(
+            t,
+            num_exp=num_experts,
+            num_select=top_k,
+            expert_hidden_size=hidden_size,
+            alpha=capacity_factor,
+            lambda_bal=lambda_bal,
+        )
+        t = model.reshape(t, (batch_size, seq_length, hidden_size))
+    t = model.dense(t, num_classes)
+    t = model.softmax(t)
+    return input_t, t
+
+
+def build_long_context_transformer(
+    model: FFModel,
+    batch_size: int = 4,
+    seq_length: int = 32768,
+    hidden_size: int = 512,
+    num_heads: int = 8,
+    num_layers: int = 2,
+    num_classes: int = 10,
+):
+    """The flagship encoder at long context: 32k positions, small batch.
+
+    Same blocks as build_transformer (models/transformer.py); the point
+    is the shape — batch below the device count means data parallelism
+    alone cannot fill the mesh, and the searched seq-dim sharding
+    (partition_seq_ring) lowers attention through the ring impl in
+    ops/attention.py when streaming engages."""
+    input_t = model.create_tensor(
+        (batch_size, seq_length, hidden_size), DataType.DT_FLOAT, name="tokens"
+    )
+    t = input_t
+    kdim = hidden_size // num_heads
+    for _ in range(num_layers):
+        t = create_attention_encoder(
+            model, t, hidden_size, num_heads, kdim, kdim
+        )
+    t = model.dense(t, num_classes)
+    t = model.softmax(t)
+    return input_t, t

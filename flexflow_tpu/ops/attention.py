@@ -59,9 +59,19 @@ def _dropout_fallback(impl: str, op_name: str, reason: str) -> None:
                 "meshes keep the dense path",
         "backend": "the fused Pallas kernel needs the TPU backend",
         "seq": "the sequence exceeds the fused kernel's VMEM tile",
+        # sequence-parallel (ring/ulysses) fallbacks: the requested SP
+        # impl cannot engage, so XLA all-gathers the full K/V instead
+        "sp_mesh": f"FF_ATTENTION_IMPL={impl} needs a seq-sharded mesh "
+                   "(sequence_parallel_degree > 1)",
+        "sp_shape": "ring/ulysses need self-attention with batch, heads "
+                    "and seq divisible by their mesh degrees",
+        "sp_heads": "ulysses needs the per-device head count divisible "
+                    "by the seq axis (heads scatter over it)",
     }[reason]
+    kind = "dropout" if reason in ("kernel", "mesh", "backend", "seq") \
+        else "sequence parallelism"
     warnings.warn(
-        f"attention dropout on {op_name or 'a MHA op'} "
+        f"attention {kind} on {op_name or 'a MHA op'} "
         f"(FF_ATTENTION_IMPL={impl}) falls back to the dense path: "
         f"{detail}"
     )
@@ -132,14 +142,18 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
     kv_len = k_in.shape[1]
     h = params.num_heads
     use_dropout = params.dropout > 0.0 and ctx.training and ctx.rng is not None
-    seq_degree = data_degree = model_degree = 1
+    seq_degree = data_degree = model_degree = expert_degree = 1
     if ctx.mesh is not None:
         seq_degree = ctx.mesh.shape.get("seq", 1)
         data_degree = ctx.mesh.shape.get("data", 1)
         model_degree = ctx.mesh.shape.get("model", 1)
-    # Only the mesh axes that actually shard the score tensor's dims count:
-    # data (batch), model (heads), seq (query positions). Expert/pipe axes
-    # don't divide this op's footprint.
+        # under the expert merge (parallel/strategies.py assign_mesh_axes)
+        # the batch rides the RENAMED data axis, so a nontrivial expert
+        # axis must gate the device-local fast paths exactly like data
+        expert_degree = ctx.mesh.shape.get("expert", 1)
+    # Only the mesh axes that actually shard the score tensor's dims count
+    # toward the per-chip footprint: data (batch), model (heads), seq
+    # (query positions). The pipe axis doesn't divide this op's footprint.
     shard = ctx.n_devices
     if ctx.mesh is not None:
         shard = data_degree * model_degree * seq_degree
@@ -166,7 +180,7 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
         and impl in ("auto", "flash")
         and jax.default_backend() == "tpu"
         and flash_supported(seq_len, kv_len)
-        and data_degree * model_degree * seq_degree == 1
+        and data_degree * model_degree * seq_degree * expert_degree == 1
     )
     if use_dropout and not flash_dropout_ok:
         if impl in ("chunked", "ring", "ulysses"):
@@ -191,7 +205,8 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
             and jax.default_backend() == "tpu"
             and (not use_dropout or flash_dropout_ok)
             and flash_supported(seq_len, kv_len)
-            and data_degree * model_degree * seq_degree == 1):
+            and data_degree * model_degree * seq_degree * expert_degree
+            == 1):
         from ..kernels.attention import dropout_seeds, flash_attention_folded
 
         dqk, dv = params.qk_head_dim, params.v_head_dim
@@ -243,9 +258,12 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
     # fused kernel must run under shard_map over the batch/head axes (each
     # program is independent per (batch, head)); when the seq axis shards
     # the queries, the ring/ulysses paths own the problem instead.
-    mesh_nontrivial = data_degree * model_degree * seq_degree > 1
+    mesh_nontrivial = (
+        data_degree * model_degree * seq_degree * expert_degree > 1
+    )
     flash_shardable = (
         seq_degree == 1
+        and expert_degree == 1  # batch rides the expert axis when merged
         and b % data_degree == 0
         and h % model_degree == 0
     )
@@ -289,13 +307,16 @@ def _forward(params: MultiHeadAttentionParams, weights, inputs, ctx):
     use_ring = sp_shardable and impl in ("auto", "ring")
     if impl in ("ring", "ulysses") and not (use_ring or use_ulysses) \
             and not use_dropout:
-        warnings.warn(
-            f"FF_ATTENTION_IMPL={impl} ignored: needs a seq-sharded mesh "
-            "(sequence_parallel_degree > 1), self-attention with "
-            "batch/heads/seq divisible by their mesh degrees"
-            + (" and heads divisible by the seq axis" if impl == "ulysses"
-               else "")
-        )
+        # same dedup + ff_attention_fallback_total{reason} accounting as
+        # the dropout fallbacks: warn once per (impl, layer, reason),
+        # count every traced occurrence
+        if seq_degree <= 1:
+            reason = "sp_mesh"
+        elif impl == "ulysses" and sp_shardable:
+            reason = "sp_heads"
+        else:
+            reason = "sp_shape"
+        _dropout_fallback(impl, ctx.op_name, reason)
     if use_ring or use_ulysses:
         import functools
 

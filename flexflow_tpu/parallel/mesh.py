@@ -105,7 +105,9 @@ def pspec_for_parallel_tensor(pt, mesh: Mesh) -> PartitionSpec:
     AND fsdp axes jointly (the fsdp group IS a subdivision of the
     data-parallel workers), so a "data"-assigned dim whose degree equals
     data_size x fsdp_size lowers to the tuple ("data", "fsdp") — the
-    SpecLayout convention (parallel/weight_sharding.py)."""
+    SpecLayout convention (parallel/weight_sharding.py). The same rule
+    covers the "expert" axis, which is the data axis renamed by the
+    expert merge (parallel/strategies.py assign_mesh_axes)."""
     names = mesh.axis_names
     sizes = dict(zip(names, mesh.devices.shape))
     spec = []
@@ -122,10 +124,11 @@ def pspec_for_parallel_tensor(pt, mesh: Mesh) -> PartitionSpec:
             # lowering of the strategy
             name = names[d.parallel_idx]
             entry = name
-            if (name == "data" and "fsdp" in names and "fsdp" not in used
-                    and d.degree != sizes["data"]
-                    and d.degree == sizes["data"] * sizes.get("fsdp", 1)):
-                entry = ("data", "fsdp")
+            if (name in ("data", "expert") and "fsdp" in names
+                    and "fsdp" not in used
+                    and d.degree != sizes[name]
+                    and d.degree == sizes[name] * sizes.get("fsdp", 1)):
+                entry = (name, "fsdp")
                 used.add("fsdp")
             used.add(name)
             spec.append(entry)

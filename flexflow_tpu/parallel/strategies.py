@@ -11,6 +11,7 @@ as built for the run).
 """
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, Optional
 
 from ..ff_types import OperatorType
@@ -45,7 +46,11 @@ def assign_mesh_axes(graph: Graph, max_devices: int) -> Dict[str, int]:
     The reference executes heterogeneous per-op MachineViews via Legion task
     placement; under one SPMD program we map degrees onto named mesh axes:
     sample-dim degrees -> "data", channel/head/weight degrees -> "model",
-    WeightShard-targeted weight degrees -> "fsdp". A dim whose degree
+    WeightShard-targeted weight degrees -> "fsdp", axis_tag-carrying
+    degrees (expert/seq substitution generators) -> their named axis,
+    with the expert axis absorbing the data axis when their degrees
+    match (the dispatch all-to-all reshards within the same device
+    group). A dim whose degree
     doesn't equal its axis size can't shard evenly under NamedSharding and
     is demoted to replicated (round-1 lowering limit; the reference's
     fully heterogeneous placements would need per-segment programs).
@@ -71,19 +76,26 @@ def assign_mesh_axes(graph: Graph, max_devices: int) -> Dict[str, int]:
     fsdp_deg = fsdp_degree_of(graph)
     fsdp_weights = sharded_weight_records(graph) if fsdp_deg > 1 else {}
     data_deg, model_deg = 1, 1
+    expert_deg, seq_deg = 1, 1
     tensors = list(graph.input_tensors())
     for op in graph.ops:
         tensors.extend(op.outputs)
         tensors.extend(op.weights)
     # classify: activation dim0 = data; fsdp-targeted weight dims = fsdp;
-    # everything else = model
+    # axis_tag-carrying dims (the expert/seq substitution generators) =
+    # their named axis; everything else = model
     weight_guids = {w.guid for op in graph.ops for w in op.weights}
     for t in tensors:
         is_weight = t.guid in weight_guids
         for i, d in enumerate(t.dims):
             if d.degree <= 1 or d.is_replica_dim:
                 continue
-            if i == 0 and not is_weight:
+            tag = getattr(d, "axis_tag", None)
+            if tag == "expert":
+                expert_deg = max(expert_deg, d.degree)
+            elif tag == "seq":
+                seq_deg = max(seq_deg, d.degree)
+            elif i == 0 and not is_weight:
                 data_deg = max(data_deg, d.degree)
             elif is_weight and t.guid in fsdp_weights \
                     and d.degree == fsdp_deg:
@@ -91,23 +103,42 @@ def assign_mesh_axes(graph: Graph, max_devices: int) -> Dict[str, int]:
             else:
                 model_deg = max(model_deg, d.degree)
 
-    def devices_needed(dd: int, fd: int) -> int:
+    def devices_needed(dd: int, fd: int, ed: int) -> int:
         # fsdp rides the data workers when it divides the batch degree
-        # (ZeRO); otherwise it's an extra device factor
+        # (ZeRO); otherwise it's an extra device factor. The expert axis
+        # absorbs the data axis when their degrees match (the dispatch
+        # all-to-all reshards within the same device group — merge rule
+        # below); otherwise it is its own orthogonal factor, like seq.
+        e = 1 if ed == dd else ed
         if fd > 1 and dd % fd == 0:
-            return dd * model_deg * pipe_deg
-        return dd * fd * model_deg * pipe_deg
+            return dd * e * model_deg * pipe_deg * seq_deg
+        return dd * fd * e * model_deg * pipe_deg * seq_deg
 
-    # shrink data, then model, then drop fsdp, before sacrificing the
-    # user's requested pipeline degree; dropping pipe is last resort
-    while devices_needed(data_deg, fsdp_deg) > max_devices and data_deg > 1:
-        data_deg //= 2
-    while devices_needed(data_deg, fsdp_deg) > max_devices and model_deg > 1:
+    # shrink data, then model, then seq, then drop fsdp, then expert,
+    # before sacrificing the user's requested pipeline degree; pipe is
+    # last. Exception: while the expert dispatch rides the data axis
+    # (equal degrees — the all-to-all NEEDS its input batch-sharded at
+    # the expert degree), shrink model first so the pair survives.
+    while devices_needed(data_deg, fsdp_deg, expert_deg) > max_devices \
+            and model_deg > 1 and expert_deg > 1 and expert_deg == data_deg:
         model_deg //= 2
-    if devices_needed(data_deg, fsdp_deg) > max_devices and fsdp_deg > 1:
+    while devices_needed(data_deg, fsdp_deg, expert_deg) > max_devices \
+            and data_deg > 1:
+        data_deg //= 2
+    while devices_needed(data_deg, fsdp_deg, expert_deg) > max_devices \
+            and model_deg > 1:
+        model_deg //= 2
+    while devices_needed(data_deg, fsdp_deg, expert_deg) > max_devices \
+            and seq_deg > 1:
+        seq_deg //= 2
+    if devices_needed(data_deg, fsdp_deg, expert_deg) > max_devices \
+            and fsdp_deg > 1:
         fsdp_deg = 1  # weight dims demote to replicated below
         fsdp_weights = {}
-    if devices_needed(data_deg, fsdp_deg) > max_devices:
+    if devices_needed(data_deg, fsdp_deg, expert_deg) > max_devices \
+            and expert_deg > 1:
+        expert_deg = 1  # expert dims demote to replicated below
+    if devices_needed(data_deg, fsdp_deg, expert_deg) > max_devices:
         from .. import obs
 
         obs.progress(
@@ -118,9 +149,63 @@ def assign_mesh_axes(graph: Graph, max_devices: int) -> Dict[str, int]:
             requested=pipe_deg, devices=max_devices,
         )
         pipe_deg = 1  # ops degrade to the sequential scan path, still correct
+    # WeightShard reconciliation: the fsdp axis carries ONE degree
+    # (fsdp_degree_of: largest wins), so nodes at any other degree —
+    # mixed-degree winners — and every node once the ladder dropped fsdp
+    # would come out of the demotion below inert (declared shard degree
+    # with no sharded weight dims: FFA207). Back them out the way the
+    # fsdp_unshard_weights substitution does: restore the target's
+    # replicated weights and splice the identity node out of the graph.
+    stale_ws = [op for op in graph.ops
+                if op.op_type == OperatorType.OP_WEIGHT_SHARD
+                and (fsdp_deg == 1 or op.params.shard_degree != fsdp_deg)]
+    if stale_ws:
+        from .weight_sharding import unshard_op_weights, weight_shard_target
+
+        drop = {op.guid for op in stale_ws}
+        for ws in stale_ws:
+            target = weight_shard_target(ws)
+            if target is not None:
+                unshard_op_weights(target)
+            out_t, in_t = ws.outputs[0], ws.inputs[0]
+            for o in graph.ops:
+                for i, t in enumerate(o.inputs):
+                    if t.guid == out_t.guid:
+                        o.inputs[i] = in_t
+        graph.ops = [o for o in graph.ops if o.guid not in drop]
+        graph._producer_cache = None
+        fsdp_weights = {g: r for g, r in fsdp_weights.items()
+                        if r[0].guid not in drop}
     joint = fsdp_deg > 1 and data_deg % fsdp_deg == 0
+    # Expert axis: the expert-parallel substitutions (search/
+    # substitution.py partition_experts_alltoall) either compose with
+    # partition_batch at the SAME degree — the all-to-all reshards the
+    # batch-sharded tokens within the data device group, so the expert
+    # axis absorbs the data axis (same devices, renamed) — or run with
+    # the batch unsharded, where expert is its own device factor like
+    # seq. Under joint fsdp the merge still holds — the fsdp group is a
+    # subdivision of the same workers, so the expert axis takes the
+    # CARVED size and expert/batch dims lower to the ("expert", "fsdp")
+    # tuple (pspec_for_parallel_tensor), exactly the ZeRO batch rule
+    # with the data axis renamed.
+    merge_expert = expert_deg > 1 and expert_deg == data_deg \
+        and (fsdp_deg == 1 or joint)
+    solo_expert = expert_deg > 1 and expert_deg != data_deg
     axes = {"data": data_deg // fsdp_deg if joint else data_deg,
             "model": model_deg}
+    data_idx, expert_idx = 0, None
+    if merge_expert:
+        axes["expert"] = axes["data"]  # carved size under joint fsdp
+        axes["data"] = 1
+        expert_idx = len(axes) - 1
+        data_idx = expert_idx  # batch dims ride the renamed axis
+    elif solo_expert:
+        axes["expert"] = expert_deg
+        expert_idx = len(axes) - 1
+    seq_idx = None
+    if seq_deg > 1:
+        axes["seq"] = seq_deg
+        seq_idx = len(axes) - 1
     fsdp_idx = None
     if fsdp_deg > 1:
         axes["fsdp"] = fsdp_deg
@@ -133,9 +218,20 @@ def assign_mesh_axes(graph: Graph, max_devices: int) -> Dict[str, int]:
             if d.is_replica_dim:
                 d.parallel_idx = -1
                 continue
-            if i == 0 and not is_weight:
+            tag = getattr(d, "axis_tag", None)
+            if tag == "expert":
+                if expert_idx is not None and d.degree == expert_deg:
+                    d.parallel_idx = expert_idx
+                else:
+                    d.degree, d.parallel_idx = 1, -1
+            elif tag == "seq":
+                if seq_idx is not None and d.degree == seq_deg:
+                    d.parallel_idx = seq_idx
+                else:
+                    d.degree, d.parallel_idx = 1, -1
+            elif i == 0 and not is_weight:
                 if d.degree == data_deg and data_deg > 1:
-                    d.parallel_idx = 0
+                    d.parallel_idx = data_idx
                 else:
                     d.degree, d.parallel_idx = 1, -1
             elif is_weight and fsdp_idx is not None \
@@ -146,6 +242,18 @@ def assign_mesh_axes(graph: Graph, max_devices: int) -> Dict[str, int]:
                     d.parallel_idx = 1
                 else:
                     d.degree, d.parallel_idx = 1, -1
+    # demotion reconciliation: an AllToAll whose scatter dim was demoted
+    # above must not keep declaring the searched exchange degree — the
+    # strategy validators (FFA104/FFA505) compare params against dims,
+    # and a degree-1 exchange lowers to the identity reshard
+    for op in graph.ops:
+        if op.op_type != OperatorType.OP_ALL_TO_ALL or not op.outputs:
+            continue
+        p = op.params
+        if 0 <= p.scatter_dim < len(op.outputs[0].dims):
+            actual = op.outputs[0].dims[p.scatter_dim].degree
+            if actual != p.degree:
+                op.params = dataclasses.replace(p, degree=actual)
     if pipe_deg > 1:
         axes["pipe"] = pipe_deg
         apply_pipeline_parallel(graph, pipe_deg, axis_idx=len(axes) - 1)

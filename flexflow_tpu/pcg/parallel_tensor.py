@@ -30,12 +30,17 @@ class ParallelDim:
     degree: #shards the dim is split into.
     parallel_idx: index into the machine-view/mesh axes (-1 = not parallelized).
     is_replica_dim: the dim exists only to index replicas (size == degree).
+    axis_tag: optional mesh-axis hint ("expert"/"seq") set by substitution
+        generators; assign_mesh_axes routes tagged degrees onto the named
+        axis. Deliberately NOT part of key(): the tag never changes the
+        numeric sharding, so cost caches and graph hashes ignore it.
     """
 
     size: int = 0
     degree: int = 1
     parallel_idx: int = -1
     is_replica_dim: bool = False
+    axis_tag: Optional[str] = None
 
     UNKNOWN_DEGREE = -1
     UNKNOWN_INDEX = -2

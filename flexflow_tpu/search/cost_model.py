@@ -129,7 +129,14 @@ def op_padded_flops(op: PCGOp, parts: int = 1) -> float:
     t = op.op_type
     if t == OperatorType.OP_LINEAR and op.inputs and op.outputs:
         si = _shard_shape(op.inputs[0])
-        so = _shard_shape(op.outputs[0])
+        # replica dims are dropped from the OUTPUT: a partial-sum output
+        # (row-parallel linear, contraction sharded) marks its pending
+        # reduction with a replica dim, but each device only computes its
+        # contraction slice — the /degree is already in si[-1]. Truly
+        # duplicated compute (replicated input) shows up as an UNSHARDED
+        # si[-1], so dropping the dim never under-prices replication.
+        so = [x for x, d in zip(_shard_shape(op.outputs[0]),
+                                op.outputs[0].dims) if not d.is_replica_dim]
         return 2.0 * _pad(_vol(so[:-1]), MXU_SUBLANES) * _pad(si[-1], MXU_LANES) * _pad(so[-1], MXU_LANES)
     if t == OperatorType.OP_CONV2D and op.inputs and op.outputs:
         si = _shard_shape(op.inputs[0])   # (N, Cin, H, W) shard
@@ -444,6 +451,25 @@ class CostModel:
             if ratio is None:
                 ratio = 2.0 if op.weights else 1.0
             bwd = ratio * fwd
+        # Ring-attention ICI rotation (Liu et al., Ring Attention): a
+        # seq-sharded attention op keeps K/V resident and rotates each
+        # shard around the seq ring — (sd-1) steps of kv_bytes/sd each,
+        # i.e. kv_bytes*(sd-1)/sd total wire time, which is EXACTLY the
+        # all_to_all_cost formula; routing it through the machine model
+        # means the hierarchical slice-crossing override prices rings
+        # that straddle slices too (search/network.py). Backward rotates
+        # twice (the dK/dV accumulation makes a second pass).
+        if op.op_type == OperatorType.OP_MULTIHEAD_ATTENTION \
+                and op.outputs and len(op.outputs[0].dims) == 3 \
+                and op.outputs[0].dims[1].degree > 1 and len(op.inputs) >= 2:
+            sd = op.outputs[0].dims[1].degree
+            group = view.device_ids()[:sd]
+            if len(group) >= 2:
+                kv_bytes = 2 * _vol(op.inputs[1].material_shape()) \
+                    * op.inputs[1].data_type.size
+                rot = self.machine.all_to_all_cost(kv_bytes, group)
+                fwd += rot
+                bwd += 2 * rot
         # weight gradient sync (reference: NCCL allreduce per weight per
         # view, optimizer.cc nccl_update_task). Per weight: a sharded
         # weight only syncs across its REPLICAS — each device owns

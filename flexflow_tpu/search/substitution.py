@@ -361,6 +361,157 @@ def partition_seq_allgather(degree: int) -> Substitution:
     return Substitution(f"partition_seq_allgather_{degree}", apply)
 
 
+def partition_seq_ring(degree: int) -> Substitution:
+    """Sequence/context parallelism INCLUDING attention: shard the seq dim
+    of every 3-D activation — attention too — and tag it "seq" so
+    assign_mesh_axes lowers it onto a dedicated mesh axis. Attention with
+    a seq-sharded mesh takes the ring/ulysses path in ops/attention.py
+    (K/V stay resident, shards rotate over ICI) instead of the allgather
+    the MHA-skipping partition_seq_allgather forces. Only offered when
+    every attention op is self-attention with a divisible seq dim — ring
+    needs kv_len == seq_len and even shards (Liu et al., Ring
+    Attention)."""
+
+    def apply(graph: Graph) -> Iterator[Graph]:
+        for op in _find_ops(graph, OperatorType.OP_MULTIHEAD_ATTENTION):
+            q, k, v = op.inputs[:3]
+            if not (q.guid == k.guid == v.guid):
+                return  # cross-attention somewhere: ring can't lower it
+            if len(q.dims) != 3 or q.dims[1].size % degree != 0:
+                return
+        has_seq = any(
+            op.outputs and len(op.outputs[0].dims) == 3
+            and op.outputs[0].dims[1].degree == 1
+            and op.outputs[0].dims[1].size % degree == 0
+            for op in graph.ops
+            if not op.is_parallel_op
+        )
+        if not has_seq:
+            return
+        g2, _ = copy_graph(graph)
+        for t in g2.input_tensors():
+            if len(t.dims) == 3 and t.dims[1].degree == 1 \
+                    and t.dims[1].size % degree == 0:
+                t.dims[1].degree = degree
+                t.dims[1].axis_tag = "seq"
+        for op in g2.ops:
+            if op.is_parallel_op:
+                continue
+            for t in op.outputs:
+                if len(t.dims) == 3 and t.dims[1].degree == 1 \
+                        and t.dims[1].size % degree == 0:
+                    t.dims[1].degree = degree
+                    t.dims[1].axis_tag = "seq"
+        yield g2
+
+    return Substitution(f"partition_seq_ring_{degree}", apply)
+
+
+def partition_experts_alltoall(degree: int) -> Substitution:
+    """Expert parallelism for MoE blocks (GShard-style, Lepikhin et al.):
+    one OP_ALL_TO_ALL dispatches the batch-sharded token tensor into a
+    hidden-sharded layout over the "expert" mesh axis, group_by's dispatch
+    einsum and EVERY expert FFN then run on hidden shards (row-parallel
+    experts), and the per-expert Reduction nodes combine the partial
+    activations. Composes with partition_batch at the same degree — the
+    expert axis reshards the SAME device group that shards the batch
+    (assign_mesh_axes merges the two axes).
+
+    Why this beats per-expert reduce_linear_partition: ONE all-to-all of
+    the token tensor (T*d bytes) feeds all n experts, instead of n
+    Repartitions moving alpha*k*T*d bytes total — and the expert weights
+    end up degree-sharded, so their gradients need no replica sync. It is
+    also the only rewrite that shards the expert block at all when the
+    capacity dim (ceil(alpha*k/n*T), ops/moe.py) doesn't divide the mesh
+    — the shape where pure data parallelism leaves group_by and every
+    expert dense at full per-device flops."""
+
+    def apply(graph: Graph) -> Iterator[Graph]:
+        from ..parallel.parallel_ops import AllToAllParams
+
+        if degree < 2:
+            return
+        for op in _find_ops(graph, OperatorType.OP_GROUP_BY):
+            in_t = op.inputs[0]  # (tokens, hidden)
+            if len(in_t.dims) != 2:
+                continue
+            if in_t.dims[0].degree != degree or in_t.dims[0].is_replica_dim:
+                continue  # compose after partition_batch at this degree
+            if in_t.dims[1].degree != 1 or in_t.dims[1].size % degree != 0:
+                continue
+            if any(d.degree > 1 for t in op.outputs for d in t.dims):
+                continue
+            experts = []
+            ok = True
+            for t in op.outputs:
+                for c, slot in _consumers(graph, t):
+                    if c.op_type != OperatorType.OP_LINEAR or slot != 0:
+                        ok = False
+                        break
+                    if any(d.degree > 1 for w in c.weights for d in w.dims):
+                        ok = False  # FSDP/TP owns these shards
+                        break
+                    if c.inputs[0].dims[-1].size % degree != 0:
+                        ok = False
+                        break
+                    experts.append(c)
+                if not ok:
+                    break
+            if not ok or not experts:
+                continue
+            g2, _ = copy_graph(graph)
+            op2 = next(o for o in g2.ops if o.layer_guid == op.layer_guid
+                       and o.name == op.name)
+            in2 = op2.inputs[0]
+            # dispatch: gather the token dim, scatter the hidden dim
+            a2a_dims = [dataclasses.replace(d) for d in in2.dims]
+            a2a_dims[0].degree = 1
+            a2a_dims[1].degree = degree
+            a2a_dims[1].axis_tag = "expert"
+            a2a = _make_parallel_op(
+                OperatorType.OP_ALL_TO_ALL,
+                AllToAllParams(scatter_dim=1, gather_dim=0, degree=degree),
+                in2,
+                a2a_dims,
+            )
+            # before op2 only — the gate dense keeps the batch-sharded view
+            g2.add_op(a2a)
+            op2.inputs[0] = a2a.outputs[0]
+            # the dispatch einsum preserves the hidden sharding: every
+            # expert slab comes out (capacity, hidden/degree)
+            for t in op2.outputs:
+                t.dims[-1].degree = degree
+                t.dims[-1].axis_tag = "expert"
+            # each expert FFN goes row-parallel over the expert axis; its
+            # partial output is combined by a Reduction (the combine leg
+            # of the dispatch/combine pair, fused per expert)
+            for c in experts:
+                c2 = next(o for o in g2.ops if o.layer_guid == c.layer_guid
+                          and o.name == c.name)
+                for w, tags in zip(c2.weights, c2.weight_tags):
+                    for i, tag in enumerate(tags):
+                        if tag == "in_channel" and w.dims[i].size % degree == 0:
+                            w.dims[i].degree = degree
+                            w.dims[i].axis_tag = "expert"
+                out = c2.outputs[0]
+                partial_dims = [ParallelDim(size=degree, degree=degree,
+                                            is_replica_dim=True)]
+                partial_dims += [dataclasses.replace(d) for d in out.dims]
+                out.dims = partial_dims
+                red_dims = [dataclasses.replace(d) for d in out.dims[1:]]
+                red = _make_parallel_op(
+                    OperatorType.OP_REDUCTION,
+                    ReductionParams(reduction_dim=0, reduction_degree=degree),
+                    out,
+                    red_dims,
+                )
+                _insert_after(g2, out, red)
+            if g2.check_correctness():
+                yield g2
+
+    return Substitution(f"partition_experts_alltoall_{degree}", apply)
+
+
 def fsdp_shard_weights(degree: int) -> Substitution:
     """FSDP/ZeRO weight sharding per layer (parallel/weight_sharding.py;
     SNIPPETS [2]'s fsdp mesh axis, ZeRO SC'20 — no reference equivalent:
@@ -591,8 +742,10 @@ def generate_all_pcg_xfers(degrees: List[int], config=None) -> List[Substitution
         xfers.append(partition_embedding_combine(d))
         xfers.append(fsdp_shard_weights(d))
         xfers.append(fsdp_zero_shard(d))
+        xfers.append(partition_experts_alltoall(d))
         if config is None or getattr(config, "enable_sequence_parallel", False):
             xfers.append(partition_seq_allgather(d))
+            xfers.append(partition_seq_ring(d))
     return xfers
 
 
@@ -651,6 +804,19 @@ class GraphSearchHelper:
                     if not cand.check_correctness():
                         continue
                     r = self.search.graph_cost(cand, res)
+                    if r.cost <= best_result.cost * self.alpha:
+                        # competitive candidate: vet degree consistency
+                        # BEFORE it can become the winner — composed
+                        # rewrites can produce graphs that price well but
+                        # fail the post-search structural validation,
+                        # which would demote the whole strategy to
+                        # replicated (core/model.py fallback)
+                        from ..analysis.structure import (
+                            structural_diagnostics,
+                        )
+
+                        if structural_diagnostics(cand).errors:
+                            continue
                     improved = r.cost < best_result.cost
                     if improved:
                         best_graph, best_result = cand, r

@@ -68,6 +68,12 @@ _TYPE_MAP = {
     "OP_ALLTOALL": OperatorType.OP_ALL_TO_ALL,
     "OP_ALL_TO_ALL": OperatorType.OP_ALL_TO_ALL,
     "OP_WEIGHT_SHARD": OperatorType.OP_WEIGHT_SHARD,
+    # MoE routing ops (workload zoo: expert-parallel rewrite rules)
+    "OP_GROUP_BY": OperatorType.OP_GROUP_BY,
+    "OP_GROUPBY": OperatorType.OP_GROUP_BY,
+    "OP_AGGREGATE": OperatorType.OP_AGGREGATE,
+    "OP_TOPK": OperatorType.OP_TOPK,
+    "OP_TOP_K": OperatorType.OP_TOPK,
 }
 
 _PARALLEL_TYPES = {
@@ -234,6 +240,27 @@ def default_rules_path() -> str:
                         "graph_subst_tpu_v1.json")
 
 
+def zoo_rules_path() -> str:
+    """Workload-zoo expert-routing rules (docs/models.md): loaded
+    alongside the default collection. The capacity-factor rewrite
+    (moe_capacity_v1.json, same directory) is NOT loaded by default —
+    it changes numerics (token dropping) and must be opted into via
+    --substitution-json."""
+    import os
+
+    return os.path.join(os.path.dirname(__file__), "substitutions",
+                        "graph_subst_zoo_v1.json")
+
+
+def moe_capacity_rules_path() -> str:
+    """The opt-in capacity-factor rewrite collection (token-dropping <->
+    dropless). Not loaded by default — see zoo_rules_path."""
+    import os
+
+    return os.path.join(os.path.dirname(__file__), "substitutions",
+                        "moe_capacity_v1.json")
+
+
 # ---------------------------------------------------------------------------
 # rule application
 # ---------------------------------------------------------------------------
@@ -280,6 +307,14 @@ def _op_matches(op: PCGOp, pat: OpPattern) -> bool:
         # free (AC_MODE_NONE) — and never match an op lacking the field
         cur = getattr(op.params, "activation", None)
         if cur is None or int(cur) != acti:
+            return False
+    capx = pat.params.get("PM_CAPACITY_FACTOR_X100")
+    if capx is not None:
+        # capacity-factor rewrite guard (token-dropping <-> dropless):
+        # pin the src group_by to one declared alpha so the rewrite and
+        # its inverse don't ping-pong on the same site
+        alpha = getattr(op.params, "alpha", None)
+        if alpha is None or round(alpha * 100) != capx:
             return False
     return True
 
@@ -488,6 +523,14 @@ def apply_rule(graph: Graph, rule: Rule) -> Iterator[Graph]:
                     # the producer's fused-activation slot
                     params = dataclasses.replace(
                         params, activation=ActiMode(acti))
+                capx = dpat.params.get("PM_CAPACITY_FACTOR_X100")
+                if capx is not None and \
+                        dpat.op_type == OperatorType.OP_GROUP_BY:
+                    # capacity-factor rewrite: the dst dispatch re-declares
+                    # alpha (int x100 — the wire format is integer-only);
+                    # output shape inference below re-derives the capacity
+                    params = dataclasses.replace(params,
+                                                 alpha=capx / 100.0)
                 nop = PCGOp(dpat.op_type, params, ins)
                 # infer output shape
                 outs = _infer_outputs(nop, src_params_op)
@@ -672,6 +715,23 @@ def _infer_outputs(op: PCGOp, src_op: Optional[PCGOp]) -> List[ParallelTensor]:
                     out.dims[i].degree = a.dims[i].degree
             out.dims[-1].degree = b.dims[-1].degree
         elif t == OperatorType.OP_LINEAR and ins:
+            for i in range(len(out.dims) - 1):
+                if i < len(ins[0].dims):
+                    out.dims[i].degree = ins[0].dims[i].degree
+        elif t == OperatorType.OP_GROUP_BY and ins:
+            # expert dispatch: the fresh capacity dim is unsharded (it is
+            # not the token dim — the rank-preserving default below would
+            # wrongly carry the token degree onto it); the hidden dim
+            # follows the token input
+            if len(out.dims) >= 2 and len(ins[0].dims) >= 2:
+                out.dims[-1].degree = ins[0].dims[-1].degree
+        elif t == OperatorType.OP_AGGREGATE and len(ins) >= 5:
+            # expert combine: token dim follows the gate input, hidden dim
+            # follows the expert tensors; the capacity dim disappears
+            out.dims[0].degree = ins[0].dims[0].degree
+            out.dims[-1].degree = ins[4].dims[-1].degree
+        elif t == OperatorType.OP_TOPK and ins:
+            # the fresh k dim stays unsharded; token dims follow the input
             for i in range(len(out.dims) - 1):
                 if i < len(ins[0].dims):
                     out.dims[i].degree = ins[0].dims[i].degree

@@ -63,15 +63,19 @@ def load_measurement(src):
 
 
 def load_baseline(metric):
+    """Published baseline for EXACTLY this metric. A new series (the zoo
+    workloads: moe_train_throughput, longctx_train_throughput) has no
+    published number until the driver records one — the caller treats
+    that as warn-only and skips the headline gate, instead of comparing
+    a zoo workload against the transformer baseline."""
     try:
         with open(os.path.join(REPO, "BASELINE.json")) as f:
             published = json.load(f).get("published", {}) or {}
     except (OSError, ValueError):
         return None
-    for key in (metric, "transformer_train_throughput"):
-        v = published.get(key)
-        if isinstance(v, (int, float)) and v > 0:
-            return float(v)
+    v = published.get(metric)
+    if isinstance(v, (int, float)) and v > 0:
+        return float(v)
     return None
 
 
@@ -144,8 +148,11 @@ def main(argv=None):
     # ---- headline gate: throughput vs the published baseline ----------
     baseline = load_baseline(metric)
     if baseline is None:
-        print(f"bench_regression: BASELINE.json has no published value for "
-              f"{metric}; skipping the headline gate")
+        # absent series are warn-only, never a failure: annotate so the
+        # missing baseline is visible in the Actions summary and move on
+        print(f"::warning title=bench baseline::BASELINE.json has no "
+              f"published value for {metric}; headline gate skipped "
+              "(new series stay warn-only until a baseline is recorded)")
     else:
         ratio = value / baseline
         line = (f"bench_regression: {metric} = {value:.3f} vs baseline "

@@ -1,0 +1,116 @@
+#!/usr/bin/env bash
+# Workload-zoo gate (ISSUE 14): the searched MoE + 32k long-context
+# flagships as first-class CI citizens (docs/models.md), hardware-free.
+#
+# Leg 1 runs tests/test_workload_zoo.py on the tier-1-shaped 8-device
+# CPU mesh — including the slow cases: search beats pure data parallel,
+# verify_strategy matches the serial lowering, the expert dispatch
+# exports nonzero ff_pcg_collective_bytes{kind="all_to_all"}. Leg 2
+# re-runs the FULL static pass stack (analysis.analyze_graph) over both
+# searched strategies and fails on any ERROR diagnostic. Leg 3 repeats
+# search + verify + analyzer on a 4-device mesh (the degree ladder must
+# adapt, not break). Leg 4 lints the shipped expert-routing rule
+# collections with the FFA4xx substitution lint. Use before touching
+# models/zoo.py, search/substitution.py's expert/seq generators,
+# parallel/strategies.py's expert lowering, or the zoo JSON rules:
+#
+#   scripts/zoo_check.sh             # all legs
+#   scripts/zoo_check.sh -k moe      # filter leg 1's pytest
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+run_on() {
+    local devs="$1"
+    shift
+    env JAX_PLATFORMS=cpu \
+        JAX_NUM_CPU_DEVICES="$devs" \
+        XLA_FLAGS="--xla_force_host_platform_device_count=$devs" \
+        "$@"
+}
+
+echo "=== zoo leg 1: workload suite incl. search+verify (8 devices) ==="
+run_on 8 python -m pytest tests/test_workload_zoo.py -v \
+    -p no:cacheprovider "$@"
+
+sweep() {
+    # search + static pass stack (+ optional verify) over both flagships
+    # on the live mesh; ZOO_VERIFY=1 adds the differential replay
+    run_on "$1" python - <<'PY'
+import os
+
+import jax
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.analysis import analyze_graph
+from flexflow_tpu.models import (
+    build_long_context_transformer,
+    build_moe_transformer,
+)
+from flexflow_tpu.runtime.verify import verify_strategy
+
+ndev = len(jax.devices())
+verify = os.environ.get("ZOO_VERIFY") == "1"
+rng = np.random.RandomState(0)
+
+def check(name, build, batch, data):
+    cfg = FFConfig()
+    cfg.batch_size = batch
+    cfg.search_budget = 24
+    m = FFModel(cfg)
+    build(m)
+    m.compile(SGDOptimizer(lr=0.05),
+              loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              metrics=[])
+    cm = m._build_cost_model()
+    rep = analyze_graph(
+        m.graph, views=getattr(m, "searched_views", None),
+        num_devices=ndev, hbm_bytes=cm.machine.chip.hbm_capacity,
+        optimizer=m.optimizer, train=m._is_training_compile(),
+        grad_bytes_ratio=m._grad_bytes_ratio(), cost_model=cm,
+        executor=m.executor,
+    )
+    assert not rep.errors, (name, [str(d) for d in rep.errors])
+    print(f"{name}: analyzer clean on {ndev} devices "
+          f"({len(rep.warnings)} warning(s)), "
+          f"searched cost {m.searched_cost:.4f}s")
+    if verify:
+        v = verify_strategy(m, data, steps=3)
+        assert v.ok and not v.validator_problems, (name, v)
+        print(f"{name}: verify_strategy ok on {ndev} devices")
+
+check(
+    "moe_transformer",
+    lambda m: build_moe_transformer(
+        m, batch_size=16, seq_length=64, hidden_size=768, num_heads=4,
+        num_layers=2, num_experts=4, top_k=2, capacity_factor=1.2,
+        lambda_bal=0.04),
+    16,
+    (rng.randn(16, 64, 768).astype(np.float32),
+     rng.randint(0, 10, (16, 64, 1)).astype(np.int32)),
+)
+check(
+    "long_context_transformer",
+    lambda m: build_long_context_transformer(
+        m, batch_size=4, seq_length=512, hidden_size=64, num_heads=8,
+        num_layers=2),
+    4,
+    (rng.randn(4, 512, 64).astype(np.float32),
+     rng.randint(0, 10, (4, 512, 1)).astype(np.int32)),
+)
+PY
+}
+
+echo "=== zoo leg 2: static pass stack over searched strategies (8 devices) ==="
+sweep 8
+
+echo "=== zoo leg 3: search + verify + analyzer on the 4-device mesh ==="
+ZOO_VERIFY=1 sweep 4
+
+echo "=== zoo leg 4: FFA4xx lint of the shipped expert-routing rules ==="
+python -m flexflow_tpu.analysis rules \
+    flexflow_tpu/search/substitutions/graph_subst_zoo_v1.json \
+    flexflow_tpu/search/substitutions/moe_capacity_v1.json \
+    --fail-on error
+
+echo "zoo_check: all legs passed"
