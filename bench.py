@@ -136,6 +136,106 @@ def phase_breakdown(model, x, y, key, *, repeats: int, fetch):
     }
 
 
+def decode_bench():
+    """FF_BENCH_WORKLOAD=decode: serving throughput, not training.
+
+    Builds a CPU-sized decoder-only LM, searches BOTH strategies
+    (compile() with the training objective, compile_decode() with the
+    HBM-roofline decode objective) and drives the continuous-batching
+    loop end to end — admission, prefill, batched single-token decode —
+    counting generated tokens. The headline is tokens/s/chip; like the
+    zoo series the absolute number is a trend line, so the regression
+    gate treats it warn-only until the driver publishes a baseline."""
+    import jax
+
+    from flexflow_tpu import (
+        ActiMode,
+        AggrMode,
+        DataType,
+        FFConfig,
+        FFModel,
+        LossType,
+        MetricsType,
+        SGDOptimizer,
+    )
+    from flexflow_tpu.runtime.serving import (
+        AdmissionQueue,
+        ContinuousBatcher,
+        GenerationRequest,
+        ServingConfig,
+    )
+
+    smoke = bool(os.environ.get("FF_BENCH_SMOKE"))
+    vocab, hidden, heads, layers, max_len = 64, 64, 4, 2, 32
+    prompt_len = 4
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    cfg.search_budget = 1
+    model = FFModel(cfg)
+    ids = model.create_tensor((2, max_len), DataType.DT_INT32)
+    t = model.embedding(ids, vocab, hidden, AggrMode.AGGR_MODE_NONE)
+    for _ in range(layers):
+        t = model.multihead_attention(t, t, t, hidden, heads, causal=True)
+        t = model.dense(t, hidden, ActiMode.AC_MODE_RELU)
+    t = model.softmax(model.dense(t, vocab))
+    model.compile(SGDOptimizer(lr=0.01),
+                  LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [MetricsType.METRICS_ACCURACY])
+    model.compile_decode()
+
+    def run_round(n_req, new_tokens):
+        q = AdmissionQueue(max_depth=max(16, n_req))
+        b = ContinuousBatcher(
+            model,
+            ServingConfig(max_len=max_len, slots=4, page_size=8,
+                          precompile=False, default_deadline_s=600.0),
+            q,
+        ).start()
+        rng = np.random.RandomState(0)
+        try:
+            t0 = time.perf_counter()
+            reqs = []
+            for _ in range(n_req):
+                prompt = rng.randint(0, vocab, prompt_len).astype(np.int32)
+                r = GenerationRequest(prompt, new_tokens, deadline_s=600.0)
+                q.offer(r)
+                reqs.append(r)
+            toks = sum(len(r.result(timeout=600.0)) - prompt_len
+                       for r in reqs)
+            return toks, time.perf_counter() - t0, b.decode_strategy_active
+        finally:
+            b.stop()
+
+    n_req, new_tokens = (2, 4) if smoke else (16, 16)
+    run_round(n_req, new_tokens)  # warmup: jit-compiles prefill + step
+    toks, elapsed, active = run_round(n_req, new_tokens)
+
+    n_chips = max(1, len(jax.devices()))
+    tokens_per_sec_per_chip = toks / elapsed / n_chips
+    metric = "decode_tokens_throughput"
+    baseline, baseline_source = read_baseline(metric)
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(tokens_per_sec_per_chip, 3),
+                "unit": "tokens/s/chip",
+                "vs_baseline": (
+                    round(tokens_per_sec_per_chip / baseline, 3)
+                    if baseline else None
+                ),
+                "baseline": baseline,
+                "baseline_source": baseline_source,
+                "phases_s_per_step": None,
+                "decode_strategy_active": bool(active),
+                "n_chips": n_chips,
+                "backend": jax.default_backend(),
+                "jax_version": jax.__version__,
+            }
+        )
+    )
+
+
 def main():
     wait_for_backend()
     import jax
@@ -153,10 +253,14 @@ def main():
     #   transformer (default) — the reference's headline config
     #   moe                   — top-k gated expert FFN blocks (CPU-sized)
     #   longctx               — the encoder at long seq, small batch
+    #   decode                — continuous-batching serving loop under the
+    #                           decode-searched strategy (tokens/s/chip)
     # The zoo series sizes are CPU-scale smoke shapes: their value is the
     # per-workload trend line (and the regression gate treats series
     # without a published baseline as warn-only), not absolute numbers.
     workload = os.environ.get("FF_BENCH_WORKLOAD", "transformer")
+    if workload == "decode":
+        return decode_bench()
     cfg = FFConfig()
     cfg.allow_mixed_precision = True
     labels = None
@@ -204,7 +308,7 @@ def main():
     else:
         raise SystemExit(
             f"bench: FF_BENCH_WORKLOAD={workload!r} "
-            "(want transformer|moe|longctx)"
+            "(want transformer|moe|longctx|decode)"
         )
     model.compile(
         optimizer=SGDOptimizer(lr=0.01),

@@ -52,6 +52,13 @@ These passes audit the CHOSEN strategy before it executes:
     a sharded capacity dim with a non-dividing degree, or a declared
     config.expert_parallel_degree the strategy pass would silently skip
     (parallel/strategies.apply_expert_parallel's divisibility guard).
+  * FFA509 — decode-objective roofline lints (WARNING; only under
+    ``objective="decode"``): an attention op whose weight shard degree
+    exceeds its KV head count (the extra ways buy no HBM bandwidth),
+    or a per-token collective whose fixed ring latency exceeds the
+    decode-roofline compute of the op feeding it (the single-token
+    step is latency-bound, not HBM-bound) — fix_hint names the
+    cheaper degree in both cases.
 
 The FFA6xx family audits fault-domain ROBUSTNESS of the strategy on
 multi-slice machines (search/survivability.py; runtime counterpart in
@@ -108,6 +115,7 @@ def perf_diagnostics(
     num_devices: Optional[int] = None,
     executor=None,
     expert_degree: int = 1,
+    objective: str = "train",
 ) -> AnalysisReport:
     """Run the FFA5xx static performance passes over a placed strategy.
 
@@ -119,6 +127,9 @@ def perf_diagnostics(
     expert_degree: a declared config.expert_parallel_degree, audited
     against expert capacities for FFA508 even when the strategy pass
     skipped applying it.
+    objective: the cost objective the strategy was searched under
+    ("train" or "decode"); "decode" enables the FFA509 decode-roofline
+    lints (head over-sharding, latency-dominated per-token collectives).
     """
     rep = AnalysisReport()
     views = views or {}
@@ -126,7 +137,14 @@ def perf_diagnostics(
         machine = cost_model.machine
     if cost_model is not None:
         _oracle_provenance_diagnostic(cost_model, rep)
-        _overlap_discount_diagnostics(graph, views, cost_model, rep)
+        if objective != "decode":
+            # the overlap discount hides weight-grad collectives behind
+            # BACKWARD compute; decode has no backward pass to hide
+            # anything behind, so the soundness audit does not apply
+            _overlap_discount_diagnostics(graph, views, cost_model, rep)
+    if objective == "decode":
+        _decode_objective_diagnostics(graph, views, cost_model, machine,
+                                      rep)
     _padding_roofline_diagnostics(graph, views, machine, rep)
     _expert_capacity_diagnostics(graph, rep,
                                  expert_degree=expert_degree)
@@ -248,6 +266,101 @@ def _overlap_discount_diagnostics(graph, views, cost_model,
             fix_hint="disable search_overlap_backward_update for this "
                      "graph or re-search with a calibrated "
                      "overlap_efficiency",
+        )
+
+
+# ----------------------------------------------------------------------
+# FFA509 — decode-objective roofline lints
+# ----------------------------------------------------------------------
+def _decode_objective_diagnostics(graph, views, cost_model, machine,
+                                  rep: AnalysisReport) -> None:
+    """Audit a strategy searched under objective="decode" for the two
+    ways a decode placement goes wrong that the HBM-roofline cost model
+    can misprice:
+
+      * head over-sharding — an attention op whose weight shard degree
+        exceeds the head count: the extra ways cannot split any more
+        KV heads, so each step pays the collective for a degree that
+        buys no additional HBM bandwidth.
+      * latency-dominated per-token collectives — a collective op on
+        the single-token critical path whose fixed ring latency
+        ((n-1)·max link latency) exceeds the decode-roofline compute of
+        the op feeding it: the step is waiting on wire latency, not on
+        HBM, and a lower degree is strictly cheaper.
+    """
+    from ..search.cost_model import op_decode_bytes
+
+    if machine is None:
+        return
+    # --- head over-sharding -------------------------------------------
+    for op in graph.topo_order():
+        if op.op_type != OperatorType.OP_MULTIHEAD_ATTENTION:
+            continue
+        heads = int(getattr(op.params, "num_heads", 0) or 0)
+        if heads <= 0 or not op.weights:
+            continue
+        head_deg = max(max(1, w.get_total_degree()) for w in op.weights)
+        if head_deg > heads:
+            best = max((d for d in range(1, heads + 1)
+                        if head_deg % d == 0), default=1)
+            rep.add(
+                Severity.WARNING, "FFA509",
+                f"decode-objective strategy shards attention weights "
+                f"{head_deg}-way but the op has only {heads} KV heads — "
+                f"the extra {head_deg // max(1, best)}x ways split no "
+                "additional heads, so each decode token pays the wider "
+                "collective without streaming any less KV per chip",
+                op=op,
+                fix_hint=f"reduce the weight shard degree {head_deg} -> "
+                         f"{best} (a divisor within the {heads}-head "
+                         "budget); replicate the remainder instead",
+            )
+    # --- latency-dominated per-token collectives ----------------------
+    producer: Dict[int, object] = {}
+    for op in graph.topo_order():
+        for t in op.outputs:
+            producer[t.guid] = op
+    hbm_bw = machine.chip.hbm_bandwidth * machine.hbm_efficiency
+    for op in graph.topo_order():
+        kind = _COLLECTIVE_OF.get(op.op_type)
+        if kind is None or not op.inputs:
+            continue
+        v = _view_of(op, views or {})
+        if v is None:
+            continue
+        ids = list(v.device_ids())
+        n = len(ids)
+        if n <= 1:
+            continue
+        max_lat = max(machine.link_latency(ids[i], ids[(i + 1) % n])
+                      for i in range(n))
+        latency = (n - 1) * max_lat
+        src = producer.get(op.inputs[0].guid)
+        if src is None:
+            continue
+        sv = _view_of(src, views or {})
+        parts = sv.num_parts() if sv is not None else 1
+        # decode-roofline compute of the feeding op: the HBM time ONE
+        # token's step spends streaming that op's bytes per device
+        compute = op_decode_bytes(src) / max(1, parts) / hbm_bw
+        if latency <= compute or latency <= 0.0:
+            continue
+        # cheapest degree whose ring latency fits under the compute it
+        # amortizes: (d-1)·max_lat <= compute, snapped to a divisor of n
+        fit = int(compute / max_lat) + 1 if max_lat > 0 else 1
+        best = max((d for d in range(1, min(n, max(1, fit)) + 1)
+                    if n % d == 0), default=1)
+        rep.add(
+            Severity.WARNING, "FFA509",
+            f"per-token {kind} over {n} devices costs "
+            f"{latency * 1e6:.2f} us of ring latency but the op feeding "
+            f"it ({src.name}) only has {compute * 1e6:.2f} us of decode-"
+            "roofline compute per token — the single-token step is "
+            "latency-bound on this collective, not HBM-bound",
+            op=op,
+            fix_hint=f"reduce the degree {n} -> {best}: "
+                     f"({best - 1})x{max_lat * 1e6:.2f} us of latency "
+                     "fits under the compute it amortizes",
         )
 
 

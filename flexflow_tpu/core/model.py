@@ -125,6 +125,15 @@ class FFModel:
         # compile products
         self.graph = None
         self.executor: Optional[PCGExecutor] = None
+        # decode-objective second strategy (compile_decode): the same
+        # layer graph re-searched under CostObjective.DECODE, carried
+        # alongside the training strategy (Splitwise/DistServe
+        # disaggregation within one model)
+        self.decode_graph = None
+        self.decode_executor: Optional[PCGExecutor] = None
+        self.decode_searched_views: Dict[int, object] = {}
+        self.decode_searched_cost: Optional[float] = None
+        self.decode_trajectory = None
         self.state: Optional[TrainState] = None
         self.metrics_obj: Optional[Metrics] = None
         self.perf_metrics = PerfMetrics()
@@ -915,10 +924,186 @@ class FFModel:
         self.search_trajectory.phase("init_state", _t_phase)
         self.perf_metrics = PerfMetrics()
 
-    def _build_cost_model(self):
+    def compile_decode(self, *, strategy_path: Optional[str] = None,
+                       export_path: Optional[str] = None):
+        """Run the Unity search a SECOND time over the same layer graph
+        with the DECODE cost objective (ROADMAP item 3; the Splitwise/
+        DistServe disaggregation insight): single-token decode is
+        HBM-bandwidth-bound where training is MXU-bound, so the cheapest
+        parallelization differs — the decode oracle prices each op off
+        the bytes one token streams (weights/shard + KV-cache reads +
+        1-token activations) and prices collectives latency-bound
+        (search/cost_model.py CostObjective.DECODE).
+
+        The model then carries TWO searched strategies: `graph`/
+        `searched_views` (training/prefill, compute-bound) and
+        `decode_graph`/`decode_searched_views`, with a separate
+        `decode_trajectory` recording this search's decisions. The
+        ContinuousBatcher lowers its batch decode executables from
+        `decode_executor` while prefill keeps the training strategy
+        (runtime/serving.py).
+
+        strategy_path: import the decode strategy from a strategy_io
+        JSON file instead of searching (ServingConfig.
+        decode_strategy_path feeds this). export_path: export the
+        searched strategy for later import. Returns the decode
+        executor."""
+        assert self.executor is not None, (
+            "compile() the model before compile_decode() — the decode "
+            "strategy is searched over the same layer graph and serves "
+            "alongside the training one"
+        )
+        cfg = self.config
+        ndev = min(cfg.numWorkers, len(jax.devices()))
+        self.decode_trajectory = obs.SearchTrajectory()
+        _t_phase = time.perf_counter()
+        # fresh lowering: the training search REWROTE self.graph with its
+        # own substitutions; the decode search must start from the same
+        # unrewritten layer graph, not the training winner
+        graph, _ = layers_to_pcg(self.layers)
+        if cfg.perform_fusion:
+            from ..pcg.fusion import apply_fusion
+
+            graph = apply_fusion(graph)
+        self.decode_trajectory.phase("decode_lowering", _t_phase,
+                                     ops=len(graph.ops))
+        cost_model = self._build_cost_model(objective="decode")
+        _t_phase = time.perf_counter()
+        if strategy_path:
+            from ..runtime.strategy_io import (
+                apply_imported_strategy,
+                import_strategy,
+            )
+
+            strategy = import_strategy(strategy_path)
+            apply_imported_strategy(graph, strategy, num_devices=ndev)
+            views = {
+                op.guid: op.machine_view for op in graph.ops
+                if getattr(op, "machine_view", None) is not None
+            }
+            cost = None
+            self.decode_trajectory.phase(
+                "decode_strategy_import", _t_phase,
+                records=len(strategy), devices=ndev,
+            )
+        else:
+            from ..pcg.machine_view import MachineResource
+            from ..search import (
+                GraphSearchHelper,
+                SearchHelper,
+                generate_all_pcg_xfers,
+            )
+
+            machine = cost_model.machine
+            sh = SearchHelper(cost_model, trajectory=self.decode_trajectory)
+            degrees = []
+            d = 2
+            while d <= machine.num_workers:
+                degrees.append(d)
+                d *= 2
+            budget = cfg.search_budget if cfg.search_budget > 0 else 10
+            # parallelization xfers ONLY — no operator-substitution rules.
+            # A substitution rewrites compute ops and rebuilds their
+            # weights fresh from initializers, but the decode strategy
+            # must serve the weights TRAINED under the training graph
+            # (the batcher feeds both lowerings the same param store,
+            # keyed by op name); a rewritten op could never find its
+            # weights and would force the serving fallback every time.
+            xfers = generate_all_pcg_xfers(degrees or [1], cfg)
+            res = MachineResource(
+                num_nodes=machine.num_nodes,
+                all_procs_per_node=machine.workers_per_node,
+                available_procs_per_node=machine.workers_per_node,
+            )
+            gsh = GraphSearchHelper(
+                sh, xfers, alpha=cfg.search_alpha, budget=budget,
+                trajectory=self.decode_trajectory,
+            )
+            graph, result = gsh.graph_optimize(graph, res)
+            views = result.views
+            cost = result.cost
+            self.decode_trajectory.phase("decode_strategy_search", _t_phase,
+                                         devices=ndev)
+        self.decode_graph = graph
+        self.decode_searched_views = views
+        self.decode_searched_cost = cost
+        # same vetting the training strategy gets: structural validators +
+        # the static perf pass — run under the decode objective so FFA509
+        # (over-sharded KV heads, latency-bound per-token collectives on
+        # the critical path) fires here, at compile time
+        from ..search import run_strategy_validators
+
+        problems = run_strategy_validators(graph, views, ndev)
+        if problems:
+            warnings.warn(
+                "decode-searched strategy failed structural validation "
+                "(falling through to lowering, which demotes infeasible "
+                "degrees to replicated): " + "; ".join(problems[:5])
+            )
+        from ..analysis.perf import perf_diagnostics
+
+        perf_rep = perf_diagnostics(
+            graph, views=views, cost_model=cost_model, num_devices=ndev,
+            expert_degree=getattr(cfg, "expert_parallel_degree", 1),
+            objective="decode",
+        )
+        if perf_rep.errors:
+            warnings.warn(
+                "static perf analysis flagged the decode-searched strategy "
+                "(docs/analysis.md FFA5xx): "
+                + "; ".join(d.format() for d in perf_rep.errors[:5])
+            )
+        self.decode_trajectory.event(
+            "perf_lint", errors=len(perf_rep.errors),
+            warnings=len(perf_rep.warnings),
+            codes=sorted({d.code for d in perf_rep}),
+        )
+        if export_path:
+            from types import SimpleNamespace
+
+            from ..runtime.strategy_io import export_strategy
+
+            export_strategy(graph, SimpleNamespace(views=views, cost=cost),
+                            export_path)
+        # decode executor over the decode graph: params stay keyed by op
+        # name, so a decode build whose op names survived the rewrite can
+        # consume the TRAINING state's params directly; the batcher
+        # checks compatibility before swapping it in (runtime/serving.py)
+        cur_inputs = graph.input_tensors()
+        ordered_inputs = [cur_inputs[i] for i in self._input_positions]
+        constants = {
+            cur_inputs[i].guid: (cur_inputs[i], v)
+            for i, v in self._constant_positions.items()
+        }
+        axis_sizes = strategies.assign_mesh_axes(graph, ndev)
+        mesh = build_mesh(axis_sizes)
+        _t_phase = time.perf_counter()
+        self.decode_executor = PCGExecutor(
+            graph,
+            mesh,
+            self.optimizer,
+            self.loss_type,
+            self.metrics_obj,
+            compute_dtype=(
+                jnp.bfloat16 if cfg.allow_mixed_precision else None
+            ),
+            grad_dtype=None,  # decode never materializes gradients
+            seed=cfg.seed,
+            input_order=ordered_inputs,
+            remat=False,
+            constants=constants,
+            plan_cost_model=cost_model,
+        )
+        self.decode_trajectory.phase("decode_executor_build", _t_phase)
+        return self.decode_executor
+
+    def _build_cost_model(self, objective: str = "train"):
         """The cost oracle for stage planning (and the search): the
         configured machine (file / search-dims / --machine-model-version)
-        with the shipped calibration."""
+        with the shipped calibration. `objective` selects what workload
+        the oracle prices (search/cost_model.py CostObjective): "train"
+        (default) or "decode" — the single-token HBM-roofline pricing
+        compile_decode()'s second search runs under."""
         from ..search import CostModel, MachineModel, parse_machine_config
 
         cfg = self.config
@@ -955,6 +1140,7 @@ class FFModel:
             machine, bf16=cfg.allow_mixed_precision,
             overlap_backward_update=cfg.search_overlap_backward_update,
             survivability_penalty=pen,
+            objective=objective,
         )
         profiled = getattr(self, "_profiled_op_costs", None)
         if profiled:

@@ -67,12 +67,23 @@ def _dropout_fallback(impl: str, op_name: str, reason: str) -> None:
                     "and seq divisible by their mesh degrees",
         "sp_heads": "ulysses needs the per-device head count divisible "
                     "by the seq axis (heads scatter over it)",
+        # paged flash-decode fallbacks (serving): the requested paged
+        # kernel cannot prove exactness for this step, so the dense
+        # per-row masked path runs instead
+        "paged_pallas": "the paged flash-decode kernel needs Pallas "
+                        "(jax.experimental.pallas unavailable)",
+        "paged_block": "the paged flash-decode kernel attends ONE query "
+                       "token per slot; multi-token blocks (prefill) "
+                       "keep the dense masked path",
     }[reason]
     kind = "dropout" if reason in ("kernel", "mesh", "backend", "seq") \
+        else "paged decode" if reason.startswith("paged_") \
         else "sequence parallelism"
+    knob = "FF_DECODE_IMPL" if reason.startswith("paged_") \
+        else "FF_ATTENTION_IMPL"
     warnings.warn(
         f"attention {kind} on {op_name or 'a MHA op'} "
-        f"(FF_ATTENTION_IMPL={impl}) falls back to the dense path: "
+        f"({knob}={impl}) falls back to the dense path: "
         f"{detail}"
     )
 
@@ -476,29 +487,72 @@ def _forward_decode(params, weights, inputs, ctx, cache, t):
         v_cache = jax.lax.dynamic_update_slice(
             v_cache, v_new.astype(v_cache.dtype), (0, t, 0, 0)
         )
-    scale = 1.0 / jnp.sqrt(jnp.asarray(params.qk_head_dim, jnp.float32))
-    scores = jnp.einsum(
-        "bshd,bthd->bhst", q, k_cache.astype(q.dtype),
-        preferred_element_type=jnp.float32,
-    ) * scale                          # (b, h, s0, max_len)
-    pos = jnp.arange(k_cache.shape[1])          # cache positions
-    if per_row_t:
-        q_pos = t[:, None] + jnp.arange(q.shape[1])[None, :]  # (b, s0)
-        scores = jnp.where(
-            pos[None, None, None, :] <= q_pos[:, None, :, None],
-            scores, jnp.finfo(jnp.float32).min,
+    # FF_DECODE_IMPL ∈ {auto, dense, paged}: "paged" routes single-token
+    # steps through the Pallas paged flash-decode kernel
+    # (kernels/decode.py — the dense per-slot cache viewed as a paged
+    # pool, online softmax over pages, dead pages skipped); "auto"
+    # engages it only where the compiled kernel runs (TPU backend);
+    # "dense" pins the per-row masked reference path. Ineligible "paged"
+    # requests fall back dense with the shared
+    # ff_attention_fallback_total{reason} counter + one warning.
+    impl = os.environ.get("FF_DECODE_IMPL", "auto")
+    if impl not in ("auto", "dense", "paged"):
+        raise ValueError(
+            f"FF_DECODE_IMPL={impl!r}: expected one of auto|dense|paged")
+    use_paged = False
+    if impl != "dense":
+        from ..kernels.attention import HAS_PALLAS
+        if impl == "paged":
+            if not HAS_PALLAS:
+                _dropout_fallback(impl, ctx.op_name, "paged_pallas")
+            elif q.shape[1] != 1:
+                _dropout_fallback(impl, ctx.op_name, "paged_block")
+            else:
+                use_paged = True
+        else:  # auto: interpret mode on CPU would lose to the XLA dense
+            use_paged = (HAS_PALLAS and q.shape[1] == 1
+                         and jax.default_backend() == "tpu")
+    if use_paged:
+        from ..kernels.decode import (
+            decode_page_size,
+            paged_flash_decode,
+            paged_view_of_cache,
         )
+        b = q.shape[0]
+        kp, vp, table = paged_view_of_cache(
+            k_cache.astype(q.dtype), v_cache.astype(q.dtype),
+            decode_page_size(k_cache.shape[1]),
+        )
+        lengths = (t.astype(jnp.int32) if per_row_t
+                   else jnp.full((b,), t, jnp.int32)) + 1
+        attn = paged_flash_decode(
+            q[:, 0], kp, vp, table, lengths,
+            interpret=jax.default_backend() != "tpu",
+        )[:, None]                     # (b, 1, h, dv)
     else:
-        q_pos = t + jnp.arange(q.shape[1])      # this block's positions
-        scores = jnp.where(
-            pos[None, None, None, :] <= q_pos[None, None, :, None],
-            scores, jnp.finfo(jnp.float32).min,
-        )
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    attn = jnp.einsum(
-        "bhst,bthd->bshd", probs, v_cache.astype(q.dtype),
-        preferred_element_type=jnp.float32,
-    ).astype(q.dtype)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(params.qk_head_dim, jnp.float32))
+        scores = jnp.einsum(
+            "bshd,bthd->bhst", q, k_cache.astype(q.dtype),
+            preferred_element_type=jnp.float32,
+        ) * scale                      # (b, h, s0, max_len)
+        pos = jnp.arange(k_cache.shape[1])      # cache positions
+        if per_row_t:
+            q_pos = t[:, None] + jnp.arange(q.shape[1])[None, :]  # (b, s0)
+            scores = jnp.where(
+                pos[None, None, None, :] <= q_pos[:, None, :, None],
+                scores, jnp.finfo(jnp.float32).min,
+            )
+        else:
+            q_pos = t + jnp.arange(q.shape[1])  # this block's positions
+            scores = jnp.where(
+                pos[None, None, None, :] <= q_pos[None, None, :, None],
+                scores, jnp.finfo(jnp.float32).min,
+            )
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        attn = jnp.einsum(
+            "bhst,bthd->bshd", probs, v_cache.astype(q.dtype),
+            preferred_element_type=jnp.float32,
+        ).astype(q.dtype)
     out = jnp.einsum("bshd,hde->bse", attn, wo,
                      preferred_element_type=jnp.float32)
     out = out.astype(q_in.dtype)  # post-cast dtype, same as _forward

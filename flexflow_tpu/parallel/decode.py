@@ -46,6 +46,7 @@ op params.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -56,6 +57,50 @@ from ..ff_types import AggrMode, OperatorType
 from ..ops.registry import FwdCtx, get_op_def
 
 NEG_INF = -1e30
+
+
+class DecodeExactnessError(NotImplementedError):
+    """Incremental decode cannot prove a step exact for this graph.
+
+    Subclasses NotImplementedError so existing callers keep working; the
+    serving layer catches THIS type to fall back (e.g. the batcher keeps
+    the training-strategy executables when a decode-searched graph's
+    step can't be built) instead of swallowing unrelated bugs."""
+
+
+# Decode-fallback bookkeeping, mirroring the attention fallback contract
+# (ops/attention.py): every occurrence counts toward
+# ff_decode_fallback_total{reason=...}; each distinct (site, reason)
+# warns once per process. Build/trace-time exactness failures that have
+# NO exact recovery still raise (DecodeExactnessError) — but counted, so
+# an aborted batcher boot is visible in telemetry instead of silent.
+_DECODE_FALLBACK_WARNED: set = set()
+
+
+def reset_decode_fallback_warnings() -> None:
+    """Forget which (site, reason) decode fallbacks already warned
+    (tests; a fresh process starts empty)."""
+    _DECODE_FALLBACK_WARNED.clear()
+
+
+def decode_fallback(site: str, reason: str, detail: str) -> None:
+    """Count + warn-once for a decode fast path falling back (or, for
+    unrecoverable exactness failures, aborting visibly)."""
+    from .. import obs
+
+    obs.count("ff_decode_fallback_total",
+              help="incremental-decode fast paths that fell back to a "
+                   "dense/recovery path (or aborted on an unprovable "
+                   "step)",
+              reason=reason)
+    key = (site, reason)
+    if key in _DECODE_FALLBACK_WARNED:
+        return
+    _DECODE_FALLBACK_WARNED.add(key)
+    warnings.warn(
+        f"incremental decode on {site or 'a decode graph'} fell back "
+        f"({reason}): {detail}"
+    )
 
 # pointwise in every axis (rank-preserving): the live/prefix axes pass
 # straight through; execution on a slice is the plain forward
@@ -137,7 +182,7 @@ class _Propagator:
         out_shapes = [tuple(x.material_shape()) for x in op.outputs]
 
         def fail(msg):
-            raise NotImplementedError(
+            raise DecodeExactnessError(
                 f"{op.name} ({t.name}): incremental decode can't prove "
                 f"exactness — {msg}"
             )
@@ -626,7 +671,7 @@ def build_plan(topo, input_pts, constants, decode_input: Optional[int] = None,
         for sm in prop.prefix_softmaxes:
             if not _prove_causal(sm, prop, live_ops, static_ops, constants,
                                  live_len):
-                raise NotImplementedError(
+                raise DecodeExactnessError(
                     f"{sm.name} ({sm.op_type.name}): primitive-op attention "
                     "whose causality can't be proven from baked mask "
                     "constants — the decode step would inject a causal "
@@ -646,7 +691,8 @@ def build_plan(topo, input_pts, constants, decode_input: Optional[int] = None,
     )
 
 
-def _slice_aligned(val, info_axis_map, t, s0, cap, out_rank=None):
+def _slice_aligned(val, info_axis_map, t, s0, cap, out_rank=None,
+                   site: str = ""):
     """Slice a static/full value per its alignment: live-aligned axes take
     [t:t+s0], prefix-aligned axes take [0:cap].
 
@@ -655,7 +701,13 @@ def _slice_aligned(val, info_axis_map, t, s0, cap, out_rank=None):
     per row — a vmapped dynamic slice that materializes a leading batch
     axis. `out_rank` (the consuming op's output rank) is then required to
     re-align the result so broadcasting still lines the batch axis up with
-    the live stream's axis 0."""
+    the live stream's axis 0.
+
+    Alignment cases an exact recovery exists for fall back to it with the
+    ff_decode_fallback_total{reason} counter + one warning (the
+    batch-position live axis at s0=1 turns into a dense per-row gather);
+    genuinely unprovable cases raise DecodeExactnessError — still
+    counted, so an aborted batcher boot shows up in telemetry."""
     per_row_t = getattr(t, "ndim", 0) == 1
     live_axes = [axis for axis, kind in info_axis_map if kind == "live"]
     for axis, kind in info_axis_map:
@@ -668,14 +720,47 @@ def _slice_aligned(val, info_axis_map, t, s0, cap, out_rank=None):
             val = jax.lax.dynamic_slice_in_dim(val, t, s0, axis=axis)
         return val
     if out_rank is None:
-        raise NotImplementedError(
+        decode_fallback(site, "no_out_rank",
+                        "per-row decode positions need the consuming "
+                        "op's output rank to realign a sliced static "
+                        "operand — no exact recovery, aborting the build")
+        raise DecodeExactnessError(
             "per-row decode positions need the consuming op's output rank "
             "to realign a sliced static operand"
         )
     b = t.shape[0]
     offset = out_rank - val.ndim  # right-aligned broadcast offset
     if any(axis + offset == 0 for axis in live_axes):
-        raise NotImplementedError(
+        # the live-aligned axis IS the output's batch axis (offset == 0,
+        # axis == 0). For single-token steps (s0 == 1 — the only shape
+        # per-row positions arrive in) row i of the output reads exactly
+        # position t[i]: a dense per-row gather is exact, so recover
+        # instead of aborting the batcher boot.
+        if s0 == 1 and offset == 0:
+            decode_fallback(
+                site, "batch_live_gather",
+                "a static operand's live-aligned axis coincides with the "
+                "batch axis; recovered with a dense per-row gather "
+                "(jnp.take over the position vector) instead of the "
+                "sliced fast path",
+            )
+            val = jnp.take(val, t, axis=0)  # (b,) + val.shape[1:]
+            rest = [axis for axis in live_axes if axis != 0]
+            if rest:
+                def slice_rest(v, tt):
+                    for axis in rest:
+                        v = jax.lax.dynamic_slice_in_dim(
+                            v, tt, s0, axis=axis - 1)
+                    return v
+                val = jax.vmap(slice_rest, in_axes=(0, 0))(val, t)
+            return val
+        decode_fallback(
+            site, "batch_live_block",
+            "a static operand's live-aligned axis coincides with the "
+            "batch axis and the step has s0 > 1 (a prefill block) — no "
+            "exact per-row recovery, aborting the build",
+        )
+        raise DecodeExactnessError(
             "per-row decode positions: a static operand's live-aligned axis "
             "coincides with the batch axis"
         )
@@ -688,7 +773,13 @@ def _slice_aligned(val, info_axis_map, t, s0, cap, out_rank=None):
                 return v
             return jax.vmap(slice_row, in_axes=(0, 0))(val, t)
         if val.shape[0] != 1:
-            raise NotImplementedError(
+            decode_fallback(
+                site, "batch_mismatch",
+                f"static operand batch axis {val.shape[0]} matches "
+                f"neither the decode batch {b} nor 1 — rows cannot be "
+                "matched to slots, no exact recovery",
+            )
+            raise DecodeExactnessError(
                 f"static operand batch axis {val.shape[0]} matches neither "
                 f"the decode batch {b} nor 1"
             )

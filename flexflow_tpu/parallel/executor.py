@@ -1418,7 +1418,7 @@ class PCGExecutor:
                     return statics[g]
                 return consts[g]
 
-            def aligned_input(x, out_rank, out_info):
+            def aligned_input(x, out_rank, out_info, site=""):
                 """A live op's input value: live tensors yield their
                 current slice; static/constant operands are sliced where
                 their full-length axes align with the live/prefix axes."""
@@ -1432,7 +1432,7 @@ class PCGExecutor:
                     tuple(full.shape), out_rank, out_info, plan.live_len,
                 )
                 return dec._slice_aligned(full, amap, t, s0, max_len,
-                                          out_rank=out_rank)
+                                          out_rank=out_rank, site=site)
 
             for op in plan.live_ops:
                 if op.is_parallel_op:
@@ -1523,7 +1523,7 @@ class PCGExecutor:
                     outs = [jnp.reshape(x, target)]
                 else:
                     out_rank = len(op.outputs[0].material_shape())
-                    ins = [aligned_input(x, out_rank, out_info)
+                    ins = [aligned_input(x, out_rank, out_info, op.name)
                            for x in op.inputs]
                     outs = d.forward(op.params, w, ins, ctx)
 

@@ -44,6 +44,7 @@ import uuid
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -574,6 +575,12 @@ class ServingConfig:
     slo_p99_s: Optional[float] = None
     eos_token_id: Optional[int] = None
     assume_causal: bool = False
+    # disaggregated prefill/decode: when set (and the model has no
+    # decode-searched strategy yet), compile_decode() imports this
+    # strategy file so the batched decode step lowers from the
+    # decode-objective strategy while prefill keeps the train-searched
+    # (compute-bound) one. Ignored when model.decode_executor exists.
+    decode_strategy_path: Optional[str] = None
     idle_wait_s: float = 0.005
     # compile every decode executable (all prefill buckets + the batched
     # step) when the replica boots, BEFORE it takes traffic: a mid-run
@@ -902,12 +909,46 @@ class ContinuousBatcher:
         # separate processes and never contend here
         self._device_lock = device_lock or threading.RLock()
         ex = model.executor
-        self._initB, self._stepB = ex.build_decode(
-            config.slots, config.max_len, assume_causal=config.assume_causal
-        )
+        # prefill ALWAYS lowers from the train-searched (compute-bound)
+        # strategy: a prompt is a full-sequence forward, exactly the
+        # shape the training objective priced
         self._init1, self._step1 = ex.build_decode(
             1, config.max_len, assume_causal=config.assume_causal
         )
+        # batched decode prefers the decode-searched strategy (HBM
+        # roofline objective) when one exists / is configured AND its
+        # cache pytree is splice-compatible with the prefill lowering —
+        # _insert_slot_locked copies prefill caches leaf-by-leaf into
+        # the running batch, so the two lowerings must agree on cache
+        # structure. Anything else falls back to the training executor
+        # (counted, warned once).
+        self.decode_strategy_active = False
+        dex = getattr(model, "decode_executor", None)
+        if dex is None and config.decode_strategy_path:
+            model.compile_decode(strategy_path=config.decode_strategy_path)
+            dex = model.decode_executor
+        initB, stepB = ex.build_decode(
+            config.slots, config.max_len, assume_causal=config.assume_causal
+        )
+        if dex is not None:
+            from ..parallel.decode import (DecodeExactnessError,
+                                           decode_fallback)
+            try:
+                initB_d, stepB_d = dex.build_decode(
+                    config.slots, config.max_len,
+                    assume_causal=config.assume_causal,
+                )
+                problem = self._decode_executor_mismatch(dex, initB_d)
+                if problem is not None:
+                    decode_fallback(self.name, "decode_strategy_incompatible",
+                                    problem)
+                else:
+                    initB, stepB = initB_d, stepB_d
+                    self.decode_strategy_active = True
+            except DecodeExactnessError as e:
+                decode_fallback(self.name, "decode_strategy_unbuildable",
+                                str(e))
+        self._initB, self._stepB = initB, stepB
         in_t = model._fit_input_tensors[-1]
         self._id_dt = in_t.data_type.np_dtype
         self._caches = None
@@ -926,6 +967,53 @@ class ContinuousBatcher:
         self.stats = {"admitted": 0, "finished": 0, "iterations": 0,
                       "prefills": 0, "retired_eos": 0, "shed_decode": 0,
                       "stranded_requeued": 0}
+
+    def _decode_executor_mismatch(self, dex, initB_d) -> Optional[str]:
+        """None if the decode-searched lowering can serve the batched
+        step, else a human-readable reason. Two lowerings are
+        splice-compatible when (a) every weight-bearing op in the decode
+        graph finds its weights in the (training) param store by op
+        name, and (b) the decode-build's cache pytree matches the
+        prefill build's section-by-section: guid-keyed 'static'/'prefix'
+        sections must agree (guids differ across lowerings, so in
+        practice both must be empty — true for decoder-only fused-MHA
+        graphs), 'mha' sections must cover the same op names with the
+        same per-slot leaf shapes. Probed with jax.eval_shape — no cache
+        allocation happens here."""
+        params = (self.model.state.params
+                  if getattr(self.model, "state", None) is not None else None)
+        if params is not None:
+            missing = [op.name for op in dex.topo
+                       if op.weights and not op.is_parallel_op
+                       and op.name not in params]
+            if missing:
+                return (f"decode graph ops {missing} have no weights in the "
+                        f"model's param store")
+        try:
+            dec = jax.eval_shape(initB_d, params, ())
+            pre = jax.eval_shape(self._init1, params, ())
+        except Exception as e:
+            return f"cache shape probe failed: {e}"
+        for section in ("static", "prefix", "mha_static"):
+            d_keys = set(dec.get(section, {}))
+            p_keys = set(pre.get(section, {}))
+            if d_keys != p_keys:
+                return (f"{section!r} cache keys differ between the decode- "
+                        f"and train-searched lowerings "
+                        f"({len(d_keys)} vs {len(p_keys)} entries)")
+        if set(dec["mha"]) != set(pre["mha"]):
+            return ("attention cache op names differ between the decode- "
+                    "and train-searched lowerings")
+        for name, dleaves in dec["mha"].items():
+            dflat, dtree = jax.tree_util.tree_flatten(dleaves)
+            pflat, ptree = jax.tree_util.tree_flatten(pre["mha"][name])
+            if dtree != ptree:
+                return f"attention cache structure differs for {name!r}"
+            for a, b in zip(dflat, pflat):
+                if a.shape[1:] != b.shape[1:] or a.dtype != b.dtype:
+                    return (f"attention cache leaf mismatch for {name!r}: "
+                            f"{a.shape}/{a.dtype} vs {b.shape}/{b.dtype}")
+        return None
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ContinuousBatcher":

@@ -1,7 +1,12 @@
 """Strategy search: cost model, DP machine-view assignment, substitution
 engine, MCMC fallback (TPU-native equivalents of reference
 src/runtime/{simulator,graph,substitution,model-mcmc}.cc)."""
-from .cost_model import CostMetrics, CostModel  # noqa: F401
+from .cost_model import (  # noqa: F401
+    CostMetrics,
+    CostModel,
+    CostObjective,
+    op_decode_bytes,
+)
 from .dp_search import GraphCostResult, SearchHelper, research_views  # noqa: F401
 from .machine_model import (  # noqa: F401
     MachineModel,

@@ -24,6 +24,35 @@ from ..pcg.op import PCGOp
 from .machine_model import MachineModel
 
 
+class CostObjective:
+    """What workload the cost oracle prices an op for (ROADMAP item 3 —
+    "run the Unity search twice per model with different cost
+    objectives"; the Splitwise/DistServe disaggregation insight).
+
+      TRAIN  — the classic per-step price: padded MXU FLOPs vs HBM
+               roofline, backward + weight-grad sync included.
+      DECODE — one single-token decode step: cost is the HBM roofline
+               over the bytes the step actually streams (weights per
+               shard + the KV-cache-resident K/V re-read per token +
+               1-token activation slices), no backward, no grad sync,
+               and collectives priced latency-bound (per-token messages
+               are KB-sized, so hop latency dominates bandwidth).
+    """
+
+    TRAIN = "train"
+    DECODE = "decode"
+    ALL = (TRAIN, DECODE)
+
+    @staticmethod
+    def validate(objective: str) -> str:
+        if objective not in CostObjective.ALL:
+            raise ValueError(
+                f"objective={objective!r}: expected one of "
+                f"{'/'.join(CostObjective.ALL)}"
+            )
+        return objective
+
+
 @dataclasses.dataclass
 class CostMetrics:
     """reference: simulator.h:54-88 CostMetrics"""
@@ -192,6 +221,36 @@ def op_weight_bytes(op: PCGOp) -> int:
     return sum(_vol(w.material_shape()) * w.data_type.size for w in op.weights)
 
 
+def _seq_extent(t) -> int:
+    """The sequence extent of an activation tensor under the repo's
+    (batch, seq, ...) convention — 1 for tensors with no seq axis."""
+    s = t.material_shape()
+    return int(s[1]) if len(s) >= 3 else 1
+
+
+def op_decode_bytes(op: PCGOp) -> float:
+    """HBM bytes ONE single-token decode step streams for this op,
+    unsharded (the decode-objective analog of op_bytes): every weight is
+    read once per step; an MHA op re-reads its KV-cache-resident K/V in
+    full (the cache length is stood in for by the graph's compiled seq
+    extent — same tensors, same bytes); activations contribute only
+    their 1-token slice (full volume over the seq extent). This is what
+    makes decode memory-bound where training is compute-bound: at batch
+    1 the weights dominate and the FLOPs term of the roofline collapses.
+    """
+    n = float(op_weight_bytes(op))
+    if op.op_type == OperatorType.OP_MULTIHEAD_ATTENTION \
+            and len(op.inputs) >= 3:
+        # the persistent (b, max_len, h, d) K/V pair the step attends
+        # over — byte-equivalent to the full k/v inputs
+        for x in op.inputs[1:3]:
+            n += _vol(x.material_shape()) * x.data_type.size
+    for x in list(op.inputs) + list(op.outputs):
+        n += _vol(x.material_shape()) * x.data_type.size \
+            / max(1, _seq_extent(x))
+    return n
+
+
 _DEFAULT_CALIBRATION: Optional[dict] = None
 _DEFAULT_CALIBRATION_LOADED = False
 
@@ -276,9 +335,16 @@ class CostModel:
     def __init__(self, machine: MachineModel, *, bf16: bool = True,
                  calibration=None, overlap_backward_update: bool = False,
                  overlap_efficiency: Optional[float] = None,
-                 survivability_penalty: float = 0.0):
+                 survivability_penalty: float = 0.0,
+                 objective: str = CostObjective.TRAIN):
         self.machine = machine
         self.bf16 = bf16
+        # what workload an op's price describes: the training step
+        # (default) or one single-token decode step (CostObjective.DECODE
+        # — HBM-roofline bytes, no backward/sync, latency-bound
+        # collectives). Per-instance, so the two searches a model runs
+        # (compile() + compile_decode()) can never share a cache entry.
+        self.objective = CostObjective.validate(objective)
         # slice-loss survivability bias (search/survivability.py, config
         # knob search_survivability_penalty): >0 on hierarchical
         # machines makes DP/MCMC multiply a candidate's cost by
@@ -410,10 +476,74 @@ class CostModel:
             view.hash(),
         )
 
+    def _measure_decode_cost(self, op: PCGOp, view: MachineView,
+                             key) -> CostMetrics:
+        """Price ONE single-token decode step of `op` under `view`: the
+        HBM roofline over the bytes the step streams per device. Weights
+        divide by their OWN shard degree (a head/channel-split weight is
+        the thing decode sharding actually buys — each chip streams
+        1/degree of the matrix per token); the KV-cache-resident K/V
+        divide by the batch degree × the head-shard degree (the two axes
+        that tile the cache); 1-token activation slices divide by the
+        view's parts. FLOPs are the UNPADDED per-token count — a 1-token
+        gemm never fills an MXU tile, and padding it would misprice
+        decode as compute-bound, which is exactly the mistake the decode
+        objective exists to avoid. No backward, no weight-grad sync."""
+        parts = max(1, view.num_parts())
+        seq = max(1, _seq_extent(op.outputs[0])) if op.outputs else 1
+        flops = op_flops(op) / seq / parts
+        membytes = 0.0
+        for w in op.weights:
+            membytes += _vol(w.material_shape()) * w.data_type.size \
+                / max(1, w.get_total_degree())
+        if op.op_type == OperatorType.OP_MULTIHEAD_ATTENTION \
+                and len(op.inputs) >= 3:
+            batch_deg = 1
+            if op.outputs and op.outputs[0].dims:
+                batch_deg = max(1, op.outputs[0].dims[0].degree)
+            head_deg = max(
+                [max(1, w.get_total_degree()) for w in op.weights] or [1]
+            )
+            kv = sum(_vol(x.material_shape()) * x.data_type.size
+                     for x in op.inputs[1:3])
+            membytes += kv / max(1, batch_deg * head_deg)
+        for x in list(op.inputs) + list(op.outputs):
+            membytes += _vol(x.material_shape()) * x.data_type.size \
+                / max(1, _seq_extent(x)) / parts
+        mxu_eff, hbm_eff = self._calibrated_efficiencies(
+            op.op_type, flops, membytes
+        )
+        self.analytic_hits += 1
+        fwd = self.machine.compute_cost(
+            flops, membytes, self.bf16, mxu_eff=mxu_eff, hbm_eff=hbm_eff,
+        )
+        wmem = 0
+        for w in op.weights:
+            w_b = _vol(w.material_shape()) * w.data_type.size
+            wmem += int(w_b / max(1, w.get_total_degree()))
+        cm = CostMetrics(
+            forward_time=fwd,
+            backward_time=0.0,
+            sync_time=0.0,
+            inputs_memory=int(
+                sum(_vol(t.material_shape()) * t.data_type.size
+                    for t in op.inputs) / parts
+            ),
+            outputs_memory=int(
+                sum(_vol(t.material_shape()) * t.data_type.size
+                    for t in op.outputs) / parts
+            ),
+            weights_memory=wmem,
+        )
+        self._cache[key] = cm
+        return cm
+
     def measure_operator_cost(self, op: PCGOp, view: MachineView) -> CostMetrics:
         key = self._key(op, view)
         if key in self._cache:
             return self._cache[key]
+        if self.objective == CostObjective.DECODE:
+            return self._measure_decode_cost(op, view, key)
         parts = max(1, view.num_parts())
         # MXU time is paid at the tile-quantized SHARD shape; the padded
         # count only describes the shard when the tensor degrees actually
@@ -547,6 +677,12 @@ class CostModel:
         if src_view.hash() == dst_view.hash():
             return 0.0
         total = _vol(tensor.material_shape()) * tensor.data_type.size
+        if self.objective == CostObjective.DECODE:
+            # a decode step only moves the 1-token slice of the
+            # activation; xfer_cost's link-latency term then dominates,
+            # which is the point — resharding per token is expensive in
+            # hops, not bytes
+            total /= max(1, _seq_extent(tensor))
         key = (total, src_view.hash(), dst_view.hash())
         cached = self._xfer_cache.get(key)
         if cached is not None:
@@ -622,6 +758,42 @@ class CostModel:
                 if len(ids) >= deg:
                     return ids[:deg]
             return range(deg)
+
+        if self.objective == CostObjective.DECODE:
+            # per-token messages over the latency-bound collective model:
+            # one decode step moves the 1-token slice, and at KB sizes the
+            # ring's hop latency (not bandwidth) is the price — the term
+            # that makes a per-token all-reduce on the critical path
+            # costly no matter how narrow the message is
+            total /= max(1, _seq_extent(x))
+            if t == OperatorType.OP_REPLICATE:
+                deg = op.params.replicate_degree
+                return m.latency_bound_collective_cost(
+                    "replicate", total, group(deg))
+            if t == OperatorType.OP_REDUCTION:
+                deg = op.params.reduction_degree
+                return m.latency_bound_collective_cost(
+                    "allreduce", total / deg, group(deg))
+            if t == OperatorType.OP_ALL_TO_ALL:
+                deg = op.params.degree
+                return m.latency_bound_collective_cost(
+                    "all_to_all", total, group(deg))
+            if t == OperatorType.OP_WEIGHT_SHARD:
+                # decode pays ONE gather-on-use of the full weight per
+                # token (no backward re-gather, no gradient
+                # reduce-scatter) — still ruinous at batch 1, which is
+                # why the decode search avoids FSDP nodes
+                from ..parallel.weight_sharding import \
+                    shard_target_weight_bytes
+
+                deg = op.params.shard_degree
+                wbytes = shard_target_weight_bytes(op)
+                return m.latency_bound_collective_cost(
+                    "all_gather", wbytes, group(deg))
+            deg = getattr(op.params, "repartition_degree",
+                          getattr(op.params, "combine_degree", 2))
+            return m.latency_bound_collective_cost(
+                "reshard", total, group(deg))
 
         if t == OperatorType.OP_WEIGHT_SHARD:
             # FSDP/ZeRO per-step collectives over the TARGET op's full

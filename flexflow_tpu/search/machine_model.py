@@ -155,6 +155,42 @@ class MachineModel:
         return (num_bytes * (n - 1) / n / self.ici_bandwidth
                 + (n - 1) * self.ici_latency)
 
+    def latency_bound_collective_cost(self, kind: str, num_bytes: float,
+                                      device_ids) -> float:
+        """Collective pricing for the DECODE cost objective
+        (search/cost_model.py CostObjective.DECODE): a single-token decode
+        step moves KB-sized activation messages, so the ring's hop latency
+        — which the bandwidth-oriented replicate/all_to_all/reshard costs
+        deliberately omit (it is noise at training-step message sizes) —
+        dominates the wire time. Prices the same bandwidth term as the
+        training methods PLUS (n-1) hops of the slowest link's latency
+        (allreduce pays its usual 2(n-1) hops), so tiny messages cost
+        ~hops·latency and large ones converge to the training price. Kept
+        as a separate method so adding latency here can never perturb a
+        training-objective search."""
+        ids = list(device_ids)
+        n = len(ids)
+        if n <= 1 or num_bytes <= 0:
+            return 0.0
+        if kind == "allreduce":
+            # already carries its 2(n-1)·max_lat hop term
+            return self.allreduce_cost(num_bytes, ids)
+        bw_cost = {
+            "all_gather": self.all_gather_cost,
+            "reduce_scatter": self.reduce_scatter_cost,
+            "replicate": self.replicate_cost,
+            "all_to_all": self.all_to_all_cost,
+            "reshard": self.reshard_cost,
+        }[kind](num_bytes, ids)
+        max_lat = max(
+            self.link_latency(ids[i], ids[(i + 1) % n]) for i in range(n)
+        )
+        if kind in ("all_gather", "reduce_scatter"):
+            # those formulas carry (n-1)·ici_latency; upgrade to the
+            # slowest link in the actual group (DCN-crossing rings)
+            return bw_cost + (n - 1) * max(0.0, max_lat - self.ici_latency)
+        return bw_cost + (n - 1) * max_lat
+
     def exposed_comm_time(self, comm_s: float, hideable_compute_s: float,
                           efficiency: float = 1.0) -> float:
         """Comm time left on the critical path when a collective may run

@@ -75,6 +75,12 @@ def build_model_fn(args):
         m.compile(SGDOptimizer(lr=0.01),
                   LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
                   [MetricsType.METRICS_ACCURACY])
+        if args.decode_strategy:
+            # disaggregated prefill/decode (docs/serving.md): the
+            # batched decode step lowers from the decode-objective
+            # strategy; the harness asserts the same typed-accounting
+            # invariants either way
+            m.compile_decode()
         return m
 
     return model_fn
@@ -203,6 +209,10 @@ def main():
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--search-budget", type=int, default=2)
+    ap.add_argument("--decode-strategy", action="store_true",
+                    help="compile_decode() each replica model: serve the "
+                         "batched decode step from the decode-objective "
+                         "strategy (docs/serving.md)")
     ap.add_argument("--base-rate", type=float, default=6.0,
                     help="pre-ramp offered load, requests/s")
     ap.add_argument("--ramp", type=float, default=10.0,
