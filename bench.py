@@ -49,23 +49,48 @@ def wait_for_backend(max_wait_s: float = 600.0) -> None:
         time.sleep(20)
 
 
-def read_baseline(metric: str):
+def read_baseline(metric: str, backend: str = None, smoke: bool = False):
     """(value, source) this round is compared against (the vs_baseline
     field): a published number in BASELINE.json if the driver recorded
     one, else the first measured round (BENCH_r01.json) — the north-star
     file documents configurations, not numbers, so round 1 is the
     de-facto baseline of this build. The source rides along in the JSON
     line so a null/odd vs_baseline is diagnosable from the artifact
-    alone."""
+    alone.
+
+    Baselines are hardware-tier scoped: bare published.<metric> numbers
+    belong to the tier named by published.tier (the driver's axon/TPU
+    pool). A round measured on another backend (a CPU-only session) only
+    compares against an explicitly scoped published.<metric>@<backend>
+    entry — a CPU round vs a TPU baseline is not a regression, it is a
+    different machine. FF_BENCH_SMOKE runs are scoped one step further
+    (published.<metric>@<backend>+smoke): the smoke shapes amortize
+    warmup differently, so a smoke value vs a full-run baseline would
+    gate fixed overhead, not throughput."""
     here = os.path.dirname(os.path.abspath(__file__))
+    tier = "axon"
     try:
         with open(os.path.join(here, "BASELINE.json")) as f:
             published = json.load(f).get("published", {}) or {}
-        v = published.get(metric)
-        if isinstance(v, (int, float)) and v > 0:
-            return float(v), f"BASELINE.json:published.{metric}"
+        tier = published.get("tier") or tier
+        if smoke:
+            key = f"{metric}@{backend or tier}+smoke"
+            v = published.get(key)
+            if isinstance(v, (int, float)) and v > 0:
+                return float(v), f"BASELINE.json:published.{key}"
+            return None, None
+        if backend:
+            v = published.get(f"{metric}@{backend}")
+            if isinstance(v, (int, float)) and v > 0:
+                return float(v), f"BASELINE.json:published.{metric}@{backend}"
+        if backend in (None, tier):
+            v = published.get(metric)
+            if isinstance(v, (int, float)) and v > 0:
+                return float(v), f"BASELINE.json:published.{metric}"
     except (OSError, ValueError):
         pass
+    if smoke or backend not in (None, tier):
+        return None, None
     if metric == "transformer_train_throughput":
         # the round-1 artifact measured the transformer workload; the zoo
         # series (moe/longctx) have no baseline until the driver records
@@ -213,7 +238,8 @@ def decode_bench():
     n_chips = max(1, len(jax.devices()))
     tokens_per_sec_per_chip = toks / elapsed / n_chips
     metric = "decode_tokens_throughput"
-    baseline, baseline_source = read_baseline(metric)
+    baseline, baseline_source = read_baseline(
+        metric, jax.default_backend(), smoke)
     print(
         json.dumps(
             {
@@ -228,6 +254,7 @@ def decode_bench():
                 "baseline_source": baseline_source,
                 "phases_s_per_step": None,
                 "decode_strategy_active": bool(active),
+                "smoke": smoke,
                 "n_chips": n_chips,
                 "backend": jax.default_backend(),
                 "jax_version": jax.__version__,
@@ -392,7 +419,8 @@ def main():
         phases = None
 
     metric = f"{workload}_train_throughput"
-    baseline, baseline_source = read_baseline(metric)
+    baseline, baseline_source = read_baseline(
+        metric, jax.default_backend(), smoke)
     print(
         json.dumps(
             {
@@ -406,6 +434,7 @@ def main():
                 "baseline": baseline,
                 "baseline_source": baseline_source,
                 "phases_s_per_step": phases,
+                "smoke": smoke,
                 "n_chips": n_chips,
                 "backend": jax.default_backend(),
                 "jax_version": jax.__version__,

@@ -36,6 +36,7 @@ from .runtime.resilience import (  # noqa: F401
     retry,
 )
 from .runtime.serving import BatchScheduler  # noqa: F401
+from .runtime.tuner import StrategyTuner, TunerConfig  # noqa: F401
 from .runtime.verify import (  # noqa: F401
     CanaryConfig,
     CanaryMismatchError,

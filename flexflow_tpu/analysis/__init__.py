@@ -43,6 +43,7 @@ from .memory import (  # noqa: F401
     training_weight_multiplier,
 )
 from .perf import diagnostics_by_op, perf_diagnostics  # noqa: F401
+from .swap_lint import lint_swap_candidate  # noqa: F401
 from .schedule import (  # noqa: F401
     OverlapSchedule,
     ScheduleTask,

@@ -1,0 +1,58 @@
+"""Swap-candidate lint: the static gate a re-searched strategy must pass
+before the StrategyTuner (runtime/tuner.py) will consider hot-swapping it
+under a live training run.
+
+A compile-time strategy that fails validation merely warns — lowering
+demotes infeasible degrees and the run starts from scratch either way. A
+HOT-SWAP candidate is held to a stricter bar: it inherits trained state
+mid-run, so anything structurally questionable, perf-regressive by the
+analyzer's own oracle, or unable to adopt every trained weight by name is
+rejected outright (the tuner quarantines it and keeps the live strategy).
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Set
+
+
+def lint_swap_candidate(graph, views, *, num_devices: int,
+                        cost_model=None,
+                        current_weight_ops: Optional[Iterable[str]] = None,
+                        objective: str = "train") -> List[str]:
+    """Vet a re-searched (graph, views) as a hot-swap candidate. Returns
+    a list of human-readable problems; empty means viable.
+
+    Gates:
+      1. every registered strategy validator (structural validity, view
+         addressing, static analyzer) — same vetting compile() applies;
+      2. the static perf pass's ERRORS (analysis/perf.py FFA5xx) under
+         the given cost model — the same oracle the re-search scored
+         with, so an error here is the search disagreeing with itself;
+      3. trained-weight coverage: every op name currently holding
+         trained parameters must exist in the candidate graph, or the
+         transplant would orphan trained state (parallelization-only
+         xfers preserve names by construction; this is the safety net).
+    """
+    problems: List[str] = []
+    from ..search import run_strategy_validators
+
+    problems.extend(run_strategy_validators(graph, views, num_devices))
+    if cost_model is not None:
+        from .perf import perf_diagnostics
+
+        rep = perf_diagnostics(
+            graph, views=views, cost_model=cost_model,
+            num_devices=num_devices, objective=objective,
+        )
+        problems.extend(d.format() for d in rep.errors)
+    if current_weight_ops is not None:
+        cand_ops: Set[str] = {op.name for op in graph.ops}
+        orphaned = sorted(n for n in current_weight_ops
+                          if n not in cand_ops)
+        if orphaned:
+            problems.append(
+                "swap would orphan trained weights (op name missing from "
+                "candidate graph): " + ", ".join(orphaned[:5])
+                + (f" (+{len(orphaned) - 5} more)" if len(orphaned) > 5
+                   else "")
+            )
+    return problems

@@ -1142,32 +1142,25 @@ class FFModel:
             survivability_penalty=pen,
             objective=objective,
         )
-        profiled = getattr(self, "_profiled_op_costs", None)
-        if profiled:
-            # explain_strategy(...).apply(model) fed real on-device op
-            # timings back — or compile(calibration=...) loaded a
-            # persisted store: serial-view costs resolve to those
-            # measurements instead of the analytic roofline (the
-            # --measured-search attach below, if enabled, supersedes
-            # this with proper per-shard measurement)
-            from ..obs.explain import attach_profiled_costs
+        # In-situ measurements ride on the oracle through the shared
+        # refresh seam (search/cost_model.py apply_calibration): per-op
+        # timings from explain_strategy(...).apply(model) or a persisted
+        # CalibrationStore override the analytic roofline for serial
+        # views; the store's measured overlap efficiency and per-kind
+        # collective bandwidths override the shipped calibration's. The
+        # online re-search (runtime/tuner.py) rebuilds its oracle through
+        # this same path, so drift-corrected searches are priced exactly
+        # like compile-time ones. (--measured-search, if enabled above,
+        # supersedes the per-op table with proper per-shard measurement.)
+        from ..search import apply_calibration
 
-            attach_profiled_costs(cm, profiled)
-        glb = getattr(self, "_calibration_globals", None)
-        if glb and glb.get("overlap_efficiency") is not None:
-            # the store's measured overlap efficiency overrides the
-            # shipped calibration's for the discount soundness math
-            cm.overlap_efficiency = float(glb["overlap_efficiency"])
-            cm.overlap_efficiency_source = "calibration_store"
-        if glb and glb.get("collective_bytes_per_s"):
-            # measured per-kind collective bandwidths (the step
-            # observatory's in-situ write-through) ride on the oracle so
-            # provenance() reports what the search was priced with
-            cm.calibrated_collective_bandwidths = {
-                k: float(v)
-                for k, v in glb["collective_bytes_per_s"].items()
-            }
-        return cm
+        glb = getattr(self, "_calibration_globals", None) or {}
+        return apply_calibration(
+            cm,
+            profiled=getattr(self, "_profiled_op_costs", None),
+            overlap_efficiency=glb.get("overlap_efficiency"),
+            collective_bandwidths=glb.get("collective_bytes_per_s"),
+        )
 
     def _run_strategy_search(self, ndev: int):
         """Unity search over the lowered PCG (reference: compile's
@@ -1593,6 +1586,7 @@ class FFModel:
         canary=None,
         lint: Optional[str] = None,
         telemetry=None,
+        tuner=None,
     ):
         if self.executor is None:
             from ..runtime.verify import NotCompiledError
@@ -1635,7 +1629,7 @@ class FFModel:
                 preemption_signal=preemption_signal,
                 elastic=elastic, health_monitor=health_monitor,
                 verify_strategy=verify_strategy, canary=canary,
-                lint=lint, tel=tel,
+                lint=lint, tel=tel, tuner=tuner,
             )
         except Exception as e:
             # OOM forensics (obs/step_profile.py): a step that dies with
@@ -1661,7 +1655,7 @@ class FFModel:
         checkpoint_dir, checkpoint_every_n_steps, keep_last_n, resume,
         skip_nonfinite_steps, step_guard, max_consecutive_skips,
         fault_injector, preemption_signal, elastic, health_monitor,
-        verify_strategy, canary, lint, tel,
+        verify_strategy, canary, lint, tel, tuner=None,
     ):
         if lint in ("warn", "error"):
             # static preflight (analysis/): shape/sharding inference,
@@ -1720,7 +1714,8 @@ class FFModel:
         if (checkpoint_dir is not None or skip_nonfinite_steps
                 or step_guard is not None or fault_injector is not None
                 or preemption_signal is not None or elastic
-                or health_monitor is not None or canary is not None):
+                or health_monitor is not None or canary is not None
+                or tuner is not None):
             # resilient stepwise loop (runtime/resilience.py): periodic
             # atomic checkpoints + mid-epoch resume, NaN/Inf step guard,
             # preemption handling, deterministic fault injection; with
@@ -1749,6 +1744,7 @@ class FFModel:
                             health_monitor=health_monitor,
                             canary=canary,
                             tel=tel,
+                            tuner=tuner,
                         )
                     except (_rz.SliceLossError, _rz.SliceDrained) as e:
                         # slice-granular failover: a SIMULATED whole-slice
@@ -2078,7 +2074,8 @@ class FFModel:
                        skip_nonfinite_steps, step_guard,
                        max_consecutive_skips, fault_injector,
                        preemption_signal, elastic=False,
-                       health_monitor=None, canary=None, tel=None):
+                       health_monitor=None, canary=None, tel=None,
+                       tuner=None):
         from ..runtime import resilience as rz
         from ..runtime import verify as vfy
 
@@ -2142,6 +2139,26 @@ class FFModel:
                 # classifies per slice (host loss vs whole-slice loss)
                 mon.fault_domains = getattr(self, "fault_domains", None)
             mon.start()
+
+        # -- strategy tuner (runtime/tuner.py): fit(tuner=TunerConfig(...))
+        # arms the self-healing re-search/hot-swap loop. It observes the
+        # synced step durations below and acts between steps; when it
+        # swaps (commit or rollback) the live executor changes and the
+        # step function/input layout are rebuilt after the boundary hook.
+        tuner_obj = None
+        if tuner is not None:
+            from ..runtime.tuner import StrategyTuner
+            from ..runtime.tuner import TunerConfig as _TunerCfg
+
+            if isinstance(tuner, StrategyTuner):
+                tuner_obj = tuner
+            else:
+                tuner_obj = StrategyTuner(
+                    self,
+                    tuner if isinstance(tuner, _TunerCfg) else _TunerCfg(),
+                    fault_injector=fault_injector,
+                )
+            self._tuner = tuner_obj
 
         # the canary re-executes steps from the pre-step state, which
         # donation would have reclaimed on accelerators — use the
@@ -2443,15 +2460,18 @@ class FFModel:
                         # hang detection (documented in docs/resilience.md)
                         jax.block_until_ready(partials["loss"])
                         mon.step_finished(global_step)
-                    if mon is not None or preempt.draining:
+                    if (mon is not None or preempt.draining
+                            or tuner_obj is not None):
                         # feed the executor's step-time EMA (drain-window
-                        # estimate) — only from synced steps, where the
-                        # wall time measures the step and not a dispatch
+                        # estimate) and the tuner's drift watch — only
+                        # from synced steps, where the wall time measures
+                        # the step and not a dispatch
                         if mon is None:
                             jax.block_until_ready(partials["loss"])
-                        self.executor.note_step_duration(
-                            time.perf_counter() - t0
-                        )
+                        _dur = time.perf_counter() - t0
+                        self.executor.note_step_duration(_dur)
+                        if tuner_obj is not None:
+                            tuner_obj.observe_step(_dur)
                     if canary is not None:
                         prev_pnorm, prev_loss = self._canary_check(
                             vfy, canary, prev_state, args, step_fn,
@@ -2494,6 +2514,25 @@ class FFModel:
                                 f"{skips} consecutive non-finite gradient "
                                 f"steps (step {global_step}); loss_scale="
                                 f"{float(_fetch_global(self.state.guard.loss_scale)):g}"
+                            )
+                    if tuner_obj is not None and not preempt.draining:
+                        # step-boundary tuner hook: probe/trigger/collect
+                        # the background search, execute a pending swap
+                        # transactionally, police the guard window. A True
+                        # return means the LIVE EXECUTOR changed (commit
+                        # or rollback) — rebuild the step function and
+                        # input layout for the new strategy. A swap during
+                        # a preemption drain is suppressed: the grace
+                        # window is for checkpointing, not re-planning.
+                        if tuner_obj.on_step_boundary(
+                            global_step, batch=(batch[:-1], batch[-1])
+                        ):
+                            step_fn = self.executor.build_train_step(
+                                donate=(canary is None)
+                            )
+                            in_pts = self.executor.input_pts
+                            n_chips = max(
+                                1, self.executor.mesh.devices.size
                             )
                     if manager is not None and global_step % every == 0:
                         _ck0 = time.perf_counter()
@@ -2559,6 +2598,17 @@ class FFModel:
                 f"THROUGHPUT = {num_samples / elapsed:.2f} samples/s",
                 name="fit_done", elapsed_s=elapsed, samples=num_samples,
             )
+        if tel is not None and getattr(tel.config, "step_profile", False):
+            # same in-situ capture epilogue as the plain loop: the
+            # resilient route is the only one the tuner takes, and the
+            # overlay it publishes is where the strategy-swap boundary
+            # instants land (obs/step_profile.py publish_step_profile)
+            from ..obs.step_profile import capture_into_session
+
+            try:
+                capture_into_session(self, tel, xs, y, bs)
+            except Exception as e:  # fflint: disable=FFL002 — observability must not fail training
+                warnings.warn(f"step-profile capture failed: {e}")
         return self.perf_metrics
 
     def eval(self, x=None, y=None, batch_size: Optional[int] = None):

@@ -313,6 +313,34 @@ class CalibrationStore:
                 "newest_entry_at": newest}
 
 
+def _rejection_reason(problem: str) -> str:
+    """Collapse a problems() string to a stable metric label:
+    fingerprint_mismatch | backend_mismatch | stale | unreadable."""
+    if problem.startswith("machine fingerprint mismatch"):
+        return "fingerprint_mismatch"
+    if problem.startswith("backend mismatch"):
+        return "backend_mismatch"
+    if problem.startswith("stale"):
+        return "stale"
+    return "unreadable"
+
+
+def _note_rejection(reason: str, detail: str, path) -> None:
+    """A rejected calibration must be visible to metrics, not just the
+    log: a tuner (or an operator staring at a drifted run) has to tell
+    "no calibration attached" apart from "calibration attached but
+    rejected" (runtime/tuner.py watches this)."""
+    from . import count, event
+
+    event("calibration_rejected", cat="calibration", reason=reason,
+          detail=detail, path=str(path))
+    count("ff_calibration_rejected_total",
+          help="Calibration stores rejected by resolve_calibration, by "
+               "reason (fingerprint_mismatch|backend_mismatch|stale|"
+               "unreadable)",
+          reason=reason)
+
+
 def resolve_calibration(calibration=None, *,
                         max_age_s: float = DEFAULT_MAX_AGE_S,
                         ) -> Tuple[Optional[_StoreTable], dict]:
@@ -338,6 +366,7 @@ def resolve_calibration(calibration=None, *,
             store = CalibrationStore(store)
         except CalibrationStoreError as e:
             logger.warning("calibration rejected: %s", e)
+            _note_rejection("unreadable", str(e), store)
             return None, {}
     bad = store.problems(max_age_s=max_age_s)
     fatal = [p for p in bad if not p.startswith("empty:")]
@@ -346,6 +375,8 @@ def resolve_calibration(calibration=None, *,
             "calibration store %s rejected: %s",
             store.path or "<memory>", "; ".join(fatal)
         )
+        _note_rejection(_rejection_reason(fatal[0]), "; ".join(fatal),
+                        store.path or "<memory>")
         return None, {}
     if not store.ops:
         if store.globals:

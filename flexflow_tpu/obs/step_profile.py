@@ -645,7 +645,15 @@ def publish_step_profile(tel, model, profile: StepProfile) -> None:
         profile.write_calibration(tel.calibration)
     out = os.path.join(tel.config.dir, OVERLAY_FILE)
     try:
-        export_overlay(profile, model, out)
+        # strategy-swap boundary instants (runtime/tuner.py): global
+        # (s="g") markers drawn across the whole overlay. Their wall-clock
+        # timestamps share no base with the profiler events, so they are
+        # rebased to the overlay origin in commit order — the marker (and
+        # its step/fingerprint args) is the signal, not its offset.
+        swaps = list(getattr(model, "_strategy_swap_overlay_events",
+                             None) or [])
+        swaps = [dict(e, ts=float(i)) for i, e in enumerate(swaps)]
+        export_overlay(profile, model, out, extra_events=swaps)
     except Exception as e:  # fflint: disable=FFL002 — export must not kill training
         logger.warning("step-profile overlay export failed: %s", e)
 
@@ -741,11 +749,13 @@ def load_bench_history(src: str = ".") -> List[dict]:
         out.append({
             "round": int(m.group(1)) if m else doc.get("n"),
             "path": p,
+            "metric": parsed.get("metric"),
             "value": parsed.get("value"),
             "unit": parsed.get("unit"),
             "phases": parsed.get("phases_s_per_step"),
             "n_chips": parsed.get("n_chips"),
             "backend": parsed.get("backend"),
+            "smoke": parsed.get("smoke"),
             "jax_version": parsed.get("jax_version"),
         })
     out.sort(key=lambda r: (r["round"] is None, r["round"]))
@@ -754,11 +764,22 @@ def load_bench_history(src: str = ".") -> List[dict]:
 
 def bench_regression_attribution(history: List[dict],
                                  *, tolerance: float = 0.05) -> dict:
-    """Newest round vs the previous one, with the regression attributed
+    """Newest round vs the previous one OF THE SAME SERIES (metric +
+    backend — rounds predating those fields count as the transformer
+    series on the driver's axon tier), with the regression attributed
     per phase: each phase's seconds delta and its share of the total
     step-time change. Phases are only attributable when both rounds
     carry phases_s_per_step."""
     rounds = [r for r in history if r.get("value") is not None]
+    if rounds:
+        newest = rounds[-1]
+        rounds = [
+            r for r in rounds
+            if (r.get("metric") or "transformer_train_throughput")
+            == (newest.get("metric") or "transformer_train_throughput")
+            and (r.get("backend") or "axon")
+            == (newest.get("backend") or "axon")
+        ]
     if len(rounds) < 2:
         return {"status": "insufficient_history", "rounds": len(rounds)}
     prev, cur = rounds[-2], rounds[-1]

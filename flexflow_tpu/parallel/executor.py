@@ -640,6 +640,19 @@ class PCGExecutor:
         self._step_dur_ema = (dur_s if ema is None
                               else 0.5 * ema + 0.5 * dur_s)
 
+    @property
+    def step_dur_ema(self) -> Optional[float]:
+        """The measured synced-step wall-time EMA (None until fed). The
+        StrategyTuner's drift watch and post-swap guard window read this
+        (runtime/tuner.py)."""
+        return getattr(self, "_step_dur_ema", None)
+
+    def reset_step_duration(self) -> None:
+        """Forget the step-time EMA. A strategy hot-swap installs a new
+        executor whose steps must not be averaged against the pre-swap
+        strategy's timings (runtime/tuner.py)."""
+        self._step_dur_ema = None
+
     def drain_window_s(self, checkpoint_s: Optional[float] = None,
                        safety: float = 2.0) -> float:
         """How much of a preemption deadline must remain for fit() to

@@ -22,6 +22,12 @@ from .resilience import (  # noqa: F401
     restore_latest,
     retry,
 )
+from .tuner import (  # noqa: F401
+    StrategyTuner,
+    SwapError,
+    TunerConfig,
+    strategy_fingerprint,
+)
 from .strategy_io import (  # noqa: F401
     apply_imported_strategy,
     export_strategy,

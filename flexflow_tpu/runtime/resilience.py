@@ -370,6 +370,21 @@ class FaultInjector:
                                ``target="disk"`` CheckpointManager.save
                                corrupts the just-written checkpoint so
                                the restore-time checksum path fires.
+      * ``swap_research_crash`` — the StrategyTuner's background
+                               re-search thread (runtime/tuner.py) dies
+                               mid-search; the cycle must end
+                               rolled_back with training untouched on
+                               the pre-swap strategy.
+      * ``swap_reshard_corruption`` — corrupts one transplanted weight
+                               after the hot-swap reshard but BEFORE the
+                               bit-exact checksum gate; the gate must
+                               catch it and the swap must roll back
+                               (``delta=`` overrides the perturbation).
+      * ``swap_regression``  — inflates the tuner's observed post-swap
+                               step durations by ``factor=`` (default
+                               10x), driving measured step time past the
+                               guard band so the post-swap rollback leg
+                               fires and the candidate is quarantined.
 
     Each injection fires `times` times, optionally only at `at_step`.
     `fire(site, step)` consumes one shot and raises `exc` when armed with

@@ -581,6 +581,21 @@ class ServingConfig:
     # decode-objective strategy while prefill keeps the train-searched
     # (compute-bound) one. Ignored when model.decode_executor exists.
     decode_strategy_path: Optional[str] = None
+    # online decode re-search (the StrategyTuner's serving leg,
+    # docs/adaptation.md): when the admitted prompt-length distribution
+    # drifts more than decode_retune_threshold (relative to the
+    # distribution observed around the last decode build) across at
+    # least decode_retune_min_admissions requests, the batcher re-runs
+    # compile_decode() between batches (active_slots == 0 only — the
+    # running batch's caches belong to the old lowering) and hot-swaps
+    # the batched decode step. The existing _decode_executor_mismatch
+    # probe vets the candidate; any incompatibility falls back to the
+    # current decode step (the rollback path), and either way the
+    # attempt lands in ff_strategy_swaps_total{leg="serving"}.
+    decode_retune: bool = False
+    decode_retune_threshold: float = 0.5
+    decode_retune_min_admissions: int = 8
+    decode_retune_cooldown_iters: int = 50
     idle_wait_s: float = 0.005
     # compile every decode executable (all prefill buckets + the batched
     # step) when the replica boots, BEFORE it takes traffic: a mid-run
@@ -964,9 +979,15 @@ class ContinuousBatcher:
         # per-token service-time EWMA drives the "cannot meet deadline"
         # early shed; warms up after the first measured iterations
         self._token_ewma_s: Optional[float] = None
+        # decode-retune drift watch: admitted prompt-length EWMA vs the
+        # distribution frozen at the last decode build (tuner serving leg)
+        self._plen_ewma: Optional[float] = None
+        self._plen_at_build: Optional[float] = None
+        self._plen_admissions = 0
+        self._retune_cooldown_until = 0
         self.stats = {"admitted": 0, "finished": 0, "iterations": 0,
                       "prefills": 0, "retired_eos": 0, "shed_decode": 0,
-                      "stranded_requeued": 0}
+                      "stranded_requeued": 0, "decode_retunes": 0}
 
     def _decode_executor_mismatch(self, dex, initB_d) -> Optional[str]:
         """None if the decode-searched lowering can serve the batched
@@ -1143,6 +1164,7 @@ class ContinuousBatcher:
         self.slots[slot_idx] = slot
         self.stats["admitted"] += 1
         self.stats["prefills"] += 1
+        self._note_admitted_plen(plen)
         self._maybe_retire(slot_idx)
         return True
 
@@ -1370,6 +1392,84 @@ class ContinuousBatcher:
                       help="in-flight requests requeued by failover")
         return requeued
 
+    # -- online decode re-search (the StrategyTuner's serving leg) -------
+    def _note_admitted_plen(self, plen: int) -> None:
+        """Feed one admission's prompt length into the drift watch. The
+        first decode_retune_min_admissions requests freeze the baseline
+        the later distribution is compared against."""
+        if not self.config.decode_retune:
+            return
+        self._plen_admissions += 1
+        self._plen_ewma = (float(plen) if self._plen_ewma is None
+                           else 0.8 * self._plen_ewma + 0.2 * float(plen))
+        if (self._plen_at_build is None and self._plen_admissions
+                >= self.config.decode_retune_min_admissions):
+            self._plen_at_build = self._plen_ewma
+
+    def _retune_wanted(self) -> bool:
+        cfg = self.config
+        if (not cfg.decode_retune
+                or self._iteration < self._retune_cooldown_until
+                or self._plen_at_build is None
+                or self._plen_ewma is None
+                or self._plen_admissions < cfg.decode_retune_min_admissions):
+            return False
+        base = max(1.0, self._plen_at_build)
+        return abs(self._plen_ewma - base) / base > cfg.decode_retune_threshold
+
+    def _retune_decode(self) -> None:
+        """Re-run the decode-objective strategy search and hot-swap the
+        batched decode step. Only called with an empty batch: the live
+        caches belong to the outgoing lowering, so they are dropped and
+        rebuilt by the next admission's _initB. Any failure keeps the
+        current decode step serving (the rollback path is the same
+        decode_fallback the boot-time selection uses); every attempt
+        lands in ff_strategy_swaps_total{leg="serving"}."""
+        from .. import obs
+        from ..parallel.decode import DecodeExactnessError, decode_fallback
+        from .tuner import SWAP_METRIC, SWAP_METRIC_HELP
+
+        cfg = self.config
+        self._retune_cooldown_until = (self._iteration
+                                       + cfg.decode_retune_cooldown_iters)
+        obs.event("decode_retune_started", cat="serving", replica=self.name,
+                  plen_ewma=round(self._plen_ewma or 0.0, 2),
+                  plen_at_build=round(self._plen_at_build or 0.0, 2))
+        outcome = "rolled_back"
+        detail = None
+        try:
+            with self._device_lock:
+                dex = self.model.compile_decode()
+                initB_d, stepB_d = dex.build_decode(
+                    cfg.slots, cfg.max_len, assume_causal=cfg.assume_causal,
+                )
+                problem = self._decode_executor_mismatch(dex, initB_d)
+                if problem is not None:
+                    detail = problem
+                    decode_fallback(self.name, "decode_retune_incompatible",
+                                    problem)
+                else:
+                    self._initB, self._stepB = initB_d, stepB_d
+                    self._caches = None  # rebuilt by the next admission
+                    self.decode_strategy_active = True
+                    outcome = "committed"
+        except DecodeExactnessError as e:
+            detail = str(e)
+            decode_fallback(self.name, "decode_retune_unbuildable", str(e))
+        except Exception as e:  # fflint: disable=FFL002 — a failed retune must not kill the replica
+            detail = str(e)
+            logger.warning("decode retune failed on %s; keeping the "
+                           "current decode strategy: %s", self.name, e)
+        # either way the drift baseline resets to the distribution the
+        # retune decision saw — no immediate re-trigger
+        self._plen_at_build = self._plen_ewma
+        self.stats["decode_retunes"] += 1
+        obs.count(SWAP_METRIC, help=SWAP_METRIC_HELP, outcome=outcome,
+                  leg="serving")
+        obs.event("decode_retune_finished", cat="serving",
+                  replica=self.name, outcome=outcome,
+                  **({"detail": detail[:200]} if detail else {}))
+
     def _serve_loop(self) -> None:
         from .. import obs
 
@@ -1393,6 +1493,9 @@ class ContinuousBatcher:
                 if self.active_slots == 0:
                     if self.draining:
                         return
+                    if self._retune_wanted():
+                        self._retune_decode()
+                        continue
                     time.sleep(self.config.idle_wait_s)
                     continue
                 it = self._iteration

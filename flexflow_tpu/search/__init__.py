@@ -5,6 +5,7 @@ from .cost_model import (  # noqa: F401
     CostMetrics,
     CostModel,
     CostObjective,
+    apply_calibration,
     op_decode_bytes,
 )
 from .dp_search import GraphCostResult, SearchHelper, research_views  # noqa: F401

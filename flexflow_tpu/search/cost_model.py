@@ -321,6 +321,33 @@ def load_default_calibration() -> Optional[dict]:
     return _DEFAULT_CALIBRATION
 
 
+def apply_calibration(cm, *, profiled=None, overlap_efficiency=None,
+                      collective_bandwidths=None):
+    """The measured-calibration refresh seam: write in-situ measurements
+    onto a CostModel in place and return it. Both compile-time oracle
+    construction (core/model.py _build_cost_model) and the online
+    re-search (runtime/tuner.py) funnel through here, so a drift-updated
+    oracle is priced exactly the way the original compile's was.
+
+    profiled: {op_cost_key: (fwd_s, bwd_s)} measured per-op seconds
+    (obs/explain.py) — serial-view costs resolve to these instead of the
+    analytic roofline. overlap_efficiency / collective_bandwidths: the
+    CalibrationStore's measured globals (step observatory write-through).
+    """
+    if profiled:
+        from ..obs.explain import attach_profiled_costs
+
+        attach_profiled_costs(cm, profiled)
+    if overlap_efficiency is not None:
+        cm.overlap_efficiency = float(overlap_efficiency)
+        cm.overlap_efficiency_source = "calibration_store"
+    if collective_bandwidths:
+        cm.calibrated_collective_bandwidths = {
+            k: float(v) for k, v in collective_bandwidths.items()
+        }
+    return cm
+
+
 class CostModel:
     """Per-(op, machine-view) cost oracle with memoization
     (reference: Simulator::measure_operator_cost's hash_map cache,

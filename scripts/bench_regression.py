@@ -62,35 +62,69 @@ def load_measurement(src):
     return doc, where
 
 
-def load_baseline(metric):
-    """Published baseline for EXACTLY this metric. A new series (the zoo
-    workloads: moe_train_throughput, longctx_train_throughput) has no
-    published number until the driver records one — the caller treats
-    that as warn-only and skips the headline gate, instead of comparing
-    a zoo workload against the transformer baseline."""
+def load_baseline(metric, backend=None, smoke=False):
+    """Published baseline for EXACTLY this metric on this hardware tier.
+    A new series (the zoo workloads: moe_train_throughput,
+    longctx_train_throughput) has no published number until the driver
+    records one — the caller treats that as warn-only and skips the
+    headline gate, instead of comparing a zoo workload against the
+    transformer baseline.
+
+    Bare published.<metric> entries belong to published.tier (the
+    driver's axon/TPU pool; rounds that predate the backend field were
+    all measured there). A round measured on another backend only gates
+    against an explicitly scoped published.<metric>@<backend> entry —
+    a CPU-session round vs a TPU baseline is a hardware difference, not
+    a regression. FF_BENCH_SMOKE rounds scope one step further
+    (<metric>@<backend>+smoke): smoke shapes amortize warmup
+    differently, so they never compare against full-run numbers."""
     try:
         with open(os.path.join(REPO, "BASELINE.json")) as f:
             published = json.load(f).get("published", {}) or {}
     except (OSError, ValueError):
         return None
+    tier = published.get("tier") or "axon"
+    if smoke:
+        v = published.get(f"{metric}@{backend or tier}+smoke")
+        if isinstance(v, (int, float)) and v > 0:
+            return float(v)
+        return None
+    if backend:
+        v = published.get(f"{metric}@{backend}")
+        if isinstance(v, (int, float)) and v > 0:
+            return float(v)
+        if backend != tier:
+            return None
     v = published.get(metric)
     if isinstance(v, (int, float)) and v > 0:
         return float(v)
     return None
 
 
-def previous_phases(where, history_dir=REPO):
+def previous_phases(where, history_dir=REPO, metric=None, backend=None,
+                    smoke=False):
     """The newest committed round OTHER than the one under test that
-    carries phases_s_per_step -> (phases dict, round label) or (None,
-    None)."""
+    carries phases_s_per_step for the SAME metric and backend ->
+    (phases dict, round label) or (None, None). Rounds that predate the
+    metric/backend fields count as transformer rounds on the driver's
+    axon tier — comparing a CPU moe round's phases against them would
+    attribute a hardware/workload difference to a code change."""
     try:
         from flexflow_tpu.obs.step_profile import load_bench_history
     except ImportError:
         return None, None
 
     history = load_bench_history(history_dir)
+    want_metric = metric or "transformer_train_throughput"
+    want_backend = backend or "axon"
     for r in reversed(history):
         if where and os.path.basename(r["path"]) == os.path.basename(where):
+            continue
+        if (r.get("metric") or "transformer_train_throughput") != want_metric:
+            continue
+        if (r.get("backend") or "axon") != want_backend:
+            continue
+        if bool(r.get("smoke")) != bool(smoke):
             continue
         if isinstance(r.get("phases"), dict):
             return r["phases"], f"r{r['round']:02d}"
@@ -143,16 +177,22 @@ def main(argv=None):
               "nothing to compare")
         return 0
     metric = doc.get("metric", "transformer_train_throughput")
+    backend = doc.get("backend")
+    smoke = bool(doc.get("smoke"))
     failures = []
 
     # ---- headline gate: throughput vs the published baseline ----------
-    baseline = load_baseline(metric)
+    baseline = load_baseline(metric, backend, smoke)
     if baseline is None:
         # absent series are warn-only, never a failure: annotate so the
         # missing baseline is visible in the Actions summary and move on
+        scope = f"{metric}@{backend}" if backend else metric
+        if smoke:
+            scope += "+smoke"
         print(f"::warning title=bench baseline::BASELINE.json has no "
-              f"published value for {metric}; headline gate skipped "
-              "(new series stay warn-only until a baseline is recorded)")
+              f"published value for {scope}; headline gate skipped "
+              "(new series stay warn-only until a baseline is recorded "
+              "on this hardware tier)")
     else:
         ratio = value / baseline
         line = (f"bench_regression: {metric} = {value:.3f} vs baseline "
@@ -169,7 +209,8 @@ def main(argv=None):
         print(f"bench_regression: {where} has no phases_s_per_step; "
               "skipping the phase gate")
     else:
-        prev, prev_label = previous_phases(where, args.history_dir)
+        prev, prev_label = previous_phases(where, args.history_dir,
+                                           metric, backend, smoke)
         if prev is None:
             print("bench_regression: no previous round carries "
                   "phases_s_per_step; skipping the phase gate")

@@ -15,7 +15,73 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+_PROBE_SRC = """
+import os
+import jax
+jax.distributed.initialize(
+    coordinator_address=os.environ["FF_PROBE_COORD"],
+    num_processes=2,
+    process_id=int(os.environ["FF_PROBE_RANK"]),
+)
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+multihost_utils.broadcast_one_to_all(jnp.ones(()))
+print("MULTIPROC_OK")
+"""
+
+_probe_result = None
+
+
+def _cpu_multiprocess_supported() -> bool:
+    """Capability probe: some jaxlib builds reject cross-process
+    collectives on CPU outright ('Multiprocess computations aren't
+    implemented on the CPU backend', dispatch.py). Run one minimal
+    2-rank broadcast; the result gates every test in this module so
+    they skip (environment capability) rather than fail where the
+    backend cannot run them at all."""
+    global _probe_result
+    if _probe_result is not None:
+        return _probe_result
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+    env.update(JAX_PLATFORMS="cpu", FF_PROBE_COORD=f"localhost:{port}")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _PROBE_SRC],
+            env=dict(env, FF_PROBE_RANK=str(rank)),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for rank in (1, 0)
+    ]
+    try:
+        outs = [p.communicate(timeout=120)[0] for p in reversed(procs)]
+        ok = all(p.returncode == 0 for p in procs) and all(
+            "MULTIPROC_OK" in o for o in outs
+        )
+    except subprocess.TimeoutExpired:
+        ok = False
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    _probe_result = ok
+    return ok
+
+
+def _require_cpu_multiprocess() -> None:
+    if not _cpu_multiprocess_supported():
+        pytest.skip(
+            "this jaxlib's CPU backend does not implement cross-process "
+            "collectives (probe: 2-rank broadcast_one_to_all failed with "
+            "the Gloo/CPU backend) — multi-host tests need a real "
+            "multi-process-capable backend"
+        )
 
 
 def _free_port() -> int:
@@ -58,6 +124,7 @@ def _run_ranks(nprocs: int, extra_env=None, timeout=560):
 
 
 def test_two_process_data_parallel_training():
+    _require_cpu_multiprocess()
     outs = _run_ranks(2)
     for p, out in outs.items():
         assert p.returncode == 0, f"rank failed:\n{out}"
@@ -69,6 +136,7 @@ def test_two_process_data_parallel_training():
 def test_three_process_data_parallel_training():
     """3 ranks (VERDICT r1 weak #8 asked for >2): batch 30 divides the
     3-device mesh; the tail 16 samples of 256 drop with a warning."""
+    _require_cpu_multiprocess()
     outs = _run_ranks(3, extra_env={"FF_TEST_BATCH": "30"})
     for p, out in outs.items():
         assert p.returncode == 0, f"rank failed:\n{out}"
@@ -81,6 +149,7 @@ def test_diverging_global_batch_fails_loudly():
     """The documented contract: every process feeds the SAME global batch.
     A rank feeding different data must die with the contract error, not
     train silently on inconsistent shards."""
+    _require_cpu_multiprocess()
     outs = _run_ranks(2, extra_env={"FF_TEST_DIVERGE": "1"})
     joined = "\n".join(outs.values())
     assert any(p.returncode != 0 for p in outs), joined
