@@ -716,6 +716,7 @@ class FFModel:
         metrics: Sequence = (),
         comp_mode: CompMode = CompMode.COMP_MODE_TRAINING,
         calibration=None,
+        artifact_store=None,
     ):
         if optimizer is not None:
             self.optimizer = optimizer
@@ -756,6 +757,30 @@ class FFModel:
             self.graph = apply_fusion(self.graph)
         self.search_trajectory.phase("lowering", _t_phase,
                                      ops=len(self.graph.ops))
+        # 1.5 Artifact cache probe (runtime/artifact_store.py): a prior
+        # compile of this exact (graph, topology, calibration) key already
+        # paid for the Unity search — replay its winner instead of
+        # re-searching. Store resolution: explicit arg > the store a
+        # previous compile attached (recompile_for_topology reuses it) >
+        # the process-ambient store (ReplicaSet wraps opaque model_fns in
+        # store.ambient()). Corrupt/stale entries degrade to a fresh
+        # search; the cause rides in strategy_provenance so
+        # restore_elastic can count redundant searches.
+        from ..runtime.artifact_store import get_ambient
+
+        store = artifact_store or getattr(self, "artifact_store", None) \
+            or get_ambient()
+        self.artifact_store = store
+        ndev = min(self.config.numWorkers, len(jax.devices()))
+        search_enabled = (self.config.search_budget >= 0
+                          and not self.config.only_data_parallel)
+        self._artifact_key = None
+        self._artifact_key_parts = None
+        _cache_entry = None
+        _research_cause = "no_store"
+        if store is not None and search_enabled:
+            _cache_entry, _research_cause = \
+                self._probe_artifact_store(store, ndev)
         self._pt_by_guid = {}
         for op in self.graph.ops:
             for t in list(op.outputs) + list(op.weights):
@@ -768,7 +793,6 @@ class FFModel:
         #      assignment, reference model.cc:2826 GRAPH_OPTIMIZE path).
         #    - else: manual degrees / pure data parallel (reference
         #      --only-data-parallel lowering).
-        ndev = min(self.config.numWorkers, len(jax.devices()))
         # Record user input order positionally BEFORE any search rewrite
         # (rewrites copy the graph with fresh tensor guids; graph input
         # order is stable under copy, so positions survive).
@@ -792,10 +816,32 @@ class FFModel:
             and self._tensor_map.get(t.guid) in pre_pos
         }
         _t_phase = time.perf_counter()
-        if self.config.search_budget >= 0 and not self.config.only_data_parallel:
+        if _cache_entry is not None:
+            # artifact-cache hit: the stored winner replayed cleanly onto
+            # the fresh lowering (degrees + views set, validators passed)
+            # — rebuild the exact searched mesh and skip the search.
+            views, mesh_axes, cost = _cache_entry
+            self.searched_views = views
+            self.searched_cost = cost
+            if int(mesh_axes.get("pipe", 1)) > 1:
+                self.searched_pipeline_degree = int(mesh_axes["pipe"])
+            mesh = build_mesh(mesh_axes)
+            self.strategy_provenance = {
+                "source": "artifact_cache",
+                "key": dict(self._artifact_key),
+                "cost": cost,
+            }
+            self.search_trajectory.phase("strategy_cache_hit", _t_phase,
+                                         devices=ndev,
+                                         ops=len(self.graph.ops))
+        elif search_enabled:
             mesh = self._run_strategy_search(ndev)
+            self.strategy_provenance = {"source": "search",
+                                        "cause": _research_cause}
             self.search_trajectory.phase("strategy_search", _t_phase,
                                          devices=ndev)
+            if store is not None and self._artifact_key is not None:
+                self._artifact_store_put(store, mesh)
         else:
             tp = max(1, self.config.tensor_parallel_degree)
             sp = max(1, self.config.sequence_parallel_degree)
@@ -828,6 +874,7 @@ class FFModel:
             if fsdp > 1:
                 strategies.apply_weight_sharding(self.graph, fsdp,
                                                  axis_idx=5)
+            self.strategy_provenance = {"source": "manual"}
             self.search_trajectory.phase(
                 "manual_lowering", _t_phase, devices=ndev,
                 data=dp, model=tp, seq=sp, expert=ep, pipe=pp, fsdp=fsdp,
@@ -1096,6 +1143,105 @@ class FFModel:
         )
         self.decode_trajectory.phase("decode_executor_build", _t_phase)
         return self.decode_executor
+
+    def _probe_artifact_store(self, store, ndev: int):
+        """Look up + replay a stored strategy for the current lowering.
+
+        Returns `((views, mesh_axes, cost), None)` on a usable hit, else
+        `(None, cause)` where cause names why a search still runs
+        ("cache_miss" / "cache_corrupt" — both feed
+        ff_elastic_research_total). A replay that fails partway has
+        already mutated tensor degrees, so the stale path re-lowers
+        self.graph fresh before handing it to the search. Store failures
+        of any kind degrade to a fresh search — a poisoned cache is
+        never worse than no cache."""
+        from ..runtime.artifact_store import (
+            ArtifactCorruptionError,
+            calibration_fingerprint,
+            graph_fingerprint,
+            make_key,
+            replay_strategy,
+            topology_digest,
+        )
+        from ..runtime.elastic import topology_fingerprint
+        from ..runtime.strategy_io import StrategyImportError
+
+        parts = {
+            "graph": graph_fingerprint(self.graph),
+            "topology": topology_digest(topology_fingerprint()),
+            "calibration": calibration_fingerprint(
+                getattr(self, "_profiled_op_costs", None),
+                getattr(self, "_calibration_globals", None),
+            ),
+        }
+        key = make_key(objective="train", num_devices=ndev, **parts)
+        self._artifact_key_parts = parts
+        self._artifact_key = key
+        try:
+            payload = store.get(key)
+        except ArtifactCorruptionError:
+            return None, "cache_corrupt"
+        except Exception as e:
+            warnings.warn(
+                f"artifact store lookup failed ({e!r}); falling back to "
+                "a fresh search"
+            )
+            return None, "cache_corrupt"
+        if payload is None:
+            return None, "cache_miss"
+        try:
+            # replay rebuilds the searched PCG around this lowering's
+            # compute ops (search-inserted parallel ops reconstructed,
+            # sharding state restored per dim) — the rebuilt graph
+            # REPLACES the fresh lowering, exactly as a search would
+            graph2, views, mesh_axes, cost = replay_strategy(
+                self.graph, payload, num_devices=ndev)
+            self.graph = graph2
+            return (views, mesh_axes, cost), None
+        except StrategyImportError as e:
+            warnings.warn(
+                f"artifact store entry could not be replayed ({e}); "
+                "quarantining it and falling back to a fresh search"
+            )
+            try:
+                store.note_stale(key, str(e))
+            except Exception as qe:
+                warnings.warn(
+                    f"artifact store could not quarantine the stale "
+                    f"entry ({qe!r}); the fresh search proceeds anyway"
+                )
+            # the failed replay mutated tensor degrees in place — the
+            # search must start from an unmutated lowering
+            self.graph, self._tensor_map = layers_to_pcg(self.layers)
+            if self.config.perform_fusion:
+                from ..pcg.fusion import apply_fusion
+
+                self.graph = apply_fusion(self.graph)
+            return None, "cache_miss"
+
+    def _artifact_store_put(self, store, mesh) -> None:
+        """Write the freshly searched winner through to the artifact
+        store under the key _probe_artifact_store computed. Never fails
+        the compile — the strategy is already in hand."""
+        from ..runtime.artifact_store import strategy_payload
+
+        try:
+            mesh_axes = {
+                str(name): int(size)
+                for name, size in zip(mesh.axis_names, mesh.devices.shape)
+            }
+            store.put(self._artifact_key, strategy_payload(
+                self.graph,
+                getattr(self, "searched_views", None),
+                cost=getattr(self, "searched_cost", None),
+                mesh_axes=mesh_axes,
+                provenance={"writer": "compile"},
+            ))
+        except Exception as e:
+            warnings.warn(
+                f"artifact store write failed ({e!r}); continuing "
+                "without caching the strategy"
+            )
 
     def _build_cost_model(self, objective: str = "train"):
         """The cost oracle for stage planning (and the search): the
@@ -2158,6 +2304,11 @@ class FFModel:
                     tuner if isinstance(tuner, _TunerCfg) else _TunerCfg(),
                     fault_injector=fault_injector,
                 )
+            # persisted quarantines (runtime/artifact_store.py): a
+            # candidate rolled back by a previous process is never
+            # re-proposed; committed winners write through for reuse
+            tuner_obj.attach_artifact_store(
+                getattr(self, "artifact_store", None))
             self._tuner = tuner_obj
 
         # the canary re-executes steps from the pre-step state, which

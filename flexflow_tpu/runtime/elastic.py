@@ -243,6 +243,27 @@ def restore_elastic(model_fn: Callable[[], "FFModel"], ckpt_dir: str,
     import jax
 
     ndev = len(jax.devices())
+    # Redundant-search observability (ROADMAP item 4): a restore that
+    # paid for a from-scratch strategy search is exactly what the
+    # artifact store (runtime/artifact_store.py) exists to eliminate —
+    # count it with why, so an 8->4->8 cycle can assert zero. compile()
+    # records the cause in strategy_provenance: no store attached, a
+    # cache miss, or a corrupt/stale entry that degraded to fresh
+    # search. "manual" and "artifact_cache" sources never searched, so
+    # they don't count.
+    prov = getattr(model, "strategy_provenance", None) or {}
+    if prov.get("source") == "search":
+        from .. import obs
+
+        cause = prov.get("cause", "no_store")
+        obs.event("elastic_research", cat="runtime", cause=cause,
+                  devices=ndev)
+        obs.count(
+            "ff_elastic_research_total",
+            help="from-scratch strategy searches during elastic restore, "
+                 "by cause (cache_miss|cache_corrupt|no_store)",
+            cause=cause,
+        )
     bad = validate_machine_views(getattr(model, "searched_views", None) or {},
                                  ndev)
     if bad:

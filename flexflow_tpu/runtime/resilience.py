@@ -385,6 +385,18 @@ class FaultInjector:
                                10x), driving measured step time past the
                                guard band so the post-swap rollback leg
                                fires and the candidate is quarantined.
+      * ``artifact_corruption`` — an ArtifactStore.get
+                               (runtime/artifact_store.py) treats the
+                               existing entry as corrupt: it is
+                               quarantined, counted under
+                               ff_artifact_cache_total{event=corrupt}
+                               and the typed ArtifactCorruptionError is
+                               raised — compile() must degrade to a
+                               fresh search.
+      * ``artifact_stale``   — an ArtifactStore.get treats the existing
+                               entry as fingerprint-stale: quarantined,
+                               counted under event=stale and returned
+                               as a miss (fresh search, no error).
 
     Each injection fires `times` times, optionally only at `at_step`.
     `fire(site, step)` consumes one shot and raises `exc` when armed with
@@ -492,7 +504,9 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def clean_stale_tmp(self) -> None:
-        """Drop half-written tmp dirs/files left by a kill mid-save."""
+        """Drop half-written tmp dirs/files left by a kill mid-save or
+        mid-GC, and orphan ``step_N.meta.json`` sidecars whose checkpoint
+        dir is gone (a crash between _gc's dir-prune and sidecar-prune)."""
         try:
             names = os.listdir(self.directory)
         except OSError:
@@ -506,6 +520,17 @@ class CheckpointManager:
                         os.remove(p)
                     except OSError:
                         pass
+        for name in names:
+            if not name.endswith(".meta.json"):
+                continue
+            base = name[: -len(".meta.json")]
+            if _STEP_DIR_RE.match(base) and not os.path.isdir(
+                os.path.join(self.directory, base)
+            ):
+                try:
+                    os.remove(os.path.join(self.directory, name))
+                except OSError:
+                    pass
 
     # -- save / restore -------------------------------------------------
     def save(self, model, step: int, extra_meta: Optional[dict] = None) -> str:
@@ -598,14 +623,40 @@ class CheckpointManager:
         os.replace(tmp, p)
 
     def _gc(self) -> None:
+        """Prune checkpoints past keep_last_n (newest-by-step kept) —
+        but NEVER the step LATEST names: an elastic rollback-resume can
+        save a LOWER step than the on-disk history, and pruning it by
+        step order would leave the just-written pointer naming a deleted
+        checkpoint. Each prune renames the dir and its sidecar to
+        ``.tmp-gc-*`` names FIRST and deletes those, so a crash
+        mid-prune leaves only tmp litter or an orphan sidecar — both
+        swept by clean_stale_tmp on the next boot — never a
+        half-deleted checkpoint that restore would trust."""
         steps = self.list_steps()
-        for s in steps[: -self.keep_last_n]:
+        keep = set(steps[-self.keep_last_n:])
+        latest = self.latest_step()
+        if latest is not None:
+            keep.add(latest)
+        for s in steps:
+            if s in keep:
+                continue
             path = self.step_path(s)
-            shutil.rmtree(path, ignore_errors=True)
+            tmp = f"{path}.tmp-gc-{os.getpid()}"
             try:
-                os.remove(path + ".meta.json")
+                os.replace(path, tmp)
             except OSError:
-                pass
+                continue
+            meta_tmp = f"{tmp}.meta.json"
+            try:
+                os.replace(path + ".meta.json", meta_tmp)
+            except OSError:
+                meta_tmp = None
+            shutil.rmtree(tmp, ignore_errors=True)
+            if meta_tmp is not None:
+                try:
+                    os.remove(meta_tmp)
+                except OSError:
+                    pass
 
 
 def restore_latest(model, directory: str,

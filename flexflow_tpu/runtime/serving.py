@@ -1591,9 +1591,16 @@ class ReplicaSet:
                  warm_spares: int = 0,
                  scale_up_queue_depth: Optional[int] = None,
                  scale_down_idle_s: float = 10.0,
-                 autoscale_interval_s: float = 0.25):
+                 autoscale_interval_s: float = 0.25,
+                 artifact_store=None):
         self.model_fn = model_fn
         self.config = config
+        # strategy/artifact store (runtime/artifact_store.py): every
+        # replica/spare build runs under store.ambient(), so the opaque
+        # model_fn's compile() reuses searched strategies — warm spares
+        # and autoscaler scale-ups boot from the store instead of
+        # re-searching
+        self.artifact_store = artifact_store
         self.min_replicas = max(1, replicas)
         self.max_replicas = max(self.min_replicas, max_replicas or replicas)
         self.ckpt_dir = ckpt_dir
@@ -1637,7 +1644,8 @@ class ReplicaSet:
 
         self.latency = Histogram(threading.Lock())
         self.stats = {"submitted": 0, "requeued": 0, "restarts": 0,
-                      "spares_used": 0, "scale_ups": 0, "scale_downs": 0}
+                      "spares_used": 0, "scale_ups": 0, "scale_downs": 0,
+                      "cold_start_s": []}
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ReplicaSet":
@@ -1649,7 +1657,11 @@ class ReplicaSet:
         # iteration — finds them ready and activation costs only a
         # checkpoint restore
         for i in range(self.warm_spares):
-            batcher = self._new_batcher(self.model_fn(), name=f"spare{i}")
+            t0 = time.perf_counter()
+            with self._store_scope():
+                model = self.model_fn()
+            self.stats["cold_start_s"].append(time.perf_counter() - t0)
+            batcher = self._new_batcher(model, name=f"spare{i}")
             batcher._warmup_compiles()
             with self._lock:
                 self._spares.append(batcher)
@@ -1697,19 +1709,35 @@ class ReplicaSet:
             rep.monitor.stop()
 
     # -- replica management ---------------------------------------------
-    def _build_model(self, *, elastic: bool):
-        with self._device_lock:
-            if elastic and self.ckpt_dir is not None:
-                from .elastic import ElasticRestoreError, restore_elastic
+    def _store_scope(self):
+        """The ambient-store context every replica/spare build runs
+        under — a no-op without a store."""
+        if self.artifact_store is not None:
+            return self.artifact_store.ambient()
+        import contextlib
 
-                try:
-                    model, _info = restore_elastic(self.model_fn,
-                                                   self.ckpt_dir,
-                                                   verbose=False)
-                    return model
-                except ElasticRestoreError:
-                    pass  # no restorable checkpoint: fresh build below
-            return self.model_fn()
+        return contextlib.nullcontext()
+
+    def _build_model(self, *, elastic: bool):
+        t0 = time.perf_counter()
+        try:
+            with self._device_lock, self._store_scope():
+                if elastic and self.ckpt_dir is not None:
+                    from .elastic import ElasticRestoreError, restore_elastic
+
+                    try:
+                        model, _info = restore_elastic(self.model_fn,
+                                                       self.ckpt_dir,
+                                                       verbose=False)
+                        return model
+                    except ElasticRestoreError:
+                        pass  # no restorable checkpoint: fresh build below
+                return self.model_fn()
+        finally:
+            # replica cold-start latency (build + compile + restore):
+            # scripts/load_check.py reads the p95 to show the artifact
+            # store shortening kill-mid-ramp recovery
+            self.stats["cold_start_s"].append(time.perf_counter() - t0)
 
     def _new_batcher(self, model,
                      name: Optional[str] = None) -> ContinuousBatcher:

@@ -228,6 +228,93 @@ class StrategyTuner:
         self._post_ema: Optional[float] = None
         self._pre_swap_ema: Optional[float] = None
         self._regress_factor: Optional[float] = None
+        # artifact-store plumbing (runtime/artifact_store.py): quarantined
+        # fingerprints persist across process restarts and committed
+        # winners are written through for fleet-wide reuse
+        self._artifact_store = None
+        self._quarantine_scope: Optional[str] = None
+
+    # ------------------------------------------------------------------
+    # artifact store: persisted quarantines + winner write-through
+    # ------------------------------------------------------------------
+    def attach_artifact_store(self, store) -> None:
+        """Load the persisted quarantine set for this (graph, topology)
+        scope and keep the store for write-through. A rolled-back
+        candidate quarantined by a PREVIOUS process is then never
+        re-proposed after a restart. No-op without a store."""
+        if store is None:
+            return
+        self._artifact_store = store
+        parts = getattr(self.model, "_artifact_key_parts", None)
+        if not parts:
+            # manual lowering / no compile probe: derive the scope the
+            # same way compile() would
+            try:
+                from .artifact_store import (
+                    graph_fingerprint,
+                    topology_digest,
+                )
+                from .elastic import topology_fingerprint
+
+                parts = {
+                    "graph": graph_fingerprint(self.model.graph),
+                    "topology": topology_digest(topology_fingerprint()),
+                }
+            except Exception:
+                return
+        # calibration deliberately excluded: a re-measured machine does
+        # not un-poison a strategy the guard window rejected
+        self._quarantine_scope = hashlib.sha1(
+            f"{parts['graph']}|{parts['topology']}".encode()
+        ).hexdigest()[:20]
+        try:
+            persisted = store.load_quarantine(self._quarantine_scope)
+        except Exception as e:
+            logger.warning("tuner: could not load persisted quarantines "
+                           "(%r); starting from the in-memory set", e)
+            return
+        if persisted:
+            logger.info("tuner: honoring %d persisted quarantine "
+                        "fingerprint(s)", len(persisted))
+        self.quarantined |= persisted
+
+    def _quarantine(self, fp: str) -> None:
+        """Quarantine a fingerprint in memory AND through the store, so
+        the decision survives a process restart."""
+        self.quarantined.add(fp)
+        if self._artifact_store is not None and self._quarantine_scope:
+            try:
+                self._artifact_store.add_quarantine(self._quarantine_scope,
+                                                    [fp])
+            except Exception as e:
+                logger.warning("tuner: failed to persist quarantine %s "
+                               "(%r)", fp, e)
+
+    def _write_through_winner(self) -> None:
+        """A committed swap IS a fresh search result the whole fleet can
+        reuse: write it through under compile()'s key so the next boot
+        replays the tuned strategy instead of the original winner."""
+        store = self._artifact_store
+        key = getattr(self.model, "_artifact_key", None)
+        if store is None or key is None:
+            return
+        try:
+            from .artifact_store import strategy_payload
+
+            mesh = self.model.executor.mesh
+            mesh_axes = {
+                str(name): int(size)
+                for name, size in zip(mesh.axis_names, mesh.devices.shape)
+            }
+            store.put(key, strategy_payload(
+                self.model.graph,
+                getattr(self.model, "searched_views", None),
+                cost=getattr(self.model, "searched_cost", None),
+                mesh_axes=mesh_axes,
+                provenance={"writer": "tuner", "leg": self.leg},
+            ))
+        except Exception as e:
+            logger.warning("tuner: winner write-through failed (%r)", e)
 
     # ------------------------------------------------------------------
     # watch
@@ -447,7 +534,7 @@ class StrategyTuner:
             current_weight_ops=set(model.state.params.keys()),
         )
         if problems:
-            self.quarantined.add(fp)
+            self._quarantine(fp)
             self._finish_cycle(step, "quarantined", reason="lint",
                                fingerprint=fp, detail="; ".join(problems[:3]))
             return False
@@ -470,7 +557,7 @@ class StrategyTuner:
                   fingerprint=fp, win=round(win, 4),
                   cur_sim_s=cur_sim, cand_sim_s=cand_sim)
         if win < self.cfg.min_win:
-            self.quarantined.add(fp)
+            self._quarantine(fp)
             self._finish_cycle(step, "quarantined", reason="below_min_win",
                                fingerprint=fp, win=round(win, 4))
             return False
@@ -634,7 +721,7 @@ class StrategyTuner:
             # the live executor/state were never touched — just discard
             logger.warning("tuner: swap aborted, keeping pre-swap "
                            "strategy: %s", e)
-            self.quarantined.add(fp)
+            self._quarantine(fp)
             self._finish_cycle(step, "rolled_back", reason="swap_failed",
                                fingerprint=fp, detail=str(e))
             return False
@@ -747,6 +834,7 @@ class StrategyTuner:
         pre = self._preswap
         self._preswap = None
         self._regress_factor = None
+        self._write_through_winner()
         self._finish_cycle(
             step, "committed",
             fingerprint=strategy_fingerprint(self.model.graph,
@@ -765,7 +853,7 @@ class StrategyTuner:
         self._preswap = None
         self._regress_factor = None
         bad_fp = strategy_fingerprint(model.graph, model.searched_views)
-        self.quarantined.add(bad_fp)
+        self._quarantine(bad_fp)
         from .verify import _host_params
 
         host_params = _host_params(model.state.params)

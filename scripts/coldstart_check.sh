@@ -1,0 +1,146 @@
+#!/usr/bin/env bash
+# Fleet cold-start sweep (docs/artifact_cache.md): the persistent
+# strategy/artifact store makes a replica boot a cache lookup instead of
+# a from-scratch Unity search.
+#
+#   leg 1  tests/test_artifact_store.py full suite (including the
+#          @pytest.mark.slow 8->4->8 zero-redundant-search story tier-1
+#          skips) on 8- and 4-device CPU meshes
+#   leg 2  populate -> kill -> cold-boot: one process compiles with the
+#          store and exits; a SECOND process (true cold start) must
+#          replay the cached strategy with zero searches. Then the
+#          corrupt-entry chaos leg: a bit-flipped entry must degrade to
+#          a fresh search (typed + quarantined + counted), never crash.
+#   leg 3  load_check kill-mid-ramp cold-start p95 WITHOUT the store vs
+#          WITH it — both printed; the with-store p95 must be lower.
+#
+#   scripts/coldstart_check.sh                 # full sweep
+#   FF_COLDSTART_DEVICES=8 scripts/coldstart_check.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+devices="${FF_COLDSTART_DEVICES:-8 4}"
+for n in $devices; do
+    echo "=== artifact store suite: ${n}-device CPU mesh ==="
+    env JAX_PLATFORMS=cpu \
+        JAX_NUM_CPU_DEVICES="$n" \
+        XLA_FLAGS="--xla_force_host_platform_device_count=$n" \
+        python -m pytest tests/test_artifact_store.py -v \
+        -p no:cacheprovider "$@"
+done
+
+OUT="$(mktemp -d)"
+trap 'rm -rf "$OUT"' EXIT
+
+coldboot() {  # $1 = mode: populate | coldboot | corrupt
+    env JAX_PLATFORMS=cpu \
+        JAX_NUM_CPU_DEVICES=8 \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        FF_COLDSTART_DIR="$OUT/store" \
+        FF_COLDSTART_MODE="$1" \
+        python - <<'EOF'
+import os
+import sys
+
+import numpy as np
+
+from flexflow_tpu import (ActiMode, DataType, FFConfig, FFModel, LossType,
+                          MetricsType, SGDOptimizer)
+from flexflow_tpu.core.model import FFModel as _FF
+from flexflow_tpu.runtime.artifact_store import ArtifactStore
+
+mode = os.environ["FF_COLDSTART_MODE"]
+store = ArtifactStore(os.environ["FF_COLDSTART_DIR"])
+
+searches = []
+orig = _FF._run_strategy_search
+_FF._run_strategy_search = lambda self, n: (searches.append(n),
+                                            orig(self, n))[1]
+
+if mode == "corrupt":
+    # bit-flip every entry: the cold boot below must degrade to a fresh
+    # search with the poison quarantined and counted — never crash,
+    # never a wrong strategy
+    for name in store.entries():
+        path = os.path.join(store.entries_dir, name)
+        raw = bytearray(open(path, "rb").read())
+        raw[len(raw) // 2] ^= 0x20
+        open(path, "wb").write(bytes(raw))
+
+cfg = FFConfig()
+cfg.batch_size = 32
+cfg.search_budget = 20
+m = FFModel(cfg)
+x = m.create_tensor((32, 4), DataType.DT_FLOAT)
+t = m.dense(x, 16, ActiMode.AC_MODE_RELU)
+t = m.dense(t, 3)
+t = m.softmax(t)
+m.compile(SGDOptimizer(lr=0.1),
+          LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+          [MetricsType.METRICS_ACCURACY], artifact_store=store)
+rng = np.random.RandomState(0)
+m.fit(x=[rng.randn(64, 4).astype(np.float32)],
+      y=rng.randint(0, 3, (64, 1)).astype(np.int32),
+      epochs=1, verbose=False)
+
+prov = m.strategy_provenance
+print(f"[coldstart_check] {mode}: provenance={prov} "
+      f"searches={len(searches)} counts={store.counts}", file=sys.stderr)
+if mode == "populate":
+    assert prov["cause"] == "cache_miss" and len(searches) == 1, prov
+    assert store.entries(), "populate wrote no entry"
+elif mode == "coldboot":
+    assert prov["source"] == "artifact_cache", \
+        f"cold boot re-searched: {prov}"
+    assert searches == [], f"cold boot ran {len(searches)} search(es)"
+    assert store.counts.get("hit") == 1, store.counts
+elif mode == "corrupt":
+    assert prov == {"source": "search", "cause": "cache_corrupt"}, prov
+    assert len(searches) == 1
+    assert store.counts.get("corrupt", 0) >= 1, store.counts
+    import glob
+    q = glob.glob(os.path.join(store.quarantine_dir, "*.corrupt-*"))
+    assert q, "corrupt entry was not quarantined"
+EOF
+}
+
+echo "=== cold start: populate -> kill -> cold boot ==="
+coldboot populate
+coldboot coldboot
+echo "=== cold start: corrupt-entry chaos leg ==="
+coldboot corrupt
+
+echo "=== load_check cold-start p95: without vs with store ==="
+# a real search budget so replica builds are search-dominated — the
+# thing the store exists to skip; short phases keep CI wall clock sane.
+# p99 is relaxed: search-dominated rebuilds intentionally steal CPU from
+# the batcher here (this leg asserts the cold-start p95 criterion; the
+# tail-latency contract is serving_check.sh's, under its own args)
+LOAD_ARGS="--search-budget 20 --warm-s 2 --ramp-s 3 --post-s 2 \
+    --base-rate 4 --ramp 4 --p99-factor 10"
+env JAX_PLATFORMS=cpu JAX_NUM_CPU_DEVICES=8 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/load_check.py $LOAD_ARGS --json "$OUT/without.json"
+env JAX_PLATFORMS=cpu JAX_NUM_CPU_DEVICES=8 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/load_check.py $LOAD_ARGS --json "$OUT/with.json" \
+    --artifact-store "$OUT/load_store"
+python - "$OUT/without.json" "$OUT/with.json" <<'EOF'
+import json
+import sys
+
+without = json.load(open(sys.argv[1]))["cold_start"]
+with_ = json.load(open(sys.argv[2]))["cold_start"]
+print(f"[coldstart_check] replica cold-start p95: "
+      f"without store {without['p95_s']}s "
+      f"({without['builds']} builds) vs "
+      f"with store {with_['p95_s']}s "
+      f"({with_['builds']} builds, cache {with_['cache_counts']})")
+assert with_["cache_counts"]["hit"] >= 1, with_
+assert with_["p95_s"] < without["p95_s"], (
+    f"store did not lower cold-start p95: {with_['p95_s']}s vs "
+    f"{without['p95_s']}s"
+)
+EOF
+
+echo "coldstart_check: OK"

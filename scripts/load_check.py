@@ -243,6 +243,12 @@ def main():
                     help="run under a telemetry session writing to this "
                          "dir and verify the request flight recorder "
                          "(criterion 4)")
+    ap.add_argument("--artifact-store", type=str, default=None,
+                    help="persistent strategy store dir "
+                         "(runtime/artifact_store.py): replica/spare "
+                         "builds boot from cached strategies; adds the "
+                         "cold-start criterion — at least one cache hit, "
+                         "no corrupt entries (docs/artifact_cache.md)")
     ap.add_argument("--request-sample-rate", type=float, default=1.0,
                     help="head-based request trace sampling rate for the "
                          "telemetry session")
@@ -271,6 +277,14 @@ def main():
               f"(request_sample_rate={args.request_sample_rate})",
               file=sys.stderr)
 
+    store = None
+    if args.artifact_store:
+        from flexflow_tpu.runtime.artifact_store import ArtifactStore
+
+        store = ArtifactStore(args.artifact_store)
+        print(f"[load_check] artifact store -> {args.artifact_store} "
+              f"({len(store.entries())} entries)", file=sys.stderr)
+
     fi = FaultInjector()
     cfg = ServingConfig(
         max_len=args.max_len, slots=args.slots, page_size=args.page_size,
@@ -287,6 +301,7 @@ def main():
         # in-process rebuild — on the shared-core CPU harness a rebuild's
         # strategy search would starve the surviving replicas mid-ramp
         warm_spares=1,
+        artifact_store=store,
     ).start()
 
     # jit warmup: run a few requests through every replica so the decode
@@ -383,6 +398,15 @@ def main():
         "run_seconds": round(t_run, 2),
         "replica_stats": rs.aggregate_stats(),
     }
+    cold = rs.stats["cold_start_s"]
+    summary["cold_start"] = {
+        "builds": len(cold),
+        "p95_s": round(float(np.percentile(cold, 95)), 4) if cold
+        else None,
+        "max_s": round(max(cold), 4) if cold else None,
+        "artifact_store": bool(store),
+        "cache_counts": dict(store.counts) if store else None,
+    }
 
     failures = []
     # criterion 1: bounded tail latency for admitted requests
@@ -412,6 +436,19 @@ def main():
             failures.append(
                 f"replica strength {rs.replica_count()} < "
                 f"{args.replicas} at end"
+            )
+    # cold-start criterion (with --artifact-store): replica builds hit
+    # the strategy cache instead of re-searching, and nothing corrupted
+    if store is not None:
+        if store.counts.get("hit", 0) < 1:
+            failures.append(
+                "artifact store attached but no replica build hit the "
+                f"strategy cache (counts: {store.counts})"
+            )
+        if store.counts.get("corrupt", 0):
+            failures.append(
+                f"artifact store reported {store.counts['corrupt']} "
+                "corrupt entr(ies) during the run"
             )
 
     rs.stop()

@@ -1,0 +1,543 @@
+"""Artifact-store tests (runtime/artifact_store.py): the persistent
+strategy/artifact cache that makes fleet cold-start a lookup instead of a
+re-search, plus the CheckpointManager retention fixes that rode in the
+same PR.
+
+Covered: envelope round-trip, fingerprint-mismatch (stale) rejection,
+truncated/bit-flipped entries raising the typed ArtifactCorruptionError
+and compile() degrading to a fresh search, the concurrent two-writer
+race, bounded LRU retention, tuner quarantine persistence across
+"process restarts" (fresh tuner instances), FaultInjector chaos sites,
+and — @pytest.mark.slow — the 8->4->8 elastic story performing ZERO
+redundant searches (scripts/coldstart_check.sh re-runs it standalone).
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    ActiMode,
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+    obs,
+)
+from flexflow_tpu.obs import TelemetryConfig
+from flexflow_tpu.runtime.artifact_store import (
+    ArtifactCorruptionError,
+    ArtifactStore,
+    graph_fingerprint,
+    make_key,
+)
+from flexflow_tpu.runtime.resilience import CheckpointManager, FaultInjector
+
+import jax  # noqa: E402  (conftest configured the platform already)
+
+NDEV = len(jax.devices())
+needs8 = pytest.mark.skipif(
+    NDEV != 8, reason="encodes the 8-device tier-1 mesh"
+)
+
+
+def small_model(store=None, budget=20, hidden=16, batch=32):
+    cfg = FFConfig()
+    cfg.batch_size = batch
+    cfg.search_budget = budget
+    m = FFModel(cfg)
+    x = m.create_tensor((batch, 4), DataType.DT_FLOAT)
+    t = m.dense(x, hidden, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, 3)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.1, momentum=0.9),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY], artifact_store=store)
+    return m
+
+
+def dataset(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = rng.randint(0, 3, (n, 1)).astype(np.int32)
+    return x, y
+
+
+def count_searches(monkeypatch):
+    """Instrument _run_strategy_search; returns the call list."""
+    calls = []
+    orig = FFModel._run_strategy_search
+
+    def spy(self, ndev):
+        calls.append(ndev)
+        return orig(self, ndev)
+
+    monkeypatch.setattr(FFModel, "_run_strategy_search", spy)
+    return calls
+
+
+# ---------------------------------------------------------------------------
+# envelope: round-trip, integrity, staleness
+# ---------------------------------------------------------------------------
+def test_round_trip(tmp_path):
+    st = ArtifactStore(str(tmp_path))
+    k = make_key(graph="g", topology="t", calibration="c", num_devices=8)
+    assert st.get(k) is None  # miss
+    st.put(k, {"kind": "strategy", "ops": [], "mesh_axes": {"data": 8}})
+    got = st.get(k)
+    assert got["mesh_axes"] == {"data": 8}
+    # a different key component misses without touching the entry
+    k2 = make_key(graph="g", topology="t", calibration="OTHER",
+                  num_devices=8)
+    assert st.get(k2) is None
+    assert st.get(k) is not None
+
+
+def test_fingerprint_mismatch_rejected(tmp_path):
+    """An entry whose recorded key disagrees with the requested one (a
+    tampered/misfiled file) is quarantined as stale and read as a miss,
+    never returned."""
+    st = ArtifactStore(str(tmp_path))
+    k = make_key(graph="g", topology="t", calibration="c", num_devices=8)
+    path = st.put(k, {"payload": True})
+    # rewrite the envelope claiming a different key, crc intact
+    env = json.load(open(path))
+    env["key"]["graph"] = "someone-else"
+    json.dump(env, open(path, "w"))
+    assert st.get(k) is None
+    assert not os.path.exists(path)  # quarantined, not left in place
+    assert os.listdir(st.quarantine_dir)
+
+
+@pytest.mark.parametrize("damage", ["truncate", "bitflip", "not_json"])
+def test_corrupt_entry_typed_error(tmp_path, damage):
+    st = ArtifactStore(str(tmp_path))
+    k = make_key(graph="g", topology="t", calibration="c", num_devices=8)
+    path = st.put(k, {"ops": list(range(50))})
+    if damage == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(40)
+    elif damage == "bitflip":
+        raw = bytearray(open(path, "rb").read())
+        raw[len(raw) // 2] ^= 0x40
+        open(path, "wb").write(bytes(raw))
+    else:
+        open(path, "w").write("definitely { not json")
+    with pytest.raises(ArtifactCorruptionError):
+        st.get(k)
+    # quarantined: the poisoned entry can never be read again
+    assert not os.path.exists(path)
+    assert st.get(k) is None
+
+
+def test_newer_schema_rejected(tmp_path):
+    st = ArtifactStore(str(tmp_path))
+    k = make_key(graph="g", topology="t", calibration="c", num_devices=8)
+    path = st.put(k, {"x": 1})
+    env = json.load(open(path))
+    env["schema"] = 999
+    json.dump(env, open(path, "w"))
+    with pytest.raises(ArtifactCorruptionError, match="schema"):
+        st.get(k)
+
+
+def test_concurrent_two_writer_race(tmp_path):
+    """Replicas racing to populate the same key: every interleaving must
+    end with ONE intact, readable entry (last writer wins)."""
+    st = ArtifactStore(str(tmp_path))
+    k = make_key(graph="g", topology="t", calibration="c", num_devices=8)
+    errors = []
+
+    def writer(i):
+        try:
+            for j in range(10):
+                st.put(k, {"writer": i, "round": j,
+                           "bulk": ["x" * 50] * 20})
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    got = st.get(k)
+    assert got is not None and got["round"] == 9
+
+
+def test_lru_eviction(tmp_path):
+    st = ArtifactStore(str(tmp_path), max_entries=3)
+    keys = [make_key(graph=f"g{i}", topology="t", calibration="c",
+                     num_devices=8) for i in range(5)]
+    for i, k in enumerate(keys):
+        st.put(k, {"i": i})
+        time.sleep(0.01)  # distinct mtimes on coarse filesystems
+        if i == 2:
+            st.get(keys[0])  # touch g0: it must survive the eviction
+            time.sleep(0.01)
+    assert len(st.entries()) == 3
+    assert st.get(keys[0]) is not None  # LRU-touched entry survived
+    assert st.get(keys[1]) is None      # oldest untouched entry evicted
+
+
+def test_clean_stale_tmp_on_open(tmp_path):
+    st = ArtifactStore(str(tmp_path))
+    k = make_key(graph="g", topology="t", calibration="c", num_devices=8)
+    st.put(k, {"x": 1})
+    litter = os.path.join(st.entries_dir, "abc.json.tmp-999-1")
+    open(litter, "w").write("half-written")
+    st2 = ArtifactStore(str(tmp_path))
+    assert not os.path.exists(litter)
+    assert st2.get(k) is not None
+
+
+# ---------------------------------------------------------------------------
+# compile() consumer: hit skips the search, corruption degrades
+# ---------------------------------------------------------------------------
+def test_compile_miss_then_hit_skips_search(tmp_path, monkeypatch):
+    st = ArtifactStore(str(tmp_path))
+    m1 = small_model(st)
+    assert m1.strategy_provenance == {"source": "search",
+                                      "cause": "cache_miss"}
+    assert len(st.entries()) == 1
+    calls = count_searches(monkeypatch)
+    m2 = small_model(st)
+    assert calls == []
+    assert m2.strategy_provenance["source"] == "artifact_cache"
+    # the replayed strategy trains, and matches the searched one's loss
+    # (sharding is layout-only under GSPMD — same seed, same numbers)
+    x, y = dataset()
+    p2 = m2.fit(x=[x], y=y, epochs=1, verbose=False)
+    p1 = m1.fit(x=[x], y=y, epochs=1, verbose=False)
+    assert np.isclose(p1.sparse_cce_loss, p2.sparse_cce_loss, rtol=1e-5)
+    assert p1.train_correct == p2.train_correct
+    # the replay is FAITHFUL, not merely valid: the rebuilt graph carries
+    # the searched winner's exact per-dim sharding state (degree, mesh
+    # axis, replica dims) op for op — a replay that "works" by silently
+    # demoting everything to replicated must fail here
+    def sharding(m):
+        return {
+            op.name: [
+                [(d.size, d.degree, d.parallel_idx, d.is_replica_dim)
+                 for d in t.dims]
+                for t in list(op.outputs) + list(op.weights)
+            ]
+            for op in m.graph.ops
+        }
+    assert sharding(m1) == sharding(m2)
+
+
+def test_payload_schema_mismatch_degrades_stale(tmp_path, monkeypatch):
+    """An entry whose payload predates (or postdates) the current graph
+    serialization is stale, never a wrong replay: the payload is a full
+    PCG, so fields can't be guessed across versions."""
+    st = ArtifactStore(str(tmp_path))
+    m1 = small_model(st)
+    payload = st.get(m1._artifact_key)
+    payload["strategy_schema"] = payload["strategy_schema"] - 1
+    st.put(m1._artifact_key, payload)
+    calls = count_searches(monkeypatch)
+    with pytest.warns(UserWarning, match="could not be replayed"):
+        m2 = small_model(st)
+    assert len(calls) == 1 and m2.strategy_provenance["source"] == "search"
+
+
+def test_compile_corrupt_entry_falls_back_to_search(tmp_path, monkeypatch):
+    st = ArtifactStore(str(tmp_path))
+    small_model(st)
+    [entry] = st.entries()
+    path = os.path.join(st.entries_dir, entry)
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0x10
+    open(path, "wb").write(bytes(raw))
+    calls = count_searches(monkeypatch)
+    m = small_model(st)  # never crashes, never a wrong strategy
+    assert len(calls) == 1
+    assert m.strategy_provenance == {"source": "search",
+                                     "cause": "cache_corrupt"}
+    # the fresh winner was re-cached over the quarantined entry
+    assert len(st.entries()) == 1
+    calls.clear()
+    m2 = small_model(st)
+    assert calls == [] and m2.strategy_provenance["source"] == \
+        "artifact_cache"
+
+
+def test_compile_unreplayable_entry_degrades_stale(tmp_path, monkeypatch):
+    """An intact entry whose strategy doesn't apply to the live model
+    (here: op records naming a different model's compute ops) is
+    quarantined as stale and compile searches fresh."""
+    st = ArtifactStore(str(tmp_path))
+    m1 = small_model(st)
+    key = m1._artifact_key
+    # overwrite the valid entry with a well-formed v3 payload whose
+    # compute ops can't match the live model
+    from flexflow_tpu.runtime.artifact_store import STRATEGY_PAYLOAD_SCHEMA
+    payload = {
+        "kind": "strategy", "strategy_schema": STRATEGY_PAYLOAD_SCHEMA,
+        "cost": 1.0, "mesh_axes": {"data": min(8, NDEV)},
+        "inputs": [[[4, 1, -1, 0, None], [4, 1, -1, 0, None]]],
+        "nodes": [{"name": "op_from_another_model_0",
+                   "op_type": "OP_LINEAR", "params": None,
+                   "inputs": [["input", 0, 0]],
+                   "outputs": [{"dtype": "DT_FLOAT",
+                                "dims": [[4, 1, -1, 0, None],
+                                         [4, 1, -1, 0, None]]}],
+                   "weights": [], "machine_view": None}],
+        "provenance": {},
+    }
+    st.put(key, payload)
+    calls = count_searches(monkeypatch)
+    with pytest.warns(UserWarning, match="could not be replayed"):
+        m2 = small_model(st)
+    assert len(calls) == 1
+    assert m2.strategy_provenance["source"] == "search"
+    x, y = dataset()
+    m2.fit(x=[x], y=y, epochs=1, verbose=False)
+
+
+def test_fault_injection_sites(tmp_path):
+    """The artifact_corruption / artifact_stale chaos sites force each
+    degradation leg without touching bytes on disk."""
+    fi = FaultInjector()
+    st = ArtifactStore(str(tmp_path), fault_injector=fi)
+    k = make_key(graph="g", topology="t", calibration="c", num_devices=8)
+    st.put(k, {"x": 1})
+    fi.inject("artifact_stale")
+    assert st.get(k) is None            # stale: silent miss
+    st.put(k, {"x": 2})
+    fi.inject("artifact_corruption")
+    with pytest.raises(ArtifactCorruptionError, match="injected"):
+        st.get(k)
+    assert st.get(k) is None            # quarantined either way
+    assert fi.fired["artifact_stale"] == 1
+    assert fi.fired["artifact_corruption"] == 1
+
+
+def test_compile_survives_injected_corruption(tmp_path, monkeypatch):
+    fi = FaultInjector()
+    st = ArtifactStore(str(tmp_path), fault_injector=fi)
+    small_model(st)
+    fi.inject("artifact_corruption")
+    calls = count_searches(monkeypatch)
+    m = small_model(st)
+    assert len(calls) == 1
+    assert m.strategy_provenance["cause"] == "cache_corrupt"
+
+
+def test_metrics_counted(tmp_path):
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td, \
+            obs.session(TelemetryConfig(dir=td)):
+        st = ArtifactStore(str(tmp_path))
+        k = make_key(graph="g", topology="t", calibration="c",
+                     num_devices=8)
+        st.get(k)
+        st.put(k, {"x": 1})
+        st.get(k)
+        st.note_stale(k, "replay failed")
+        reg = obs.active().metrics
+        for event, expect in [("miss", 1), ("put", 1), ("hit", 1),
+                              ("stale", 1)]:
+            c = reg.find("ff_artifact_cache_total", event=event)
+            assert c is not None and c.value == expect, event
+
+
+# ---------------------------------------------------------------------------
+# tuner quarantine persistence
+# ---------------------------------------------------------------------------
+def test_quarantine_set_round_trip(tmp_path):
+    st = ArtifactStore(str(tmp_path))
+    assert st.load_quarantine("scope") == set()
+    st.add_quarantine("scope", {"aaa", "bbb"})
+    st.add_quarantine("scope", {"ccc"})
+    assert st.load_quarantine("scope") == {"aaa", "bbb", "ccc"}
+    # corrupt quarantine file degrades to empty, not a crash
+    path = st._quarantine_set_path("scope")
+    open(path, "w").write("junk{")
+    assert st.load_quarantine("scope") == set()
+
+
+def test_tuner_quarantine_persists_across_restart(tmp_path):
+    """A fingerprint quarantined by one process's tuner is honored by
+    the next process's tuner (fresh instance, same store)."""
+    from flexflow_tpu.runtime.tuner import StrategyTuner
+
+    st = ArtifactStore(str(tmp_path))
+    m = small_model(st)
+    t1 = StrategyTuner(m)
+    t1.attach_artifact_store(st)
+    t1._quarantine("deadbeefcafe0000")
+    # "restart": new tuner over a freshly compiled model, same store
+    m2 = small_model(st)
+    t2 = StrategyTuner(m2)
+    t2.attach_artifact_store(m2.artifact_store)
+    assert "deadbeefcafe0000" in t2.quarantined
+
+
+def test_tuner_write_through_winner(tmp_path, monkeypatch):
+    """A committed tuner winner lands in the store under compile()'s
+    key, so the next boot replays the TUNED strategy."""
+    from flexflow_tpu.runtime.tuner import StrategyTuner
+
+    st = ArtifactStore(str(tmp_path))
+    m = small_model(st)
+    tuner = StrategyTuner(m)
+    tuner.attach_artifact_store(st)
+    tuner._write_through_winner()
+    entry = st.get(m._artifact_key)
+    assert entry["provenance"]["writer"] == "tuner"
+    calls = count_searches(monkeypatch)
+    m2 = small_model(st)
+    assert calls == [] and m2.strategy_provenance["source"] == \
+        "artifact_cache"
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager retention (satellite bugfix)
+# ---------------------------------------------------------------------------
+class _Step:
+    """Minimal stand-in: CheckpointManager paths don't need a model for
+    retention tests — we create checkpoint dirs + sidecars by hand."""
+
+
+def _fake_ckpt(mgr, step):
+    path = mgr.step_path(step)
+    os.makedirs(path)
+    open(os.path.join(path, "data.npz"), "w").write("x")
+    json.dump({"step": step}, open(path + ".meta.json", "w"))
+
+
+def test_gc_never_prunes_latest_named_step(tmp_path):
+    """Rollback-resume regression: saving a LOWER step than the on-disk
+    history must not let retention delete the checkpoint LATEST names."""
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=3)
+    for s in (8, 9, 10):
+        _fake_ckpt(mgr, s)
+    # an elastic rollback resumed from step 5 and saved it
+    _fake_ckpt(mgr, 5)
+    mgr._write_latest(5)
+    mgr._gc()
+    assert os.path.isdir(mgr.step_path(5)), \
+        "retention deleted the checkpoint LATEST points at"
+    assert mgr.latest_step() == 5
+    # newest keep_last_n still kept alongside
+    assert sorted(mgr.list_steps()) == [5, 8, 9, 10]
+
+
+def test_gc_prunes_checkpoint_and_sidecar(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=2)
+    for s in (1, 2, 3, 4):
+        _fake_ckpt(mgr, s)
+    mgr._write_latest(4)
+    mgr._gc()
+    assert mgr.list_steps() == [3, 4]
+    for s in (1, 2):
+        assert not os.path.exists(mgr.step_path(s))
+        assert not os.path.exists(mgr.step_path(s) + ".meta.json"), \
+            "sidecar survived its checkpoint"
+
+
+def test_gc_crash_between_prune_and_pointer_recovers(tmp_path):
+    """Crash mid-GC (dir renamed to tmp, sidecar still in place, process
+    dies): the next manager boot sweeps the litter — including the
+    orphan sidecar — and restore still sees a consistent directory."""
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=2)
+    for s in (1, 2, 3):
+        _fake_ckpt(mgr, s)
+    mgr._write_latest(3)
+    # simulate the crash window: step_1's dir renamed to the tmp-gc name
+    # (as the fixed _gc does first) but nothing else happened
+    victim = mgr.step_path(1)
+    os.replace(victim, victim + ".tmp-gc-999")
+    assert os.path.exists(victim + ".meta.json")  # orphan sidecar
+    mgr2 = CheckpointManager(str(tmp_path), keep_last_n=2)
+    assert not os.path.exists(victim + ".tmp-gc-999")
+    assert not os.path.exists(victim + ".meta.json"), \
+        "orphan sidecar survived recovery"
+    assert mgr2.list_steps() == [2, 3]
+    assert mgr2.latest_step() == 3
+
+
+# ---------------------------------------------------------------------------
+# the 8->4->8 story: zero redundant searches
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+@needs8
+def test_elastic_848_zero_redundant_searches(tmp_path, monkeypatch):
+    """The acceptance story (scripts/coldstart_check.sh runs this
+    standalone): once the store holds the 8- and 4-device winners, a
+    full 8->4->8 failover cycle performs ZERO strategy searches —
+    ff_artifact_cache_total{event=hit} >= 2, ff_elastic_research_total
+    absent — and every restored model trains."""
+    import tempfile
+
+    from flexflow_tpu.runtime.elastic import restore_elastic, shrunk_devices
+
+    store = ArtifactStore(str(tmp_path / "store"))
+    ckpt = str(tmp_path / "ckpt")
+    x, y = dataset()
+
+    def model_fn():
+        return small_model(store, budget=20)
+
+    m = model_fn()  # populates the 8-device key
+    m.fit(x=[x], y=y, epochs=1, checkpoint_dir=ckpt,
+          checkpoint_every_n_steps=1, verbose=False)
+    with shrunk_devices(4):  # warm phase: populates the 4-device key
+        m4, _ = restore_elastic(model_fn, ckpt, verbose=False)
+        assert m4.strategy_provenance["cause"] == "cache_miss"
+    assert len(store.entries()) == 2
+
+    calls = count_searches(monkeypatch)
+    with tempfile.TemporaryDirectory() as td, \
+            obs.session(TelemetryConfig(dir=td)):
+        with shrunk_devices(4):
+            m4b, _ = restore_elastic(model_fn, ckpt, verbose=False)
+        m8b, _ = restore_elastic(model_fn, ckpt, verbose=False)
+        reg = obs.active().metrics
+        hits = reg.find("ff_artifact_cache_total", event="hit")
+        assert hits is not None and hits.value >= 2
+        for cause in ("cache_miss", "cache_corrupt", "no_store"):
+            assert reg.find("ff_elastic_research_total",
+                            cause=cause) is None, \
+                f"redundant search counted (cause={cause})"
+    assert calls == [], f"redundant searches ran: {calls}"
+    assert m4b.strategy_provenance["source"] == "artifact_cache"
+    assert m8b.strategy_provenance["source"] == "artifact_cache"
+    m8b.fit(x=[x], y=y, epochs=1, verbose=False)
+
+
+@pytest.mark.slow
+@needs8
+def test_elastic_research_counted_without_store(tmp_path):
+    """The no_store cause: restore_elastic without any store counts its
+    from-scratch search, so redundant work is observable."""
+    import tempfile
+
+    from flexflow_tpu.runtime.elastic import restore_elastic
+
+    ckpt = str(tmp_path / "ckpt")
+    x, y = dataset()
+
+    def model_fn():
+        return small_model(None, budget=20)
+
+    m = model_fn()
+    m.fit(x=[x], y=y, epochs=1, checkpoint_dir=ckpt,
+          checkpoint_every_n_steps=1, verbose=False)
+    with tempfile.TemporaryDirectory() as td, \
+            obs.session(TelemetryConfig(dir=td)):
+        m2, _ = restore_elastic(model_fn, ckpt, verbose=False)
+        c = obs.active().metrics.find("ff_elastic_research_total",
+                                      cause="no_store")
+        assert c is not None and c.value >= 1
+    assert m2.strategy_provenance == {"source": "search",
+                                      "cause": "no_store"}
