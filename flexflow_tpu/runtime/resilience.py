@@ -397,6 +397,24 @@ class FaultInjector:
                                entry as fingerprint-stale: quarantined,
                                counted under event=stale and returned
                                as a miss (fresh search, no error).
+      * ``shared_page_corruption`` — a shared-prefix KV chain fails its
+                               integrity check (runtime/kvcache.py): the
+                               chain is quarantined from the content
+                               index; ``match_prefix`` raises the typed
+                               SharedPageCorruptionError while
+                               ``reserve`` degrades to an unshared
+                               admission (counted in
+                               ff_kv_accounting_errors_total).
+      * ``release_race``     — a racing second ``PagePool.release`` is
+                               synthesized right after a successful one;
+                               the loser must surface as a typed
+                               KVCacheAccountingError (double release),
+                               never corrupt refcounts.
+      * ``cow_fault``        — a KV copy-on-write fails BEFORE any pool
+                               state mutates (allocation, rebind and
+                               decref never happen), proving the COW
+                               path leaves the pool audit-clean when it
+                               dies.
 
     Each injection fires `times` times, optionally only at `at_step`.
     `fire(site, step)` consumes one shot and raises `exc` when armed with

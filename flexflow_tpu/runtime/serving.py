@@ -29,9 +29,19 @@ granularity, shed at admission, never hang):
     single-shot forward requests to the compiled batch) stays for
     non-generative and encoder-decoder models.
 
+  * **prefix sharing** — admission consults the page pool's
+    content-addressed index (`reserve(..., tokens=prompt)`): published
+    prompt pages are attached refcounted and discounted from the KV
+    charge, prompts seen verbatim before skip their prefill compute
+    entirely (a bounded host-side strip cache — exact because identical
+    prompt + identical params reproduce the identical cache strip), and
+    failover stranding/requeue transfers page ownership exactly once
+    (typed `KVCacheAccountingError` on double release, never silent).
+
 Chaos-testable on CPU: FaultInjector sites ``replica_death``,
-``slow_worker``, ``kv_exhaustion`` and ``serving_worker``
-(tests/test_serving.py, scripts/load_check.py).
+``slow_worker``, ``kv_exhaustion``, ``serving_worker``,
+``shared_page_corruption``, ``release_race`` and ``cow_fault``
+(tests/test_serving.py, tests/test_kvshare.py, scripts/load_check.py).
 """
 from __future__ import annotations
 
@@ -41,7 +51,7 @@ import queue
 import threading
 import time
 import uuid
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Callable, Dict, List, Optional
 
 import jax
@@ -560,6 +570,17 @@ class ServingConfig:
     page_size: int = 16
     num_pages: Optional[int] = None
     watermark: float = 0.0
+    # content-addressed prefix sharing (docs/serving.md "Prefix
+    # sharing"): admission attaches already-published prompt pages
+    # refcounted (discounting them from the KV charge) and publishes
+    # this prompt's full blocks for later arrivals. Exactness is
+    # unconditional — shared pages are immutable by construction
+    # (copy-on-write in the pool is the enforced safety valve).
+    share_prefixes: bool = True
+    # prompts memoized for exact prefill-FLOP skipping (LRU entries of
+    # (bucket, prompt) -> prefilled cache strip); 0 disables the skip
+    # while keeping page-level dedup
+    prefix_cache_entries: int = 8
     max_queue_depth: int = 64
     default_deadline_s: float = 30.0
     default_max_new_tokens: int = 16
@@ -976,6 +997,16 @@ class ContinuousBatcher:
         self._iteration = 0
         self._admit_seq = 0  # per-admission nonce: pool keys stay unique
         # even if a request is ever double-admitted across a failover race
+        # slot teardown mutex: _release and _strand_slots TAKE the slot
+        # under this lock before touching the pool, so a wedged serve
+        # thread waking up mid-steal and the watchdog can never both
+        # release the same seq_key (pool double-release is typed now)
+        self._teardown_lock = threading.Lock()
+        # exact prefill-skip memo: (bucket, prompt bytes) -> (first
+        # token, batch-1 cache strip). Identical prompt + identical
+        # params reproduce the identical strip, so replaying it is
+        # bit-exact; bounded LRU, invalidated on decode retune.
+        self._prefix_cache: "OrderedDict" = OrderedDict()
         # per-token service-time EWMA drives the "cannot meet deadline"
         # early shed; warms up after the first measured iterations
         self._token_ewma_s: Optional[float] = None
@@ -987,7 +1018,8 @@ class ContinuousBatcher:
         self._retune_cooldown_until = 0
         self.stats = {"admitted": 0, "finished": 0, "iterations": 0,
                       "prefills": 0, "retired_eos": 0, "shed_decode": 0,
-                      "stranded_requeued": 0, "decode_retunes": 0}
+                      "stranded_requeued": 0, "decode_retunes": 0,
+                      "prefix_hits": 0, "prefill_skips": 0}
 
     def _decode_executor_mismatch(self, dex, initB_d) -> Optional[str]:
         """None if the decode-searched lowering can serve the batched
@@ -1112,10 +1144,15 @@ class ContinuousBatcher:
         generation = req.generation
         self._admit_seq += 1
         seq_key = f"{req.id}:{generation}:{self.name}:{self._admit_seq}"
-        reserve_pages = 0
+        share = self.config.share_prefixes
+        prompt_tokens = req.prompt.tolist() if share else None
         try:
-            reserve_pages = self.pool.reserve(seq_key, self._reserve_tokens(
-                plen, req.max_new_tokens))
+            # with the prompt given, reserve() attaches published prefix
+            # pages refcounted and only charges the unshared remainder —
+            # the dedup that lets N same-prefix sessions share one pool
+            rr = self.pool.reserve(
+                seq_key, self._reserve_tokens(plen, req.max_new_tokens),
+                tokens=prompt_tokens)
         except KVCacheExhaustedError as e:
             if e.never_fits:
                 _shed("kv_exhausted")
@@ -1141,13 +1178,35 @@ class ContinuousBatcher:
         req.admitted_t = time.monotonic()
         req.trace.admitted(self.name, generation=generation,
                            slot=slot_idx, prompt_len=plen)
+        if rr.shared_pages:
+            self.stats["prefix_hits"] += 1
         if req.trace.sampled:
             req.trace.event("kv_reserve", replica=self.name,
-                            pages=reserve_pages, **self.pool.snapshot())
+                            pages=rr.pages, shared=rr.shared_pages,
+                            **self.pool.snapshot())
+        cache_key = ((bucket, req.prompt.astype(self._id_dt).tobytes())
+                     if share and self.config.prefix_cache_entries > 0
+                     else None)
+        cached = (self._prefix_cache.get(cache_key)
+                  if cache_key is not None else None)
         prefill_span = req.trace.span("prefill", replica=self.name,
-                                      bucket=bucket, prompt_len=plen)
+                                      bucket=bucket, prompt_len=plen,
+                                      skipped=cached is not None)
         try:
-            first, caches1 = self._prefill(req, plen)
+            if cached is not None:
+                # exact FLOP skip: this verbatim prompt was prefilled
+                # before under the same params, so its strip (and first
+                # token) are bit-identical — replay instead of compute
+                first, caches1 = cached
+                self._prefix_cache.move_to_end(cache_key)
+                self.stats["prefill_skips"] += 1
+            else:
+                first, caches1 = self._prefill(req, plen)
+                if cache_key is not None:
+                    self._prefix_cache[cache_key] = (first, caches1)
+                    while (len(self._prefix_cache)
+                           > self.config.prefix_cache_entries):
+                        self._prefix_cache.popitem(last=False)
         except BaseException:
             self.pool.release(seq_key)
             raise
@@ -1161,6 +1220,10 @@ class ContinuousBatcher:
                      tokens=list(req.prompt.tolist()) + [first],
                      prompt_len=plen, pos=plen)
         self.pool.touch(seq_key, bucket)
+        if share:
+            # make this prompt's full pages content-addressable so
+            # later same-prefix admissions attach instead of allocating
+            self.pool.publish(seq_key, prompt_tokens)
         self.slots[slot_idx] = slot
         self.stats["admitted"] += 1
         self.stats["prefills"] += 1
@@ -1226,13 +1289,16 @@ class ContinuousBatcher:
 
     # -- retirement ------------------------------------------------------
     def _release(self, slot_idx: int) -> None:
-        slot = self.slots[slot_idx]
+        # take-then-release: whoever swaps the slot out owns the ONE
+        # pool release for its seq_key (double release is typed now)
+        with self._teardown_lock:
+            slot = self.slots[slot_idx]
+            self.slots[slot_idx] = None
         if slot is not None:
             freed = self.pool.release(slot.seq_key)
             if slot.req.trace.sampled:
                 slot.req.trace.event("kv_release", replica=self.name,
                                      pages=freed, **self.pool.snapshot())
-        self.slots[slot_idx] = None
 
     def _finish_slot(self, slot_idx: int) -> None:
         from .. import obs
@@ -1301,6 +1367,14 @@ class ContinuousBatcher:
             sampled_any = sampled_any or slot.req.trace.sampled
             t_vec[i] = slot.pos
             toks[i, 0] = slot.tokens[slot.pos]
+            if self.config.share_prefixes:
+                # protocol guard: this step writes K/V at slot.pos. Only
+                # full PROMPT blocks are ever published, and decode
+                # positions sit strictly past them, so this is a no-op in
+                # steady state — but if a shared page were ever in the
+                # write path, the pool copies it private here (COW)
+                # instead of letting the write leak into siblings
+                self.pool.note_write(slot.seq_key, slot.pos)
         span_t0 = time.perf_counter() if sampled_any else 0.0
         with self._device_lock:
             logits, self._caches = self._stepB(
@@ -1312,6 +1386,8 @@ class ContinuousBatcher:
         occupancy = len(active)
         for i in active:
             slot = self.slots[i]
+            if slot is None:
+                continue  # taken by a concurrent teardown sweep mid-step
             slot.tokens.append(int(logits[i, 0].argmax(-1)))
             slot.pos += 1
             new_pages = self.pool.touch(
@@ -1359,15 +1435,21 @@ class ContinuousBatcher:
         declared the replica dead still gets rescued; pool keys carry a
         per-admission nonce, so even a double-handled request can never
         collide in a page pool. Safe to call from the ReplicaSet too
-        (stuck-thread steal): slot writes are atomic item stores and
-        completion stays exactly-once via the generation check."""
+        (stuck-thread steal): slots are taken under the teardown mutex
+        so page refs transfer exactly once, and completion stays
+        exactly-once via the generation check."""
         from .. import obs
 
         requeued = 0
-        for i, slot in enumerate(self.slots):
+        for i in range(len(self.slots)):
+            # take-then-release under the teardown mutex: the dying
+            # serve thread and a watchdog steal can both sweep, but only
+            # the taker decrefs — page ownership transfers exactly once
+            with self._teardown_lock:
+                slot = self.slots[i]
+                self.slots[i] = None
             if slot is None:
                 continue
-            self.slots[i] = None
             self.pool.release(slot.seq_key)
             gen = slot.req._requeue_bump()
             if gen is None:
@@ -1451,6 +1533,9 @@ class ContinuousBatcher:
                 else:
                     self._initB, self._stepB = initB_d, stepB_d
                     self._caches = None  # rebuilt by the next admission
+                    # memoized strips came from the old serving epoch;
+                    # drop them rather than reason about compatibility
+                    self._prefix_cache.clear()
                     self.decode_strategy_active = True
                     outcome = "committed"
         except DecodeExactnessError as e:
@@ -1913,8 +1998,12 @@ class ReplicaSet:
 
     @staticmethod
     def pool_release_quiet(batcher: ContinuousBatcher, slot: _Slot) -> None:
+        # sweeps that legitimately race the serve loop's own release
+        # (retirement / dead-exit stranding may have freed the slot
+        # already) pass missing_ok so the typed double-release guard
+        # stays armed for real failover bugs
         try:
-            batcher.pool.release(slot.seq_key)
+            batcher.pool.release(slot.seq_key, missing_ok=True)
         except Exception:  # fflint: disable=FFL002 — best-effort cleanup
             pass
 
@@ -2006,7 +2095,15 @@ class ReplicaSet:
         grace = time.monotonic() + 30.0
         while rep.batcher.active_slots and time.monotonic() < grace:
             time.sleep(0.02)
-        for slot in rep.batcher.in_flight():
+        for i in range(len(rep.batcher.slots)):
+            # take the straggler slot under the batcher's teardown mutex
+            # so this sweep and the (still-running) serve loop can't
+            # both decref its pages
+            with rep.batcher._teardown_lock:
+                slot = rep.batcher.slots[i]
+                rep.batcher.slots[i] = None
+            if slot is None:
+                continue
             gen = slot.req._requeue_bump()
             self.pool_release_quiet(rep.batcher, slot)
             if gen is not None:

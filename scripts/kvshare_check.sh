@@ -1,0 +1,153 @@
+#!/usr/bin/env bash
+# Content-addressed prefix-sharing KV pool check (docs/serving.md
+# "Prefix sharing"): the dedup win, the chaos legs, and the auditor
+# exit-code contract. Three legs:
+#   1. 8-device mesh, starved pool, shared-prefix load — sharing must
+#      sustain >= 5x the concurrent sessions of the unshared pool in
+#      the SAME page budget, token-exact vs incremental_generate, with
+#      zero PagePool.audit() violations and zero leaked pages;
+#   2. 4-device mesh, chaos sweep over the three new fault sites
+#      (shared_page_corruption / release_race / cow_fault) against a
+#      live batcher AND the randomized pool selftest — every leg must
+#      end typed-only and audit-clean;
+#   3. auditor CLI exit codes — `python -m flexflow_tpu.runtime.kvcache
+#      audit` returns 0 on a clean state dump and 1 on a corrupted one.
+# CI wires this into the lint workflow alongside the other *_check.sh.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+OUT="$(mktemp -d)"
+trap 'rm -rf "$OUT"' EXIT
+
+echo "=== kvshare_check leg 1: 8-device mesh, starved pool, shared-prefix load ==="
+JAX_NUM_CPU_DEVICES=8 python scripts/load_check.py --shared-prefix \
+    --hidden 16 --layers 1 --heads 2 --search-budget 1 \
+    --json "$OUT/leg1.json"
+
+echo "=== kvshare_check leg 2: 4-device mesh, chaos sweep over the new fault sites ==="
+JAX_NUM_CPU_DEVICES=4 python - "$OUT" <<'EOF'
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count="
+    + os.environ.get("JAX_NUM_CPU_DEVICES", "4")
+).strip()
+
+import numpy as np
+
+from flexflow_tpu.runtime.kvcache import (KVCacheAccountingError,
+                                          KVCacheConfig, PagePool,
+                                          SharedPageCorruptionError)
+from flexflow_tpu.runtime.resilience import FaultInjector
+from flexflow_tpu.runtime.serving import (AdmissionQueue, ContinuousBatcher,
+                                          GenerationRequest, ServingConfig,
+                                          incremental_generate)
+from tests.test_serving import VOCAB, build_lm
+
+lm = build_lm()
+rng = np.random.RandomState(0)
+prompt = rng.randint(0, VOCAB, 8).astype(np.int32)
+ref = incremental_generate(lm, prompt[None], max_new_tokens=4)[0]
+
+# -- serving legs: corruption degrades, an armed cow_fault never fires
+# (decode cannot write a shared page), release_race dies TYPED ---------
+for site in ("shared_page_corruption", "cow_fault", "release_race"):
+    fi = FaultInjector()
+    fi.inject(site, times=1)
+    cfg = ServingConfig(max_len=16, slots=2, page_size=4,
+                        precompile=False, default_deadline_s=120.0)
+    q = AdmissionQueue(max_depth=8)
+    b = ContinuousBatcher(lm, cfg, q, fault_injector=fi).start()
+    n = 1 if site == "release_race" else 3
+    reqs = [GenerationRequest(prompt.copy(), 4, deadline_s=120.0)
+            for _ in range(n)]
+    for r in reqs:
+        q.offer(r)
+    for r in reqs:
+        np.testing.assert_array_equal(r.result(timeout=120.0), ref)
+    b.stop()
+    report = b.pool.audit()
+    assert report.ok, (site, report.to_dict())
+    assert report.pages_resident == 0, (site, "leaked pages")
+    if site == "shared_page_corruption":
+        assert b.pool.stats["corruptions"] >= 1, site
+    elif site == "cow_fault":
+        assert fi.fired.get("cow_fault", 0) == 0, \
+            "decode wrote a shared page: immutability broken"
+    else:
+        assert b.dead and isinstance(b.death_cause, KVCacheAccountingError)
+        assert b.death_cause.kind == "double_release"
+    print(f"kvshare_check: serving chaos leg {site} audit-clean")
+
+# -- pool-level typed legs (cow_fault can only fire here) --------------
+fi = FaultInjector()
+pool = PagePool(KVCacheConfig(num_pages=32, page_size=4),
+                fault_injector=fi)
+toks = list(range(100, 132))
+pool.reserve("a", 36, tokens=toks)
+pool.touch("a", 32)
+pool.publish("a", toks)
+pool.reserve("b", 36, tokens=toks, writable=True)
+fi.inject("cow_fault")
+try:
+    pool.note_write("b", 0)
+    raise SystemExit("cow_fault did not surface typed")
+except KVCacheAccountingError as e:
+    assert e.kind == "cow_fault"
+assert pool.audit().ok  # the fault fired BEFORE any mutation
+fi.inject("shared_page_corruption")
+try:
+    pool.match_prefix(toks)
+    raise SystemExit("shared_page_corruption did not surface typed")
+except SharedPageCorruptionError:
+    pass
+pool.release("a")
+pool.release("b")
+report = pool.audit()
+assert report.ok and report.pages_resident == 0
+print("kvshare_check: pool-level typed legs audit-clean")
+EOF
+
+echo "=== kvshare_check leg 2b: randomized pool selftest under chaos ==="
+JAX_NUM_CPU_DEVICES=4 python -m flexflow_tpu.runtime.kvcache \
+    selftest --ops 600 --seed 1 > "$OUT/selftest.json"
+python - "$OUT" <<'EOF'
+import json
+import sys
+
+s = json.load(open(f"{sys.argv[1]}/selftest.json"))
+assert s["ok"] and s["drained"] and s["violations"] == 0, s
+print(f"kvshare_check: selftest {s['ops']} ops, "
+      f"{s['typed_errors']} typed error(s), 0 violations — OK")
+EOF
+
+echo "=== kvshare_check leg 3: auditor CLI exit codes ==="
+python - "$OUT" <<'EOF'
+import json
+import sys
+
+from flexflow_tpu.runtime.kvcache import KVCacheConfig, PagePool
+
+pool = PagePool(KVCacheConfig(num_pages=16, page_size=4))
+pool.reserve("a", 16, tokens=list(range(16)))
+pool.touch("a", 16)
+pool.publish("a", list(range(16)))
+pool.dump_state(f"{sys.argv[1]}/clean.json")
+state = pool.to_state()
+state["free"].append(state["tables"]["a"][0])  # seq holds a freed page
+with open(f"{sys.argv[1]}/corrupt.json", "w") as f:
+    json.dump(state, f)
+EOF
+python -m flexflow_tpu.runtime.kvcache audit "$OUT/clean.json" \
+    || { echo "kvshare_check: FAIL — clean state flagged"; exit 1; }
+if python -m flexflow_tpu.runtime.kvcache audit "$OUT/corrupt.json" \
+    > "$OUT/corrupt_audit.json"; then
+  echo "kvshare_check: FAIL — corrupted state passed the auditor"
+  exit 1
+fi
+grep -q '"freed_page_bound"' "$OUT/corrupt_audit.json" \
+    || { echo "kvshare_check: FAIL — wrong violation kind"; exit 1; }
+echo "kvshare_check: auditor exit codes OK (0 clean / 1 corrupt) — OK"

@@ -22,6 +22,16 @@ and asserts the overload-robustness contract:
      finished under its ORIGINAL trace id with exactly one complete
      event (obs/request_trace.py).
 
+With ``--shared-prefix`` the ramp is replaced by the KV-dedup A/B
+check (docs/serving.md "Prefix sharing"): the same burst of sessions —
+one long block-aligned common prompt prefix, unique tails — is served
+twice from an identically starved page pool, sharing off then on, and
+the run asserts >= ``--share-factor`` (default 5x) the concurrent
+sessions in the same HBM budget, ``ff_kv_pages_shared > 0`` at peak,
+token-exact output vs ``incremental_generate`` in BOTH phases, and a
+zero-violation ``PagePool.audit()`` per phase.
+scripts/kvshare_check.sh runs this leg in CI.
+
 Exit 0 with a JSON summary on stdout when all criteria hold; exit 1
 (with the failed criterion) otherwise. scripts/serving_check.sh runs
 this on 8- and 4-device CPU meshes in CI; scripts/obs_check.sh runs the
@@ -192,6 +202,149 @@ def verify_request_trace(tel_dir, *, expect_requeue):
     return verdict, failures
 
 
+def run_shared_prefix(args):
+    """The --shared-prefix A/B criterion: identical starved pool, the
+    same same-prefix session burst, sharing off vs on. The geometry is
+    chosen so one session needs `blocks+1` pages unshared but only ONE
+    page once the prefix is published: prefix = `blocks` full pages,
+    and the unique tail plus every decoded token fit inside a single
+    extra page."""
+    from flexflow_tpu.runtime.serving import (AdmissionQueue,
+                                              ContinuousBatcher,
+                                              GenerationRequest,
+                                              ServingConfig,
+                                              incremental_generate)
+
+    ps = args.page_size
+    if ps < 4:
+        print("[load_check] --shared-prefix needs --page-size >= 4",
+              file=sys.stderr)
+        return 1
+    blocks = 8                      # shared prefix: 8 full pages
+    plen = blocks * ps + 2          # + 2-token unique tail
+    max_new = ps - 2                # decode stays inside the tail page
+    args.max_len = (blocks + 1) * ps
+    pages_per = blocks + 1          # unshared worst case per session
+    num_pages = args.num_pages or 2 * pages_per + 2  # fits TWO unshared
+    slots = max(args.slots, 12)
+    sessions = slots + 4            # more offered than can ever run
+
+    import jax
+
+    ndev = len(jax.devices())
+    print(f"[load_check] shared-prefix A/B: {ndev} device(s), "
+          f"{num_pages}-page pool, {pages_per} pages/session unshared, "
+          f"{sessions} sessions offered", file=sys.stderr)
+    model = build_model_fn(args)()
+    rng = np.random.RandomState(args.seed)
+    prefix = rng.randint(0, args.vocab, blocks * ps).astype(np.int32)
+    prompts = [np.concatenate([prefix, np.array(
+        [(i // args.vocab) % args.vocab, i % args.vocab], np.int32)])
+        for i in range(sessions)]
+    refs = [incremental_generate(model, p[None], max_new_tokens=max_new)[0]
+            for p in prompts]
+
+    phases = {}
+    failures = []
+    for label, share in (("unshared", False), ("shared", True)):
+        cfg = ServingConfig(
+            max_len=args.max_len, slots=slots, page_size=ps,
+            num_pages=num_pages, share_prefixes=share, precompile=False,
+            max_queue_depth=sessions + 4,
+            default_deadline_s=args.deadline_s,
+        )
+        q = AdmissionQueue(max_depth=sessions + 4)
+        b = ContinuousBatcher(model, cfg, q).start()
+        peak = {"sessions": 0, "pages_shared": 0}
+        poll_stop = threading.Event()
+
+        def poll(b=b, peak=peak, poll_stop=poll_stop):
+            while not poll_stop.is_set():
+                peak["sessions"] = max(peak["sessions"], b.active_slots)
+                peak["pages_shared"] = max(peak["pages_shared"],
+                                           b.pool.pages_shared)
+                time.sleep(0.001)
+
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+        try:
+            reqs = [GenerationRequest(p.copy(), max_new,
+                                      deadline_s=args.deadline_s)
+                    for p in prompts]
+            for r in reqs:
+                q.offer(r)
+            outs = [r.result(timeout=300.0) for r in reqs]
+        finally:
+            poll_stop.set()
+            poller.join(timeout=2.0)
+            report = b.pool.audit()
+            pool_stats = dict(b.pool.stats)
+            b.stop()
+        exact = sum(1 for o, ref in zip(outs, refs)
+                    if np.array_equal(o, ref))
+        phases[label] = {
+            "peak_concurrent_sessions": peak["sessions"],
+            "peak_pages_shared": peak["pages_shared"],
+            "exact_outputs": exact,
+            "prefix_hits": pool_stats["prefix_hits"],
+            "cow": pool_stats["cow"],
+            "accounting_errors": pool_stats["accounting_errors"],
+            "audit_violations": len(report.violations),
+            "pages_resident_at_end": report.pages_resident,
+        }
+        if exact != sessions:
+            failures.append(
+                f"{label}: only {exact}/{sessions} outputs exact vs "
+                f"incremental_generate")
+        if not report.ok:
+            failures.append(
+                f"{label}: pool audit found {len(report.violations)} "
+                f"violation(s); first: {report.violations[0].kind}")
+        if report.pages_resident:
+            failures.append(
+                f"{label}: {report.pages_resident} page(s) leaked after "
+                f"the burst drained")
+
+    ratio = (phases["shared"]["peak_concurrent_sessions"]
+             / max(1, phases["unshared"]["peak_concurrent_sessions"]))
+    summary = {
+        "devices": ndev,
+        "geometry": {"page_size": ps, "prefix_blocks": blocks,
+                     "prompt_len": plen, "max_new": max_new,
+                     "num_pages": num_pages, "slots": slots,
+                     "sessions_offered": sessions,
+                     "pages_per_session_unshared": pages_per},
+        "phases": phases,
+        "concurrency_ratio": round(ratio, 2),
+        "required_ratio": args.share_factor,
+    }
+    if ratio < args.share_factor:
+        failures.append(
+            f"sharing sustained only {ratio:.2f}x the unshared concurrent "
+            f"sessions (need >= {args.share_factor}x in the same "
+            f"{num_pages}-page budget)")
+    if phases["shared"]["peak_pages_shared"] <= 0:
+        failures.append("ff_kv_pages_shared never rose above 0 with "
+                        "sharing on")
+    if phases["shared"]["prefix_hits"] < 1:
+        failures.append("no admission attached a shared prefix")
+    if phases["unshared"]["prefix_hits"] or phases["unshared"][
+            "peak_pages_shared"]:
+        failures.append("sharing leaked into the share_prefixes=False "
+                        "control phase")
+
+    print(json.dumps(summary, indent=2, default=str))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=2, default=str)
+    if failures:
+        for f_ in failures:
+            print(f"[load_check] FAIL: {f_}", file=sys.stderr)
+        return 1
+    print("[load_check] OK", file=sys.stderr)
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--replicas", type=int, default=2)
@@ -252,7 +405,18 @@ def main():
     ap.add_argument("--request-sample-rate", type=float, default=1.0,
                     help="head-based request trace sampling rate for the "
                          "telemetry session")
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="run the KV prefix-sharing A/B criterion instead "
+                         "of the load ramp: >= --share-factor x concurrent "
+                         "sessions in the same page budget with sharing "
+                         "on, exact outputs, zero audit violations")
+    ap.add_argument("--share-factor", type=float, default=5.0,
+                    help="required concurrent-session multiplier for "
+                         "--shared-prefix")
     args = ap.parse_args()
+
+    if args.shared_prefix:
+        return run_shared_prefix(args)
 
     from flexflow_tpu.runtime.resilience import FaultInjector, InferenceTimeout
     from flexflow_tpu.runtime.serving import ReplicaSet, RequestShedError, \

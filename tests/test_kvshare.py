@@ -1,0 +1,556 @@
+"""Content-addressed prefix sharing in the paged KV pool (ISSUE 18).
+
+Covers the allocator's sharing semantics end to end: the rolling-hash
+chain property, refcounted attach/COW/release transitions, the typed
+accounting-error taxonomy, the three chaos sites
+(shared_page_corruption / release_race / cow_fault), the invariant
+auditor (in-process, offline, and via the CLI), an 8-thread
+reserve/cow/release hammer with `audit()` asserted clean every 100 ops,
+and the serving-layer integration: exactness vs `incremental_generate`
+with sharing on, prefill-skip reuse, and a replica-death-during-
+shared-decode story asserting zero leaked pages and exactly-once
+completion.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from random import Random
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.runtime.kvcache import (
+    KVCacheAccountingError,
+    KVCacheConfig,
+    KVCacheExhaustedError,
+    PagePool,
+    SharedPageCorruptionError,
+    audit_state,
+    main as kvcache_cli,
+    prefix_page_keys,
+)
+from flexflow_tpu.runtime.resilience import FaultInjector
+from flexflow_tpu.runtime.serving import ReplicaDeathError
+
+# A 32-token prompt over page_size=4 yields 8 full shared-addressable
+# blocks — big enough that attach-vs-charge arithmetic is interesting.
+PS = 4
+PREFIX = list(range(100, 132))
+
+
+# ---------------------------------------------------------------------------
+# rolling-hash content addressing
+# ---------------------------------------------------------------------------
+
+def test_prefix_page_keys_chain_property():
+    """Key i commits to ALL tokens in blocks 0..i: equal prefixes agree
+    key-by-key until the first divergent block, and stay different ever
+    after — a plain dict walk is a prefix tree."""
+    a = PREFIX
+    b = PREFIX[:17] + [999] + PREFIX[18:]  # diverges inside block 4
+    ka, kb = prefix_page_keys(a, PS), prefix_page_keys(b, PS)
+    assert len(ka) == len(a) // PS == 8  # only FULL blocks are keyed
+    assert ka[:4] == kb[:4]
+    assert all(x != y for x, y in zip(ka[4:], kb[4:]))  # chain poisoned
+    # a partial tail block never gets a key (it stays private)
+    assert len(prefix_page_keys(a[:18], PS)) == 4
+    assert prefix_page_keys([], PS) == []
+    # keys are position-dependent, not bag-of-tokens
+    assert prefix_page_keys(a[4:8], PS)[0] != ka[1]
+
+
+def test_reserve_attaches_shared_pages_and_discounts_charge():
+    pool = PagePool(KVCacheConfig(num_pages=32, page_size=PS))
+    r1 = pool.reserve("a", 40, tokens=PREFIX)  # 8 prompt blocks + decode
+    assert r1.shared_pages == 0  # cold pool: nothing to attach
+    pool.touch("a", len(PREFIX))
+    assert pool.publish("a", PREFIX) == 8
+    r2 = pool.reserve("b", 40, tokens=PREFIX)
+    assert r2.shared_pages == 8 and r2.matched_tokens == 32
+    assert r2.pages == 10 - 8  # charge covers only the unshared remainder
+    assert pool.pages_shared == 8
+    assert pool.stats["prefix_hits"] == 1
+    # b's table already covers the prefix: touching it allocates nothing
+    assert pool.touch("b", len(PREFIX)) == []
+    assert pool.page_table("b")[:8] == pool.page_table("a")[:8]
+    m, pages = pool.match_prefix(PREFIX + [7, 8, 9])
+    assert m == 32 and len(pages) == 8
+    assert pool.audit().ok
+    # release order is irrelevant: pages free only at refcount zero
+    pool.release("a")
+    assert pool.pages_resident == 8 and pool.pages_shared == 0
+    assert pool.match_prefix(PREFIX)[0] == 32  # still published via b
+    pool.release("b")
+    assert pool.pages_resident == 0 and pool.pages_free == 32
+    assert pool.match_prefix(PREFIX)[0] == 0  # index entry dropped at free
+    assert pool.audit().ok
+
+
+def test_note_write_copy_on_write_and_unpublish():
+    pool = PagePool(KVCacheConfig(num_pages=32, page_size=PS))
+    pool.reserve("a", 36, tokens=PREFIX)
+    pool.touch("a", len(PREFIX))
+    pool.publish("a", PREFIX)
+    # sole holder writing a published page: unpublished in place, no copy
+    assert pool.note_write("a", 0) is None
+    assert pool.stats["unpublished_on_write"] == 1
+    assert pool.match_prefix(PREFIX)[0] == 0  # chain head retracted
+    pool.publish("a", PREFIX)  # re-freeze for the sharing leg
+    # writable=True pre-budgets every potential COW (full charge)
+    rb = pool.reserve("b", 36, tokens=PREFIX, writable=True)
+    assert rb.shared_pages == 8 and rb.pages == 9
+    before = pool.page_table("b")[2]
+    new_pid = pool.note_write("b", 2 * PS + 1)  # write inside block 2
+    assert new_pid is not None and new_pid != before
+    assert pool.page_table("b")[2] == new_pid
+    assert pool.page_table("a")[2] == before  # a's view untouched
+    assert pool.page_refs(before) == 1 and pool.page_refs(new_pid) == 1
+    assert pool.stats["cow"] == 1
+    # a private page stays a no-op on subsequent writes
+    assert pool.note_write("b", 2 * PS + 1) is None
+    assert pool.audit().ok
+    # a discounted (writable=False) reservation has no COW headroom
+    rc = pool.reserve("c", 32, tokens=PREFIX)
+    assert rc.pages == 0
+    with pytest.raises(KVCacheAccountingError) as ei:
+        pool.note_write("c", 0)
+    assert ei.value.kind == "cow_without_headroom"
+    for s in ("a", "b", "c"):
+        pool.release(s)
+    assert pool.pages_resident == 0 and pool.audit().ok
+
+
+def test_typed_accounting_errors_write_and_publish_without_reservation():
+    pool = PagePool(KVCacheConfig(num_pages=8, page_size=PS))
+    with pytest.raises(KVCacheAccountingError) as e1:
+        pool.note_write("ghost", 0)
+    assert e1.value.kind == "write_without_reservation"
+    with pytest.raises(KVCacheAccountingError) as e2:
+        pool.publish("ghost", PREFIX)
+    assert e2.value.kind == "publish_without_reservation"
+    assert pool.stats["accounting_errors"] == 2
+    assert pool.audit().ok
+
+
+# ---------------------------------------------------------------------------
+# chaos sites
+# ---------------------------------------------------------------------------
+
+def test_shared_page_corruption_site_quarantines_and_degrades():
+    fi = FaultInjector()
+    pool = PagePool(KVCacheConfig(num_pages=32, page_size=PS),
+                    fault_injector=fi)
+    pool.reserve("a", 36, tokens=PREFIX)
+    pool.touch("a", len(PREFIX))
+    pool.publish("a", PREFIX)
+    # leg 1: the read path raises typed and quarantines the chain
+    fi.inject("shared_page_corruption")
+    with pytest.raises(SharedPageCorruptionError) as ei:
+        pool.match_prefix(PREFIX)
+    assert ei.value.kind == "shared_page_corruption"
+    assert pool.match_prefix(PREFIX)[0] == 0  # quarantined, not attachable
+    assert pool.audit().ok  # quarantine never corrupts occupancy
+    # leg 2: the admission path degrades to an unshared reservation
+    pool.publish("a", PREFIX)
+    fi.inject("shared_page_corruption")
+    rr = pool.reserve("b", 36, tokens=PREFIX)
+    assert rr.shared_pages == 0  # corrupt chain must never be attached
+    assert pool.stats["corruptions"] == 2
+    assert fi.fired["shared_page_corruption"] == 2
+    pool.release("a")
+    pool.release("b")
+    assert pool.audit().ok and pool.pages_resident == 0
+
+
+def test_release_race_site_second_release_is_typed():
+    fi = FaultInjector()
+    pool = PagePool(KVCacheConfig(num_pages=8, page_size=PS),
+                    fault_injector=fi)
+    pool.reserve("x", 8)
+    pool.touch("x", 8)
+    fi.inject("release_race")
+    # the legitimate release succeeds, then the injected losing racer's
+    # second release surfaces as the typed error — never corruption
+    with pytest.raises(KVCacheAccountingError) as ei:
+        pool.release("x")
+    assert ei.value.kind == "double_release"
+    assert not pool.holds("x") and pool.pages_free == 8
+    assert pool.audit().ok
+
+
+def test_cow_fault_site_fails_before_any_mutation():
+    fi = FaultInjector()
+    pool = PagePool(KVCacheConfig(num_pages=32, page_size=PS),
+                    fault_injector=fi)
+    pool.reserve("a", 36, tokens=PREFIX)
+    pool.touch("a", len(PREFIX))
+    pool.publish("a", PREFIX)
+    pool.reserve("b", 36, tokens=PREFIX, writable=True)
+    fi.inject("cow_fault")
+    shared_pid = pool.page_table("b")[0]
+    with pytest.raises(KVCacheAccountingError) as ei:
+        pool.note_write("b", 0)
+    assert ei.value.kind == "cow_fault"
+    # the fault fired BEFORE any mutation: binding and refs are intact
+    assert pool.page_table("b")[0] == shared_pid
+    assert pool.page_refs(shared_pid) == 2
+    assert pool.stats["cow"] == 0
+    assert pool.audit().ok
+    # the retry (plan consumed) completes the copy
+    assert pool.note_write("b", 0) is not None
+    pool.release("a")
+    pool.release("b")
+    assert pool.audit().ok
+
+
+# ---------------------------------------------------------------------------
+# auditor: in-process, offline, CLI
+# ---------------------------------------------------------------------------
+
+def test_audit_detects_seeded_violations():
+    pool = PagePool(KVCacheConfig(num_pages=16, page_size=PS))
+    pool.reserve("a", 16, tokens=PREFIX[:16])
+    pool.touch("a", 16)
+    pool.publish("a", PREFIX[:16])
+    assert pool.audit().ok
+    # white-box: inflate a refcount — sum(refs) != bindings must trip
+    pid = pool.page_table("a")[0]
+    pool._pages[pid].refs += 1
+    rep = pool.audit()
+    assert not rep.ok
+    assert any(v.kind == "refcount_mismatch" for v in rep.violations)
+    with pytest.raises(KVCacheAccountingError) as ei:
+        pool.audit(raise_on_violation=True)
+    assert ei.value.kind == "audit"
+    pool._pages[pid].refs -= 1
+    # white-box: a zero-ref resident page is a leak
+    pool._pages[99] = type(pool._pages[pid])(refs=0)
+    rep2 = pool.audit()
+    assert any(v.kind == "zero_ref_resident" for v in rep2.violations)
+    del pool._pages[99]
+    assert pool.audit().ok
+
+
+def test_audit_state_offline_roundtrip(tmp_path):
+    pool = PagePool(KVCacheConfig(num_pages=16, page_size=PS))
+    pool.reserve("a", 20, tokens=PREFIX[:16])
+    pool.touch("a", 16)
+    pool.publish("a", PREFIX[:16])
+    pool.reserve("b", 20, tokens=PREFIX[:16])
+    good = pool.to_state()
+    assert audit_state(good).ok
+    # seq holding a freed page — the classic failover use-after-free
+    bad = json.loads(json.dumps(good))
+    bad["free"].append(bad["tables"]["a"][0])
+    rep = audit_state(bad)
+    assert not rep.ok
+    assert any(v.kind == "freed_page_bound" for v in rep.violations)
+    # exercised via the CLI entry point too (exit codes are the contract)
+    good_p, bad_p = tmp_path / "good.json", tmp_path / "bad.json"
+    pool.dump_state(str(good_p))
+    bad_p.write_text(json.dumps(bad))
+    assert kvcache_cli(["audit", str(good_p)]) == 0
+    assert kvcache_cli(["audit", str(good_p), str(bad_p)]) == 1
+
+
+@pytest.mark.slow
+def test_auditor_cli_subprocess_exit_codes(tmp_path):
+    pool = PagePool(KVCacheConfig(num_pages=8, page_size=PS))
+    pool.reserve("a", 8)
+    pool.touch("a", 8)
+    good = tmp_path / "good.json"
+    pool.dump_state(str(good))
+    bad_state = pool.to_state()
+    bad_state["free"].append(bad_state["tables"]["a"][0])
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(bad_state))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run(
+        [sys.executable, "-m", "flexflow_tpu.runtime.kvcache", "audit",
+         str(good)], capture_output=True, text=True, env=env, timeout=300)
+    assert ok.returncode == 0, ok.stderr
+    assert '"ok": true' in ok.stdout
+    broken = subprocess.run(
+        [sys.executable, "-m", "flexflow_tpu.runtime.kvcache", "audit",
+         str(bad)], capture_output=True, text=True, env=env, timeout=300)
+    assert broken.returncode == 1, broken.stderr
+    assert '"ok": false' in broken.stdout
+
+
+# ---------------------------------------------------------------------------
+# concurrency: the 8-thread shared-prefix hammer
+# ---------------------------------------------------------------------------
+
+def test_multithread_shared_prefix_hammer_audits_clean():
+    """8 threads × (reserve shared / touch / publish / COW / release),
+    `audit()` asserted clean after every 100 ops per thread. The pool's
+    single lock makes each op atomic; this proves the op SEQUENCES
+    interleave without leaking, double-freeing, or stranding refs."""
+    pool = PagePool(KVCacheConfig(num_pages=256, page_size=PS))
+    violations, typed, errors = [], [0], []
+
+    def worker(tid):
+        rng = Random(1000 + tid)
+        live, ops = [], 0
+        try:
+            for i in range(40):
+                seq = f"t{tid}:{i}"
+                suffix = [tid * 10_000 + i * 10 + k
+                          for k in range(rng.randrange(0, 7))]
+                toks = PREFIX + suffix
+                try:
+                    pool.reserve(seq, len(toks) + rng.randrange(1, 9),
+                                 tokens=toks, writable=True)
+                except KVCacheExhaustedError:
+                    continue  # transient pressure: backpressure, not a bug
+                pool.touch(seq, len(toks))
+                pool.publish(seq, toks)
+                for _ in range(3):
+                    pos = rng.randrange(0, len(toks))
+                    try:
+                        pool.note_write(seq, pos)
+                    except KVCacheAccountingError:
+                        typed[0] += 1  # COW headroom races are typed
+                # a sliding window of LIVE sequences: overlap is what
+                # makes later admissions attach the published prefix
+                live.append(seq)
+                if len(live) > 2:
+                    pool.release(live.pop(0))
+                ops += 6
+                if ops % 100 < 6:
+                    rep = pool.audit()
+                    if not rep.ok:
+                        violations.extend(rep.violations)
+            while live:
+                pool.release(live.pop(0))
+        except BaseException as e:  # pragma: no cover - failure reporting
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert not violations, violations
+    final = pool.audit()
+    assert final.ok and final.pages_resident == 0
+    assert pool.pages_free == 256  # every page came home: zero leaks
+    assert pool.stats["prefix_hits"] > 0  # the threads really did share
+    assert pool.stats["cow"] > 0  # writes inside the shared prefix copied
+    assert pool.stats["accounting_errors"] == typed[0]  # all typed, counted
+
+
+# ---------------------------------------------------------------------------
+# serving integration (sharing on by default)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lm():
+    from tests.test_serving import build_lm
+
+    return build_lm()
+
+
+def test_serving_shared_prefix_exact_and_prefill_skipped(lm, tmp_path):
+    """The acceptance bar: with sharing on, repeated prompts attach
+    shared pages and skip redundant prefill compute, and the decoded
+    tokens stay EXACT vs incremental_generate."""
+    from flexflow_tpu import obs
+    from flexflow_tpu.obs import TelemetryConfig
+    from flexflow_tpu.obs.metrics import parse_prometheus
+    from flexflow_tpu.runtime.serving import (
+        AdmissionQueue, ContinuousBatcher, GenerationRequest,
+        incremental_generate)
+    from tests.test_serving import VOCAB, _serve_cfg
+
+    rng = np.random.RandomState(11)
+    prompt = rng.randint(0, VOCAB, 8).astype(np.int32)  # 2 full blocks
+    with obs.session(TelemetryConfig(dir=str(tmp_path / "tel"))) as tel:
+        q = AdmissionQueue(max_depth=8)
+        b = ContinuousBatcher(lm, _serve_cfg(slots=3), q).start()
+        try:
+            reqs = [GenerationRequest(prompt.copy(), 6, deadline_s=120.0)
+                    for _ in range(3)]
+            for r in reqs:
+                q.offer(r)
+            outs = [r.result(timeout=120.0) for r in reqs]
+        finally:
+            b.stop()
+        series = parse_prometheus(tel.metrics.to_prometheus())
+    ref = incremental_generate(lm, prompt[None], max_new_tokens=6)[0]
+    for out in outs:
+        np.testing.assert_array_equal(out, ref)
+    assert b.stats["prefix_hits"] >= 1  # later admissions attached pages
+    assert b.stats["prefill_skips"] >= 1  # identical prompt: no recompute
+    assert b.pool.stats["shared_attached"] >= 2
+    assert series.get("ff_kv_prefix_hits_total", 0) >= 1
+    assert "ff_kv_pages_shared" in series
+    rep = b.pool.audit()
+    assert rep.ok and rep.pages_resident == 0  # drained, zero leaks
+
+
+def test_serving_sharing_off_is_supported(lm):
+    from flexflow_tpu.runtime.serving import (
+        AdmissionQueue, ContinuousBatcher, GenerationRequest,
+        incremental_generate)
+    from tests.test_serving import VOCAB, _serve_cfg
+
+    rng = np.random.RandomState(12)
+    prompt = rng.randint(0, VOCAB, 6).astype(np.int32)
+    q = AdmissionQueue(max_depth=4)
+    b = ContinuousBatcher(lm, _serve_cfg(share_prefixes=False), q).start()
+    try:
+        r1 = GenerationRequest(prompt.copy(), 4, deadline_s=120.0)
+        r2 = GenerationRequest(prompt.copy(), 4, deadline_s=120.0)
+        q.offer(r1)
+        q.offer(r2)
+        ref = incremental_generate(lm, prompt[None], max_new_tokens=4)[0]
+        np.testing.assert_array_equal(r1.result(timeout=120.0), ref)
+        np.testing.assert_array_equal(r2.result(timeout=120.0), ref)
+    finally:
+        b.stop()
+    assert b.stats["prefix_hits"] == 0 and b.stats["prefill_skips"] == 0
+    assert b.pool.stats["shared_attached"] == 0
+    assert b.pool.audit().ok
+
+
+def test_replica_death_during_shared_decode_no_leaks(tmp_path, monkeypatch):
+    """Failover × shared pages: kill a replica mid-decode while every
+    slot shares one prompt's prefix. Every request completes EXACTLY
+    once with the right tokens, and every pool that ever existed ends
+    audit-clean with zero resident pages — refs transferred exactly
+    once through the slot-stranding/requeue path."""
+    from flexflow_tpu.runtime.serving import ContinuousBatcher, ReplicaSet
+    from flexflow_tpu.runtime.serving import incremental_generate
+    from tests.test_serving import VOCAB, _serve_cfg, build_lm
+
+    batchers = []
+    orig_init = ContinuousBatcher.__init__
+
+    def recording_init(self, *a, **kw):
+        orig_init(self, *a, **kw)
+        batchers.append(self)
+
+    monkeypatch.setattr(ContinuousBatcher, "__init__", recording_init)
+    fi = FaultInjector()
+    fi.inject("replica_death", at_step=3, replica="replica0",
+              exc=ReplicaDeathError("chaos: die mid shared decode"))
+    rng = np.random.RandomState(13)
+    prompt = rng.randint(0, VOCAB, 8).astype(np.int32)  # 2 shared blocks
+    rs = ReplicaSet(build_lm, _serve_cfg(slots=3), replicas=2,
+                    ckpt_dir=str(tmp_path), fault_injector=fi,
+                    health_timeout_s=60.0, restart_backoff_s=0.05).start()
+    try:
+        reqs = [rs.submit(prompt.copy(), max_new_tokens=8, deadline_s=120.0)
+                for _ in range(6)]
+        outs = [r.result(timeout=180.0) for r in reqs]
+        lm = batchers[0].model
+        ref = incremental_generate(lm, prompt[None], max_new_tokens=8)[0]
+        for out in outs:
+            np.testing.assert_array_equal(out, ref)  # exactly-once, exact
+        assert fi.fired["replica_death"] == 1
+        t0 = time.monotonic()
+        while rs.replica_count() < 2 and time.monotonic() - t0 < 120:
+            time.sleep(0.05)  # elastic restart brings the pool count back
+        assert rs.replica_count() == 2
+    finally:
+        rs.stop()
+    # the dead replica's pool is in `batchers` too: NO pool may leak
+    assert len(batchers) >= 3  # 2 initial + >= 1 restart
+    for b in batchers:
+        rep = b.pool.audit()
+        assert rep.ok, (b.name, rep.to_dict())
+        assert rep.pages_resident == 0, b.name  # zero leaked pages
+    assert sum(b.stats["prefix_hits"] for b in batchers) >= 1
+
+
+def test_serving_chaos_corruption_and_cow_sites_audit_clean(lm):
+    """shared_page_corruption degrades admission to unshared (serving
+    stays up); an armed cow_fault never fires because decode writes
+    never land in a shared page — frozen PROMPT blocks only. Both legs
+    end exact and audit-clean."""
+    from flexflow_tpu.runtime.serving import (
+        AdmissionQueue, ContinuousBatcher, GenerationRequest,
+        incremental_generate)
+    from tests.test_serving import VOCAB, _serve_cfg
+
+    rng = np.random.RandomState(14)
+    prompt = rng.randint(0, VOCAB, 8).astype(np.int32)
+    ref = incremental_generate(lm, prompt[None], max_new_tokens=4)[0]
+    for site in ("shared_page_corruption", "cow_fault"):
+        fi = FaultInjector()
+        fi.inject(site, times=2)
+        q = AdmissionQueue(max_depth=8)
+        b = ContinuousBatcher(lm, _serve_cfg(slots=2), q,
+                              fault_injector=fi).start()
+        try:
+            reqs = [GenerationRequest(prompt.copy(), 4, deadline_s=120.0)
+                    for _ in range(3)]
+            for r in reqs:
+                q.offer(r)
+            outs = [r.result(timeout=120.0) for r in reqs]
+        finally:
+            b.stop()
+        for out in outs:
+            np.testing.assert_array_equal(out, ref)  # site never bends output
+        assert not b.dead, site  # both sites are absorbed, not fatal
+        if site == "shared_page_corruption":
+            # admission degraded to unshared rather than attaching a
+            # corrupt chain
+            assert b.pool.stats["corruptions"] >= 1, site
+        else:
+            # decode never writes into a shared page (prefix pages are
+            # frozen PROMPT blocks), so the armed plan must never fire:
+            # that non-event IS the read-only-by-construction proof
+            assert fi.fired.get("cow_fault", 0) == 0, site
+            assert b.pool.stats["cow"] == 0, site
+        rep = b.pool.audit()
+        assert rep.ok, (site, rep.to_dict())
+        assert rep.pages_resident == 0, site
+
+
+def test_serving_release_race_surfaces_typed_not_corruption(lm):
+    """The injected losing racer's double release is FATAL to the serve
+    loop — by design: a typed KVCacheAccountingError, never silent
+    occupancy corruption. The finished request still got its tokens
+    (results commit before release) and the pool stays audit-clean."""
+    from flexflow_tpu.runtime.serving import (
+        AdmissionQueue, ContinuousBatcher, GenerationRequest,
+        incremental_generate)
+    from tests.test_serving import VOCAB, _serve_cfg
+
+    rng = np.random.RandomState(15)
+    prompt = rng.randint(0, VOCAB, 5).astype(np.int32)
+    fi = FaultInjector()
+    fi.inject("release_race")
+    q = AdmissionQueue(max_depth=4)
+    b = ContinuousBatcher(lm, _serve_cfg(), q, fault_injector=fi).start()
+    try:
+        req = GenerationRequest(prompt.copy(), 4, deadline_s=120.0)
+        q.offer(req)
+        out = req.result(timeout=120.0)
+        np.testing.assert_array_equal(
+            out, incremental_generate(lm, prompt[None], max_new_tokens=4)[0])
+        t0 = time.monotonic()
+        while not b.dead and time.monotonic() - t0 < 60:
+            time.sleep(0.01)
+    finally:
+        b.stop()
+    assert b.dead
+    assert isinstance(b.death_cause, KVCacheAccountingError)
+    assert b.death_cause.kind == "double_release"
+    assert fi.fired["release_race"] == 1
+    rep = b.pool.audit()
+    assert rep.ok and rep.pages_resident == 0  # the REAL release freed all
+
+
+def test_pool_selftest_entry_point_chaos_clean():
+    """The CLI selftest (the kvshare_check.sh chaos leg) in-process:
+    randomized shared-prefix traffic + injected faults must drain to an
+    audit-clean empty pool."""
+    rc = kvcache_cli(["selftest", "--ops", "400", "--seed", "5"])
+    assert rc == 0

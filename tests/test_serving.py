@@ -27,6 +27,7 @@ from flexflow_tpu import (
     SGDOptimizer,
 )
 from flexflow_tpu.runtime.kvcache import (
+    KVCacheAccountingError,
     KVCacheConfig,
     KVCacheExhaustedError,
     PagePool,
@@ -80,8 +81,8 @@ def lm():
 def test_page_pool_reserve_touch_release_accounting():
     pool = PagePool(KVCacheConfig(num_pages=8, page_size=4))
     assert pool.pages_free == 8
-    need = pool.reserve("a", 10)  # ceil(10/4) = 3 pages
-    assert need == 3
+    rr = pool.reserve("a", 10)  # ceil(10/4) = 3 pages
+    assert rr.pages == 3 and rr.shared_pages == 0
     assert pool.pages_free == 5 and pool.pages_reserved == 3
     assert pool.pages_in_use == 0  # nothing materialized yet
     assert pool.touch("a", 4) and pool.pages_in_use == 1
@@ -92,8 +93,14 @@ def test_page_pool_reserve_touch_release_accounting():
     with pytest.raises(ValueError):
         pool.touch("a", 16)
     assert pool.release("a") == 2
-    assert pool.release("a") == 0  # idempotent
+    # double release is a TYPED accounting error now, not a silent no-op
+    # (a failover requeue bug must surface instead of corrupting refs)
+    with pytest.raises(KVCacheAccountingError):
+        pool.release("a")
+    assert pool.release("a", missing_ok=True) == 0  # benign-race escape
+    assert pool.stats["accounting_errors"] == 1
     assert pool.pages_free == 8 and pool.pages_in_use == 0
+    assert pool.audit().ok
 
 
 def test_page_pool_exhaustion_typed_and_never_fits():
@@ -120,6 +127,23 @@ def test_page_pool_watermark_and_config_validation():
                 dict(num_pages=4, watermark=1.0)):
         with pytest.raises(ValueError):
             KVCacheConfig(**bad)
+
+
+def test_page_pool_watermark_rounds_up_on_tiny_pools():
+    """Regression: int(num_pages * watermark) floored to 0 below 1/w
+    pages, silently disabling the watermark exactly where CPU tests
+    live. A positive watermark must hold back >= 1 page."""
+    tiny = KVCacheConfig(num_pages=4, page_size=4, watermark=0.1)
+    assert tiny.held_back_pages() == 1  # 0.4 pages rounds UP, not down
+    pool = PagePool(tiny)
+    pool.reserve("a", 12)  # 3 of the 3 admittable pages
+    with pytest.raises(KVCacheExhaustedError):
+        pool.reserve("b", 1)  # the held-back page is not admittable
+    # no float-noise over-rounding: 10 * 0.2 holds exactly 2, not 3
+    assert KVCacheConfig(num_pages=10, watermark=0.2).held_back_pages() == 2
+    # a watermark that would hold back the whole pool is a config error
+    with pytest.raises(ValueError):
+        KVCacheConfig(num_pages=2, page_size=4, watermark=0.9)
 
 
 def test_page_pool_kv_exhaustion_fault_site():
