@@ -8,6 +8,9 @@ fix_hint)`) and composable passes:
   sharding     — shape/dtype/degree re-derivation vs declared tensors
   collectives  — implied-collective consistency (order, axes, views,
                  all-to-all coverage)
+  precision    — FFA7xx mixed-precision flow: boundary dtype mismatch,
+                 low-precision accumulators, low-precision grad rings,
+                 loss-scale range, static drift budget (precision.py)
   memory       — static per-device HBM-fit from material shapes
   perf         — FFA5xx performance lints: overlap-discount soundness,
                  padding/roofline, slice-boundary collective cost (perf.py)
@@ -43,6 +46,13 @@ from .memory import (  # noqa: F401
     training_weight_multiplier,
 )
 from .perf import diagnostics_by_op, perf_diagnostics  # noqa: F401
+from .precision import (  # noqa: F401
+    DEFAULT_DRIFT_BUDGET,
+    annotate_graph_precision,
+    estimate_drift,
+    precision_diagnostics,
+    register_precision_rule,
+)
 from .swap_lint import lint_swap_candidate  # noqa: F401
 from .schedule import (  # noqa: F401
     OverlapSchedule,
@@ -58,8 +68,8 @@ from .substitution_lint import (  # noqa: F401
     lint_rules,
 )
 
-ALL_PASSES = ("structure", "sharding", "collectives", "memory", "perf",
-              "schedule")
+ALL_PASSES = ("structure", "sharding", "collectives", "precision",
+              "memory", "perf", "schedule")
 
 
 def analyze_graph(
@@ -74,6 +84,9 @@ def analyze_graph(
     passes: Sequence[str] = ALL_PASSES,
     cost_model=None,
     executor=None,
+    drift_budget: Optional[float] = None,
+    grad_dtype=None,
+    step_guard=None,
 ) -> AnalysisReport:
     """Run the selected analysis passes over a PCG.
 
@@ -86,6 +99,11 @@ def analyze_graph(
     roofline/topology lints (FFA503/504). executor: a live PCGExecutor
     whose ``overlap_schedule()`` hook the "schedule" pass audits for
     FFA502 races (skipped when absent or the overlapped path is off).
+    drift_budget/grad_dtype/step_guard: the "precision" pass's context —
+    the FFA705 budget (None = precision.DEFAULT_DRIFT_BUDGET), the
+    gradient storage dtype (DT_BF16 under the AMP recipe; enables
+    FFA703), and the StepGuardConfig whose loss-scale bounds FFA704
+    checks against the compute dtype's dynamic range.
     """
     rep = AnalysisReport()
     if "structure" in passes:
@@ -99,6 +117,12 @@ def analyze_graph(
     if "collectives" in passes:
         rep.extend(collective_diagnostics(graph, views=views,
                                           num_devices=num_devices))
+    if "precision" in passes:
+        rep.extend(precision_diagnostics(
+            graph, views=views, num_devices=num_devices,
+            drift_budget=drift_budget, grad_dtype=grad_dtype,
+            step_guard=step_guard,
+        ))
     if "memory" in passes:
         mem_rep, _ = memory_diagnostics(
             graph, views=views, num_devices=num_devices or 1,
@@ -145,6 +169,10 @@ def analyze_model(model, *, passes: Sequence[str] = ALL_PASSES,
         hbm_bytes = model.config.device_mem or None
         if hbm_bytes is None and cost_model is not None:
             hbm_bytes = cost_model.machine.chip.hbm_capacity
+    from ..ff_types import DataType
+
+    grad_dtype = (DataType.DT_BF16 if model._grad_bytes_ratio() < 1.0
+                  else None)
     return analyze_graph(
         graph,
         views=getattr(model, "searched_views", None),
@@ -156,6 +184,9 @@ def analyze_model(model, *, passes: Sequence[str] = ALL_PASSES,
         passes=passes,
         cost_model=cost_model,
         executor=model.executor,
+        drift_budget=getattr(model.config, "precision_drift_budget", None),
+        grad_dtype=grad_dtype,
+        step_guard=getattr(model.executor, "step_guard", None),
     )
 
 
@@ -166,6 +197,6 @@ def strategy_violations(graph, views, num_devices: int) -> list:
     vetting goes through the memory-aware search / fit(lint=...)."""
     rep = analyze_graph(
         graph, views=views, num_devices=num_devices,
-        passes=("structure", "sharding", "collectives"),
+        passes=("structure", "sharding", "collectives", "precision"),
     )
     return [d.format() for d in rep.errors]

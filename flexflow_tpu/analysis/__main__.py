@@ -88,6 +88,7 @@ def _cmd_model(args) -> int:
         MetricsType,
         SGDOptimizer,
     )
+    from ..ff_types import DataType
     from ..models.transformer import build_transformer
     from . import analyze_graph
 
@@ -99,6 +100,10 @@ def _cmd_model(args) -> int:
         cfg.search_budget = args.budget
     if args.overlap_discount:
         cfg.search_overlap_backward_update = True
+    if args.mixed_precision:
+        cfg.allow_mixed_precision = True
+    if args.drift_budget is not None:
+        cfg.precision_drift_budget = args.drift_budget
     model = FFModel(cfg)
     build_transformer(
         model, batch_size=args.batch, seq_length=args.seq,
@@ -129,6 +134,10 @@ def _cmd_model(args) -> int:
         grad_bytes_ratio=model._grad_bytes_ratio(),
         cost_model=cost_model,
         executor=model.executor,
+        drift_budget=getattr(cfg, "precision_drift_budget", None),
+        grad_dtype=(DataType.DT_BF16 if model._grad_bytes_ratio() < 1.0
+                    else None),
+        step_guard=getattr(model.executor, "step_guard", None),
     )
     name = (f"bench transformer (b{args.batch} s{args.seq} "
             f"h{args.hidden} x{args.layers}, {ndev} device(s))")
@@ -187,6 +196,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--overlap-discount", action="store_true",
                    help="search with the overlappable-collective "
                         "discount on, so FFA501 audits a live discount")
+    p.add_argument("--mixed-precision", action="store_true",
+                   help="compile the bench model under bf16 AMP so the "
+                        "FFA7xx precision pass audits an annotated "
+                        "mixed-precision flow")
+    p.add_argument("--drift-budget", type=float, default=None,
+                   help="FFA705 accumulated-drift budget override "
+                        "(default: FFConfig.precision_drift_budget)")
     args = p.parse_args(argv)
 
     if args.command == "model":

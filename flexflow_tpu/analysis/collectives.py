@@ -123,7 +123,11 @@ def estimate_collective_bytes(graph, views: Optional[Dict] = None,
         )
         if t is None:
             continue
-        full = t.get_volume() * t.data_type.size
+        # wire traffic moves the tensor at its COMPUTE width: a bf16-
+        # annotated activation crosses the fabric at 2 bytes/elt even
+        # though its declared storage dtype is fp32 (pre-annotation the
+        # two coincide, so fp32 graphs price unchanged)
+        full = t.get_volume() * t.effective_itemsize()
         v = _view_of(op, views or {})
         if op.op_type == OperatorType.OP_ALL_TO_ALL:
             # the exchange degree is declared on the op; a view may

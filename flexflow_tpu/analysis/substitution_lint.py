@@ -20,14 +20,17 @@ rule blow up — or silently mis-rewrite — deep inside the search:
 Codes: FFA401 arity/reference, FFA402 unsound sharding, FFA403
 unsupported op type (warning — the loader skips these, like the
 reference), FFA404 missing required param, FFA405 dead pattern output
-(warning), FFA406 dst op with no param source (warning).
+(warning), FFA406 dst op with no param source (warning), FFA407
+unsound precision substitution (bad PM_PRECISION value, or a
+low-precision accumulating dst op that does not declare its
+PM_ACCUM_PRECISION).
 """
 from __future__ import annotations
 
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
-from ..ff_types import OperatorType
+from ..ff_types import DataType, OperatorType
 from .diagnostics import AnalysisReport, Severity
 
 _PARALLEL_TYPES = {
@@ -246,6 +249,54 @@ def _eval_side(ops, ctx: _RuleCtx, side: str,
     return states
 
 
+# Valid targets for a PM_PRECISION / PM_ACCUM_PRECISION declaration: the
+# float members of DataType (a rule that stamps DT_INT32 as a compute
+# dtype is nonsense, and an out-of-enum int raises deep in apply_rule).
+_FLOAT_DTYPES = {
+    int(DataType.DT_HALF),
+    int(DataType.DT_BF16),
+    int(DataType.DT_FLOAT),
+    int(DataType.DT_DOUBLE),
+}
+_LOW_PRECISION = {int(DataType.DT_HALF), int(DataType.DT_BF16)}
+
+
+def _lint_precision(rule, ctx: _RuleCtx) -> None:
+    """FFA407: precision-rewrite soundness.
+
+    A substitution that narrows compute precision must (a) name a real
+    float dtype and (b), when the destination op accumulates (matmul /
+    attention / reductions — see analysis.precision), declare the accum
+    dtype it keeps wide, so the FFA702 invariant survives the rewrite.
+    """
+    from .precision import _ACCUMULATING
+
+    for side, ops in (("src", rule.src_ops), ("dst", rule.dst_ops)):
+        for oi, pat in enumerate(ops):
+            for key in ("PM_PRECISION", "PM_ACCUM_PRECISION"):
+                v = pat.params.get(key)
+                if v is not None and v not in _FLOAT_DTYPES:
+                    ctx.error(
+                        "FFA407",
+                        f"{side}Op[{oi}] ({pat.type_str}): {key}={v!r} is "
+                        "not a float DataType member",
+                        fix_hint="use the int value of DT_HALF/DT_BF16/"
+                                 "DT_FLOAT/DT_DOUBLE",
+                    )
+    for oi, pat in enumerate(rule.dst_ops):
+        prec = pat.params.get("PM_PRECISION")
+        if prec in _LOW_PRECISION and pat.op_type in _ACCUMULATING \
+                and pat.params.get("PM_ACCUM_PRECISION") is None:
+            ctx.error(
+                "FFA407",
+                f"dstOp[{oi}] ({pat.type_str}) narrows compute to "
+                f"{DataType(prec).name} but declares no accumulator "
+                "dtype for an accumulating op",
+                fix_hint="add PM_ACCUM_PRECISION (typically DT_FLOAT) "
+                         "to the dst op's para list",
+            )
+
+
 def lint_rule(rule) -> AnalysisReport:
     rep = AnalysisReport()
     ctx = _RuleCtx(rule, rep)
@@ -253,6 +304,7 @@ def lint_rule(rule) -> AnalysisReport:
         ctx.error("FFA401", "no source pattern ops")
     if not rule.dst_ops:
         ctx.error("FFA401", "no destination ops")
+    _lint_precision(rule, ctx)
     if not rule.mapped_outputs:
         # legal in the reference wire format (matches only sites whose
         # outputs have no outside consumers) but almost always a mistake

@@ -108,6 +108,14 @@ class FFConfig:
     # ICI/DCN at half width. None = follow allow_mixed_precision; set
     # False to force f32 gradient storage.
     bf16_grads: Optional[bool] = None
+    # End-to-end static drift budget (analysis/precision.py FFA705): the
+    # accumulated ulp-scaled quantization error a searched strategy may
+    # statically incur along its longest path. None = the pass default
+    # (precision.DEFAULT_DRIFT_BUDGET). runtime/verify.py derives the
+    # differential verifier's per-dtype tolerances from the SAME budget
+    # (tolerance_from_budget), so tightening it makes both the static
+    # lint and the runtime check stricter together.
+    precision_drift_budget: Optional[float] = None
     simulator_work_space_size: int = 64 * 1024 * 1024
     search_num_nodes: int = -1
     search_num_workers: int = -1

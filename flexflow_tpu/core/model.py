@@ -816,6 +816,7 @@ class FFModel:
             and self._tensor_map.get(t.guid) in pre_pos
         }
         _t_phase = time.perf_counter()
+        _pending_artifact_put = False
         if _cache_entry is not None:
             # artifact-cache hit: the stored winner replayed cleanly onto
             # the fresh lowering (degrees + views set, validators passed)
@@ -840,8 +841,11 @@ class FFModel:
                                         "cause": _research_cause}
             self.search_trajectory.phase("strategy_search", _t_phase,
                                          devices=ndev)
-            if store is not None and self._artifact_key is not None:
-                self._artifact_store_put(store, mesh)
+            # the artifact payload is written after the precision pass in
+            # step 4 stamps compute/accum dtypes, so cache replays restore
+            # the full typed strategy (_pending_artifact_put below)
+            _pending_artifact_put = (
+                store is not None and self._artifact_key is not None)
         else:
             tp = max(1, self.config.tensor_parallel_degree)
             sp = max(1, self.config.sequence_parallel_degree)
@@ -941,6 +945,40 @@ class FFModel:
             if self.config.bf16_grads is None else self.config.bf16_grads
         )
         grad_dtype = jnp.bfloat16 if use_bf16_grads else None
+        # Precision as first-class PCG state (analysis/precision.py): stamp
+        # compute_dtype/accum_dtype on the final graph's tensors from the
+        # registry rules, then run the FFA7xx precision audit over the
+        # winner — same warn-don't-block contract as the FFA5xx perf lint
+        # above (fit(lint=...) re-checks and can hard-fail).
+        from ..analysis.precision import (
+            annotate_graph_precision,
+            precision_diagnostics,
+        )
+
+        annotate_graph_precision(
+            self.graph,
+            compute_dtype=(DataType.DT_BF16
+                           if self.config.allow_mixed_precision else None),
+        )
+        prec_rep = precision_diagnostics(
+            self.graph, views=getattr(self, "searched_views", None),
+            num_devices=ndev,
+            drift_budget=self.config.precision_drift_budget,
+            grad_dtype=(DataType.DT_BF16 if use_bf16_grads else None),
+        )
+        if prec_rep.errors:
+            warnings.warn(
+                "static precision analysis flagged the compiled strategy "
+                "(fit(lint=...) re-checks; docs/analysis.md FFA7xx): "
+                + "; ".join(d.format() for d in prec_rep.errors[:5])
+            )
+        self.search_trajectory.event(
+            "precision_lint", errors=len(prec_rep.errors),
+            warnings=len(prec_rep.warnings),
+            codes=sorted({d.code for d in prec_rep}),
+        )
+        if _pending_artifact_put:
+            self._artifact_store_put(store, mesh)
         # Map user input tensors (creation order) to their PCG tensors; only
         # those actually consumed by the graph become executor inputs.
         cur_inputs = self.graph.input_tensors()
@@ -1104,6 +1142,34 @@ class FFModel:
             "perf_lint", errors=len(perf_rep.errors),
             warnings=len(perf_rep.warnings),
             codes=sorted({d.code for d in perf_rep}),
+        )
+        # FFA7xx precision audit of the decode strategy: annotate the
+        # decode graph's precision flow (decode serves under the same AMP
+        # dtype as training compute) and vet it like the train path does
+        from ..analysis.precision import (
+            annotate_graph_precision,
+            precision_diagnostics,
+        )
+
+        annotate_graph_precision(
+            graph,
+            compute_dtype=(DataType.DT_BF16
+                           if cfg.allow_mixed_precision else None),
+        )
+        prec_rep = precision_diagnostics(
+            graph, views=views, num_devices=ndev,
+            drift_budget=cfg.precision_drift_budget,
+        )
+        if prec_rep.errors:
+            warnings.warn(
+                "static precision analysis flagged the decode-searched "
+                "strategy (docs/analysis.md FFA7xx): "
+                + "; ".join(d.format() for d in prec_rep.errors[:5])
+            )
+        self.decode_trajectory.event(
+            "precision_lint", errors=len(prec_rep.errors),
+            warnings=len(prec_rep.warnings),
+            codes=sorted({d.code for d in prec_rep}),
         )
         if export_path:
             from types import SimpleNamespace

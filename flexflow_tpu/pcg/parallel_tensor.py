@@ -148,6 +148,15 @@ class ParallelTensor:
     create_gradients: bool = True
     sync_type: ParameterSyncType = ParameterSyncType.NONE
     initializer: Optional[object] = None
+    # Precision flow (analysis/precision.py): the dtype the producing op
+    # COMPUTES this tensor in (None = data_type, i.e. full precision) and
+    # the dtype its producing op ACCUMULATES in (None = compute dtype;
+    # matmul/attention/reduction ops default to fp32 master accumulation
+    # under mixed precision). Like axis_tag these are deliberately NOT
+    # part of shape_key()/key(): precision annotation never changes the
+    # numeric sharding, so cost caches and graph hashes ignore it.
+    compute_dtype: Optional[DataType] = None
+    accum_dtype: Optional[DataType] = None
 
     @property
     def num_dims(self) -> int:
@@ -180,6 +189,17 @@ class ParallelTensor:
 
     def check_valid(self) -> bool:
         return all(d.is_valid() for d in self.dims)
+
+    def effective_dtype(self) -> DataType:
+        """The dtype this tensor is materialized in: the precision pass's
+        compute_dtype annotation when present, else the declared
+        data_type. Byte accounting (cost_model, analysis/collectives)
+        prices tensors at this width."""
+        return self.compute_dtype if self.compute_dtype is not None \
+            else self.data_type
+
+    def effective_itemsize(self) -> int:
+        return self.effective_dtype().size
 
     def __repr__(self):
         return f"ParallelTensor(guid={self.guid}, {self.get_shape()!r})"

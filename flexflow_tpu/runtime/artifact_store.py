@@ -509,7 +509,10 @@ class _StaleEntry(ValueError):
 # edges, per-dim sharding state), so a field we didn't write can't be
 # guessed and a field we no longer read can't be trusted. Version
 # mismatch degrades to stale -> fresh search, never to a wrong replay.
-STRATEGY_PAYLOAD_SCHEMA = 3
+# v4: output records carry compute_dtype/accum_dtype (precision-flow
+# annotations, analysis/precision.py) so a cache hit replays with the
+# byte accounting and verify tolerances it was searched under.
+STRATEGY_PAYLOAD_SCHEMA = 4
 
 
 def _dim_to_json(d) -> list:
@@ -641,6 +644,10 @@ def strategy_payload(graph, views: Optional[dict], *, cost=None,
             "inputs": refs,
             "outputs": [
                 {"dtype": t.data_type.name,
+                 "compute_dtype": (t.compute_dtype.name
+                                   if t.compute_dtype is not None else None),
+                 "accum_dtype": (t.accum_dtype.name
+                                 if t.accum_dtype is not None else None),
                  "dims": [_dim_to_json(d) for d in t.dims]}
                 for t in op.outputs
             ],
@@ -702,6 +709,22 @@ def replay_strategy(graph, payload: dict, *, num_devices: int):
     from ..pcg.parallel_tensor import ParallelTensor
     from ..pcg.machine_view import MachineView
     from .strategy_io import StrategyImportError
+
+    def _prec_of(name, srec):
+        """Decode the stored precision annotations (None = unannotated)."""
+        out = []
+        for key in ("compute_dtype", "accum_dtype"):
+            v = srec.get(key)
+            if v is None:
+                out.append(None)
+                continue
+            try:
+                out.append(DataType[v])
+            except KeyError:
+                raise StrategyImportError(
+                    f"op {name!r}: unknown {key} {v!r}"
+                )
+        return out
 
     if payload.get("kind") != "strategy":
         raise StrategyImportError(
@@ -834,6 +857,7 @@ def replay_strategy(graph, payload: dict, *, num_devices: int):
                         f"fresh {old_n}"
                     )
                 t.dims = new_dims
+                t.compute_dtype, t.accum_dtype = _prec_of(name, srec)
             wrecs = node.get("weights") or []
             if len(wrecs) != len(op.weights):
                 raise StrategyImportError(
@@ -877,6 +901,7 @@ def replay_strategy(graph, payload: dict, *, num_devices: int):
                     dims=[_dim_from_json(d) for d in srec["dims"]],
                     data_type=dtype,
                 )
+                t.compute_dtype, t.accum_dtype = _prec_of(name, srec)
                 t.owner_op = op
                 op.outputs.append(t)
         mv = node.get("machine_view")

@@ -120,6 +120,12 @@ class KVCacheConfig:
     num_pages: int
     page_size: int = 16
     watermark: float = 0.0
+    # dtype the pooled K/V blocks are materialized in, as a numpy dtype
+    # string ("float32", "bfloat16", "int8", ...). None defers to the
+    # executor's compute dtype (kv_page_bytes' historical behavior).
+    # Quantized caches (int8) double the sessions a byte budget admits
+    # relative to fp16/bf16 — see tests/test_precision.py.
+    kv_dtype: Optional[str] = None
 
     def __post_init__(self):
         if self.num_pages <= 0:
@@ -128,6 +134,16 @@ class KVCacheConfig:
             raise ValueError(f"page_size must be positive: {self.page_size}")
         if not 0.0 <= self.watermark < 1.0:
             raise ValueError(f"watermark must be in [0, 1): {self.watermark}")
+        if self.kv_dtype is not None:
+            import numpy as np
+
+            try:
+                np.dtype(self.kv_dtype)
+            except TypeError as e:
+                raise ValueError(
+                    f"kv_dtype {self.kv_dtype!r} is not a numpy dtype "
+                    f"name: {e}"
+                ) from e
         if self.watermark > 0.0 and self.held_back_pages() >= self.num_pages:
             raise ValueError(
                 f"watermark {self.watermark} holds back every page of a "
@@ -838,10 +854,15 @@ def audit_state(state: dict) -> AuditReport:
                        bindings=sum(len(t) for t in tables.values()))
 
 
-def kv_page_bytes(model, page_size: int) -> Optional[int]:
+def kv_page_bytes(model, page_size: int,
+                  kv_dtype: Optional[str] = None) -> Optional[int]:
     """Bytes one page costs across the model's self-attention layers
     (2 * page_size * heads * head_dim * itemsize per layer) — the
     docs/serving.md sizing formula, computed from the compiled graph.
+    `kv_dtype` (a numpy dtype name, e.g. KVCacheConfig.kv_dtype) prices
+    the page at an explicit cache dtype — a quantized int8 pool admits
+    ~2x the sessions of an fp16 pool in the same byte budget; None keeps
+    the executor's compute dtype (fp32 when unset).
     Returns None when the graph has no fused-MHA self-attention (e.g.
     primitive-op imports, where the cache cost lives in prefix tensors)."""
     import numpy as np
@@ -852,10 +873,13 @@ def kv_page_bytes(model, page_size: int) -> Optional[int]:
     if ex is None:
         return None
     total = 0
-    itemsize = np.dtype(np.float32).itemsize
-    cdt = getattr(ex, "compute_dtype", None)
-    if cdt is not None:
-        itemsize = np.dtype(cdt).itemsize
+    if kv_dtype is not None:
+        itemsize = np.dtype(kv_dtype).itemsize
+    else:
+        itemsize = np.dtype(np.float32).itemsize
+        cdt = getattr(ex, "compute_dtype", None)
+        if cdt is not None:
+            itemsize = np.dtype(cdt).itemsize
     for op in ex.topo:
         if getattr(op, "op_type", None) != OperatorType.OP_MULTIHEAD_ATTENTION:
             continue

@@ -529,8 +529,14 @@ class CheckpointManager:
             names = os.listdir(self.directory)
         except OSError:
             return
+        pid_suffix = str(os.getpid())
         for name in names:
             if ".tmp-" in name:
+                # tmp names end in the writer's pid; OUR pid means another
+                # manager in this process (warm spare / replica sharing the
+                # dir) may be mid-save — sweeping its tmp races os.replace
+                if name.rsplit("-", 1)[-1] == pid_suffix:
+                    continue
                 p = os.path.join(self.directory, name)
                 shutil.rmtree(p, ignore_errors=True)
                 if os.path.isfile(p):

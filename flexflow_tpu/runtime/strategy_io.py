@@ -18,6 +18,7 @@ import json
 import logging
 from typing import Dict, List, Optional
 
+from ..ff_types import DataType
 from ..pcg.graph import Graph
 from ..pcg.machine_view import MachineView
 
@@ -33,7 +34,16 @@ logger = logging.getLogger("flexflow_tpu.runtime.strategy_io")
 # rejected — a pre-FSDP reader applying it would silently replicate
 # state the strategy expects sharded. Replicated-only v1 files load
 # unchanged.
-SCHEMA_VERSION = 2
+# v3: records carry per-tensor dtype state — "output_dtypes" ([{data,
+# compute, accum}] name strings, compute/accum null when unannotated)
+# and "weight_dtypes" ([data name]) — so a cached strategy replays with
+# its precision flow intact (analysis/precision.py annotates
+# compute/accum; byte accounting and verify tolerances consume them). A
+# pre-v3 file that nonetheless carries a non-default compute/accum
+# annotation is rejected the same way sharded v1 state is: a pre-
+# precision reader would silently replay a mixed-precision strategy at
+# full width, invalidating every byte estimate it was searched under.
+SCHEMA_VERSION = 3
 
 
 class StrategyImportError(ValueError):
@@ -48,6 +58,17 @@ def _weight_shard_of(op) -> Optional[dict]:
             and op.op_type.name == "OP_WEIGHT_SHARD":
         return {"axis": "fsdp", "degree": int(op.params.shard_degree)}
     return None
+
+
+def _dtype_record(t) -> dict:
+    """Per-tensor dtype triple: declared storage dtype plus the precision
+    annotations (analysis/precision.py), null when unannotated."""
+    return {
+        "data": t.data_type.name,
+        "compute": t.compute_dtype.name if t.compute_dtype is not None
+        else None,
+        "accum": t.accum_dtype.name if t.accum_dtype is not None else None,
+    }
 
 
 def op_strategy_record(op, view: Optional[MachineView]) -> dict:
@@ -73,6 +94,11 @@ def op_strategy_record(op, view: Optional[MachineView]) -> dict:
         "weight_degrees": [
             [d.degree for d in t.dims] for t in op.weights
         ],
+        "output_dtypes": [_dtype_record(t) for t in op.outputs],
+        # weights keep master storage at their declared width (precision
+        # annotations never touch them — see annotate_graph_precision),
+        # so only the data dtype rides along
+        "weight_dtypes": [w.data_type.name for w in op.weights],
     }
 
 
@@ -128,6 +154,27 @@ def _validate_record(rec, idx: int) -> None:
                 f"op {name!r}: weight_shard must be null or "
                 "{{axis: str, degree: int >= 1}}"
             )
+    for dt in rec.get("output_dtypes", []):
+        if not isinstance(dt, dict) or "data" not in dt:
+            raise StrategyImportError(
+                f"op {name!r}: output_dtypes entries must be objects "
+                "with a 'data' dtype name"
+            )
+        for key in ("data", "compute", "accum"):
+            v = dt.get(key)
+            if v is None and key != "data":
+                continue
+            if not isinstance(v, str) or v not in DataType.__members__:
+                raise StrategyImportError(
+                    f"op {name!r}: output_dtypes {key}={v!r} is not a "
+                    "DataType name"
+                )
+    for v in rec.get("weight_dtypes", []):
+        if not isinstance(v, str) or v not in DataType.__members__:
+            raise StrategyImportError(
+                f"op {name!r}: weight_dtypes entry {v!r} is not a "
+                "DataType name"
+            )
 
 
 def import_strategy(path: str) -> Dict[str, dict]:
@@ -168,6 +215,13 @@ def import_strategy(path: str) -> Dict[str, dict]:
                 "degree > 1) — re-export the strategy with this build "
                 f"(schema {SCHEMA_VERSION})"
             )
+        if version < 3 and _record_has_precision_state(rec):
+            raise StrategyImportError(
+                f"{path}: schema version {version} predates precision "
+                f"flow but op {rec.get('name')!r} carries a compute/accum "
+                "dtype annotation — re-export the strategy with this "
+                f"build (schema {SCHEMA_VERSION})"
+            )
         if rec["name"] in out:
             logger.warning("strategy %s: duplicate op record %r (last wins)",
                            path, rec["name"])
@@ -182,6 +236,16 @@ def _record_has_sharded_state(rec: dict) -> bool:
         return True
     ws = rec.get("weight_shard")
     return isinstance(ws, dict) and ws.get("degree", 1) > 1
+
+
+def _record_has_precision_state(rec: dict) -> bool:
+    """Whether a record carries a non-default precision annotation (a
+    compute or accum dtype on any output)."""
+    return any(
+        isinstance(dt, dict)
+        and (dt.get("compute") is not None or dt.get("accum") is not None)
+        for dt in rec.get("output_dtypes", [])
+    )
 
 
 def _check_feasible(rec: dict, num_devices: int) -> None:
@@ -270,4 +334,12 @@ def apply_imported_strategy(
         for w, degs in zip(op.weights, rec.get("weight_degrees", [])):
             for d, deg in zip(w.dims, degs):
                 d.degree = deg
+        for t, dt in zip(op.outputs, rec.get("output_dtypes", [])):
+            t.data_type = DataType[dt["data"]]
+            t.compute_dtype = (DataType[dt["compute"]]
+                               if dt.get("compute") is not None else None)
+            t.accum_dtype = (DataType[dt["accum"]]
+                             if dt.get("accum") is not None else None)
+        for w, name in zip(op.weights, rec.get("weight_dtypes", [])):
+            w.data_type = DataType[name]
     return unmatched

@@ -136,6 +136,17 @@ DTYPE_TOLERANCES: Dict[str, Tuple[float, float]] = {
 }
 _DEFAULT_TOL = (2e-4, 1e-5)
 
+# How much of the static FFA705 drift budget (analysis/precision.py) a
+# legal sharding-induced reorder is allowed to consume. The budget bounds
+# accumulated ulp-scaled roundoff along the longest compute path; a
+# reordered-but-equivalent strategy should stay well inside it, so the
+# verify tolerance is capped at this fraction of the budget. At the
+# default budget (0.25) the cap is 5e-2 — exactly the bf16 table row —
+# so the table governs until someone TIGHTENS the budget, at which point
+# verification tightens with it (the two knobs share
+# FFConfig.precision_drift_budget).
+DRIFT_TO_TOLERANCE = 0.2
+
 
 def tolerance_for(dtype, rtol: Optional[float] = None,
                   atol: Optional[float] = None) -> Tuple[float, float]:
@@ -145,6 +156,20 @@ def tolerance_for(dtype, rtol: Optional[float] = None,
                                 else "float32", _DEFAULT_TOL)
     return (base[0] if rtol is None else rtol,
             base[1] if atol is None else atol)
+
+
+def tolerance_from_budget(dtype_key: str,
+                          drift_budget: Optional[float]) -> Tuple[float,
+                                                                  float]:
+    """Derive the (rtol, atol) pair for `dtype_key` from the static drift
+    budget: the per-dtype table row, capped at DRIFT_TO_TOLERANCE of the
+    budget. None uses the analyzer's default budget."""
+    from ..analysis.precision import DEFAULT_DRIFT_BUDGET
+
+    base = DTYPE_TOLERANCES.get(dtype_key, _DEFAULT_TOL)
+    budget = DEFAULT_DRIFT_BUDGET if drift_budget is None else drift_budget
+    cap = max(budget, 0.0) * DRIFT_TO_TOLERANCE
+    return (min(base[0], cap), min(base[1], cap))
 
 
 # ----------------------------------------------------------------------
@@ -657,10 +682,14 @@ def verify_strategy(model, data, *, steps: int = 2,
         raise ValueError(
             f"verify_strategy: dataset has {n} samples < batch_size {bs}"
         )
-    # tolerance keyed by the model's COMPUTE dtype: mixed-precision math
-    # legitimately reorders bf16 roundoff across shardings
-    base = DTYPE_TOLERANCES["bfloat16" if ex.compute_dtype is not None
-                            else "float32"]
+    # tolerance keyed by the model's COMPUTE dtype (mixed-precision math
+    # legitimately reorders bf16 roundoff across shardings), then capped
+    # by the static drift budget: tightening
+    # FFConfig.precision_drift_budget tightens what verification accepts
+    base = tolerance_from_budget(
+        "bfloat16" if ex.compute_dtype is not None else "float32",
+        getattr(model.config, "precision_drift_budget", None),
+    )
     r = base[0] if rtol is None else rtol
     t = base[1] if atol is None else atol
 

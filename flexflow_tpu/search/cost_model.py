@@ -206,12 +206,17 @@ def op_padded_flops(op: PCGOp, parts: int = 1) -> float:
 
 
 def op_bytes(op: PCGOp) -> float:
-    """HBM traffic of the whole op (inputs + outputs + weights, once)."""
+    """HBM traffic of the whole op (inputs + outputs + weights, once).
+
+    Activations move at their COMPUTE width (analysis/precision.py
+    annotations — a bf16 flow streams 2 bytes/elt); weights stay at
+    their declared storage width, because the fp32 master copy is what
+    the op actually reads from HBM under AMP."""
     n = 0
     for x in op.inputs:
-        n += _vol(x.material_shape()) * x.data_type.size
+        n += _vol(x.material_shape()) * x.effective_itemsize()
     for x in op.outputs:
-        n += _vol(x.material_shape()) * x.data_type.size
+        n += _vol(x.material_shape()) * x.effective_itemsize()
     for w in op.weights:
         n += _vol(w.material_shape()) * w.data_type.size
     return float(n)
@@ -242,11 +247,12 @@ def op_decode_bytes(op: PCGOp) -> float:
     if op.op_type == OperatorType.OP_MULTIHEAD_ATTENTION \
             and len(op.inputs) >= 3:
         # the persistent (b, max_len, h, d) K/V pair the step attends
-        # over — byte-equivalent to the full k/v inputs
+        # over — byte-equivalent to the full k/v inputs; the cache is
+        # materialized at the compute width (bf16 under AMP)
         for x in op.inputs[1:3]:
-            n += _vol(x.material_shape()) * x.data_type.size
+            n += _vol(x.material_shape()) * x.effective_itemsize()
     for x in list(op.inputs) + list(op.outputs):
-        n += _vol(x.material_shape()) * x.data_type.size \
+        n += _vol(x.material_shape()) * x.effective_itemsize() \
             / max(1, _seq_extent(x))
     return n
 
@@ -531,11 +537,11 @@ class CostModel:
             head_deg = max(
                 [max(1, w.get_total_degree()) for w in op.weights] or [1]
             )
-            kv = sum(_vol(x.material_shape()) * x.data_type.size
+            kv = sum(_vol(x.material_shape()) * x.effective_itemsize()
                      for x in op.inputs[1:3])
             membytes += kv / max(1, batch_deg * head_deg)
         for x in list(op.inputs) + list(op.outputs):
-            membytes += _vol(x.material_shape()) * x.data_type.size \
+            membytes += _vol(x.material_shape()) * x.effective_itemsize() \
                 / max(1, _seq_extent(x)) / parts
         mxu_eff, hbm_eff = self._calibrated_efficiencies(
             op.op_type, flops, membytes
@@ -553,11 +559,11 @@ class CostModel:
             backward_time=0.0,
             sync_time=0.0,
             inputs_memory=int(
-                sum(_vol(t.material_shape()) * t.data_type.size
+                sum(_vol(t.material_shape()) * t.effective_itemsize()
                     for t in op.inputs) / parts
             ),
             outputs_memory=int(
-                sum(_vol(t.material_shape()) * t.data_type.size
+                sum(_vol(t.material_shape()) * t.effective_itemsize()
                     for t in op.outputs) / parts
             ),
             weights_memory=wmem,
@@ -623,7 +629,7 @@ class CostModel:
             group = view.device_ids()[:sd]
             if len(group) >= 2:
                 kv_bytes = 2 * _vol(op.inputs[1].material_shape()) \
-                    * op.inputs[1].data_type.size
+                    * op.inputs[1].effective_itemsize()
                 rot = self.machine.all_to_all_cost(kv_bytes, group)
                 fwd += rot
                 bwd += 2 * rot

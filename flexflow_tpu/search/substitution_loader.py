@@ -20,7 +20,7 @@ import itertools
 import json
 from typing import Dict, Iterator, List, Optional, Tuple
 
-from ..ff_types import ActiMode, OperatorType
+from ..ff_types import ActiMode, DataType, OperatorType
 from ..parallel.parallel_ops import (
     AllToAllParams,
     CombineParams,
@@ -316,6 +316,20 @@ def _op_matches(op: PCGOp, pat: OpPattern) -> bool:
         alpha = getattr(op.params, "alpha", None)
         if alpha is None or round(alpha * 100) != capx:
             return False
+    prec = pat.params.get("PM_PRECISION")
+    if prec is not None:
+        # precision-rewrite guard (analysis/precision.py): the src
+        # pattern pins the op's OUTPUT effective dtype (value = the
+        # DataType enum member), so a quantizing rule fires only on ops
+        # still computing at the dtype it demotes — and its inverse
+        # can't ping-pong on the same site
+        if not op.outputs:
+            return False
+        t = op.outputs[0]
+        eff = t.compute_dtype if t.compute_dtype is not None \
+            else t.data_type
+        if int(eff) != prec:
+            return False
     return True
 
 
@@ -537,6 +551,18 @@ def apply_rule(graph: Graph, rule: Rule) -> Iterator[Graph]:
                 for t in outs:
                     t.owner_op = nop
                     nop.outputs.append(t)
+                # PM_PRECISION / PM_ACCUM_PRECISION on a dst op stamp the
+                # precision annotation (values = DataType enum members)
+                # the FFA7xx pass and verify's drift-budget tolerances
+                # then audit; FFA407 vets the declaration at load time
+                prec = dpat.params.get("PM_PRECISION")
+                accp = dpat.params.get("PM_ACCUM_PRECISION")
+                if prec is not None or accp is not None:
+                    for t in nop.outputs:
+                        if prec is not None:
+                            t.compute_dtype = DataType(prec)
+                        if accp is not None:
+                            t.accum_dtype = DataType(accp)
                 if fresh_weights:
                     _attach_fresh_weights(nop, src_params_op)
                 elif src_params_op is not None:
@@ -675,7 +701,10 @@ def _infer_outputs(op: PCGOp, src_op: Optional[PCGOp]) -> List[ParallelTensor]:
                 raise ValueError("all_to_all: dims not resharddable")
             dims[g].degree = 1
             dims[s].degree = d
-        return [ParallelTensor(dims=dims, data_type=in_t.data_type)]
+        # parallel ops move shards, never change numerics: the precision
+        # flow carries straight through the reshard
+        return [ParallelTensor(dims=dims, data_type=in_t.data_type,
+                               compute_dtype=in_t.compute_dtype)]
     d = get_op_def(op.op_type)
     shapes, dtypes = d.infer(
         op.params,

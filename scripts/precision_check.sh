@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# FFA7xx precision-flow check (docs/analysis.md `precision` pass). Legs:
+#   1. 8-device mesh: the full precision test suite — seeded defects
+#      fire each of FFA701-705 (+ FFA407 in the rule lint), the mixed
+#      zoo sweep is FFA7xx-error-free, strategy_io/artifact-store
+#      round-trips preserve dtype annotations, and tightening
+#      precision_drift_budget flips a borderline strategy to a typed
+#      StrategyDivergenceError (tolerances derive from the budget);
+#   2. 4-device mesh: analyzer CLI under --fail-on error over the bench
+#      Transformer compiled --mixed-precision (default budget, then an
+#      explicitly loose --drift-budget) — the searched bf16 strategy
+#      must be statically clean;
+#   3. both shipped rule collections re-linted (FFA407 rides the same
+#      rules command CI already gates on).
+# CI wires this into the lint workflow alongside the other *_check.sh.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "=== precision_check leg 1: 8-device precision test suite ==="
+JAX_NUM_CPU_DEVICES=8 python -m pytest tests/test_precision.py -q \
+    -p no:cacheprovider
+
+echo "=== precision_check leg 2: 4-device analyzer CLI, mixed precision ==="
+JAX_NUM_CPU_DEVICES=4 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    python -m flexflow_tpu.analysis model \
+    --budget 2 --mixed-precision --fail-on error
+JAX_NUM_CPU_DEVICES=4 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    python -m flexflow_tpu.analysis model \
+    --budget 2 --mixed-precision --drift-budget 0.5 --fail-on error
+
+echo "=== precision_check leg 3: shipped rule collections (FFA407) ==="
+python -m flexflow_tpu.analysis --fail-on error
+
+echo "precision_check: OK"

@@ -544,7 +544,11 @@ def test_ffl103_kernel_scope_and_pragma():
         "    q = np.asarray(refs)\n"
     )
     hits = lint_source(src, "/x/flexflow_tpu/kernels/k.py")
-    assert [f.code for f in hits] == ["FFL103"] and hits[0].line == 2
+    # the dtype-less asarray in the kernel body also trips FFL301
+    # (float64 creep); this test cares about the FFL103 scoping
+    sync_hits = [f for f in hits if f.code == "FFL103"]
+    assert {f.code for f in hits} == {"FFL103", "FFL301"}
+    assert len(sync_hits) == 1 and sync_hits[0].line == 2
     suppressed = src.replace("q = np.asarray(refs)\n",
                              "q = np.asarray(refs)  "
                              "# fflint: disable=FFL103\n", 1)

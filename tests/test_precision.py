@@ -1,0 +1,776 @@
+"""FFA7xx precision-flow analyzer tests (flexflow_tpu/analysis/precision.py).
+
+Covers: compute/accum dtype as first-class ParallelTensor state, the
+registry-driven annotation pass, each FFA701-705 check on a seeded-defect
+PCG, FFA407 in the substitution-rule lint plus PM_PRECISION match/apply in
+the loader, effective-dtype byte accounting (collectives + cost model +
+KV cache), strategy_io/artifact-store round-trips preserving dtypes, the
+verify-tolerance-from-drift-budget derivation (tightening the budget
+flips a borderline strategy to a typed failure), a mixed-precision clean
+zoo sweep (zero FFA7xx errors on searched strategies), and the FFL301
+float64-creep fflint rule. scripts/precision_check.sh re-runs this file
+plus the analyzer CLI standalone."""
+import dataclasses
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexflow_tpu import (
+    ActiMode,
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+    Severity,
+    analyze_model,
+)
+from flexflow_tpu.analysis import analyze_rules_path, strategy_violations
+from flexflow_tpu.analysis.precision import (
+    DEFAULT_DRIFT_BUDGET,
+    RING_DEGREE_THRESHOLD,
+    annotate_graph_precision,
+    estimate_drift,
+    precision_diagnostics,
+)
+from flexflow_tpu.ff_types import OperatorType
+from flexflow_tpu.ops.elementwise import (
+    ElementBinaryParams,
+    ElementUnaryParams,
+)
+from flexflow_tpu.ops.linear import LinearParams
+from flexflow_tpu.ops.tensor_ops import CastParams
+from flexflow_tpu.parallel.parallel_ops import ReductionParams
+from flexflow_tpu.pcg.graph import Graph
+from flexflow_tpu.pcg.op import PCGOp
+from flexflow_tpu.pcg.parallel_tensor import ParallelTensor, make_dims
+from flexflow_tpu.runtime.resilience import StepGuardConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------------------
+# graph-building helpers (no compile, no devices)
+# ----------------------------------------------------------------------
+def pt(sizes, degrees=None, replicas=None, dtype=DataType.DT_FLOAT):
+    return ParallelTensor(dims=make_dims(sizes, degrees, replicas),
+                          data_type=dtype)
+
+
+def add_op(graph, op_type, params, inputs, out):
+    op = PCGOp(op_type, params, inputs)
+    out.owner_op = op
+    op.outputs.append(out)
+    graph.add_op(op)
+    return op
+
+
+def bf16(t):
+    t.compute_dtype = DataType.DT_BF16
+    return t
+
+
+# ----------------------------------------------------------------------
+# tentpole core: dtype as first-class ParallelTensor state
+# ----------------------------------------------------------------------
+def test_parallel_tensor_precision_fields():
+    t = pt([8, 16])
+    assert t.compute_dtype is None and t.accum_dtype is None
+    assert t.effective_dtype() is DataType.DT_FLOAT
+    assert t.effective_itemsize() == 4
+    t.compute_dtype = DataType.DT_BF16
+    assert t.effective_dtype() is DataType.DT_BF16
+    assert t.effective_itemsize() == 2
+    # like axis_tag, precision annotations must NOT perturb the shape
+    # key (cost-model caches key on it)
+    u = pt([8, 16])
+    assert t.shape_key() == u.shape_key()
+
+
+def test_annotate_graph_precision_flow_and_idempotence():
+    g = Graph()
+    x = pt([8, 16])
+    h = pt([8, 32])
+    lin = add_op(g, OperatorType.OP_LINEAR, LinearParams(32), [x], h)
+    w = pt([16, 32])
+    lin.weights.append(w)
+    y = pt([8, 32])
+    add_op(g, OperatorType.OP_RELU,
+           ElementUnaryParams(op_type=OperatorType.OP_RELU), [h], y)
+    annotate_graph_precision(g, compute_dtype=DataType.DT_BF16)
+    # outputs annotated: graph inputs enter through the AMP cast, so the
+    # whole flow runs bf16 with an fp32 accumulator on the matmul
+    assert h.compute_dtype is DataType.DT_BF16
+    assert h.accum_dtype is DataType.DT_FLOAT
+    assert y.compute_dtype is DataType.DT_BF16
+    # weights are NEVER annotated (fp32 master storage keeps data_type
+    # width in every memory account)
+    assert w.compute_dtype is None and w.effective_itemsize() == 4
+    # None clears: re-annotation is idempotent
+    annotate_graph_precision(g, compute_dtype=None)
+    assert h.compute_dtype is None and h.accum_dtype is None
+    assert y.compute_dtype is None
+
+
+def test_cast_op_redirects_the_flow():
+    g = Graph()
+    x = pt([8, 16])
+    c = pt([8, 16])
+    add_op(g, OperatorType.OP_CAST, CastParams(dtype=DataType.DT_FLOAT),
+           [x], c)
+    y = pt([8, 16])
+    add_op(g, OperatorType.OP_RELU,
+           ElementUnaryParams(op_type=OperatorType.OP_RELU), [c], y)
+    annotate_graph_precision(g, compute_dtype=DataType.DT_BF16)
+    # the explicit cast promotes back to fp32 and downstream follows
+    assert c.compute_dtype is None  # == data_type, stored as None
+    assert y.compute_dtype is None
+
+
+# ----------------------------------------------------------------------
+# FFA701-705 on seeded defects
+# ----------------------------------------------------------------------
+def test_ffa701_boundary_mix_flags_and_cast_fixes():
+    g = Graph()
+    a, b = pt([8, 16]), bf16(pt([8, 16]))
+    s = pt([8, 16])
+    add_op(g, OperatorType.OP_EW_ADD,
+           ElementBinaryParams(op_type=OperatorType.OP_EW_ADD), [a, b], s)
+    rep = precision_diagnostics(g)
+    assert [d.code for d in rep.errors] == ["FFA701"]
+    assert "DT_BF16" in rep.errors[0].message
+    # the fix: cast the narrow operand up, boundary becomes clean
+    g2 = Graph()
+    a2, b2 = pt([8, 16]), bf16(pt([8, 16]))
+    c2 = pt([8, 16])
+    add_op(g2, OperatorType.OP_CAST,
+           CastParams(dtype=DataType.DT_FLOAT), [b2], c2)
+    s2 = pt([8, 16])
+    add_op(g2, OperatorType.OP_EW_ADD,
+           ElementBinaryParams(op_type=OperatorType.OP_EW_ADD),
+           [a2, c2], s2)
+    assert precision_diagnostics(g2).ok
+
+
+def test_ffa702_low_precision_accumulation():
+    g = Graph()
+    x = pt([8, 256])
+    h = pt([8, 32])
+    add_op(g, OperatorType.OP_LINEAR, LinearParams(32), [x], h)
+    h.compute_dtype = DataType.DT_BF16
+    h.accum_dtype = None  # seeded defect: bf16 accumulate, no fp32 master
+    rep = precision_diagnostics(g, drift_budget=1e9)
+    codes = [d.code for d in rep.errors]
+    assert codes == ["FFA702"]
+    assert "256" in rep.errors[0].message  # names the reduction width
+    # the default inference never produces this state
+    h.accum_dtype = DataType.DT_FLOAT
+    assert precision_diagnostics(g, drift_budget=1e9).ok
+
+
+def test_ffa703_low_precision_ring_reduction_names_degree():
+    g = Graph()
+    x = bf16(pt([8, 16], replicas=[8]))
+    y = pt([8, 16])
+    add_op(g, OperatorType.OP_REDUCTION,
+           ReductionParams(reduction_dim=0, reduction_degree=8), [x], y)
+    rep = precision_diagnostics(g, drift_budget=1e9)
+    warns = rep.by_code("FFA703")
+    assert len(warns) == 1 and warns[0].severity is Severity.WARNING
+    assert "degree 8" in warns[0].message
+    # narrow rings stay quiet
+    g2 = Graph()
+    x2 = bf16(pt([8, 16], replicas=[2]))
+    y2 = pt([8, 16])
+    add_op(g2, OperatorType.OP_REDUCTION,
+           ReductionParams(reduction_dim=0, reduction_degree=2), [x2], y2)
+    assert not precision_diagnostics(g2, drift_budget=1e9).by_code("FFA703")
+    assert RING_DEGREE_THRESHOLD == 4
+
+
+def test_ffa703_implicit_weight_grad_sync_aggregate_warning():
+    g = Graph()
+    x = pt([8, 16])
+    h = pt([8, 32])
+    lin = add_op(g, OperatorType.OP_LINEAR, LinearParams(32), [x], h)
+    lin.weights.append(pt([16, 32]))
+    rep = precision_diagnostics(g, num_devices=8,
+                                grad_dtype=DataType.DT_BF16,
+                                drift_budget=1e9)
+    warns = rep.by_code("FFA703")
+    assert len(warns) == 1
+    assert "degree 8" in warns[0].message and "DT_BF16" in warns[0].message
+    # fp32 grads: no warning
+    assert not precision_diagnostics(
+        g, num_devices=8, grad_dtype=None, drift_budget=1e9
+    ).by_code("FFA703")
+
+
+def test_ffa704_guard_range_vs_dtype():
+    g = Graph()
+    x = pt([8, 16], dtype=DataType.DT_HALF)
+    y = pt([8, 16], dtype=DataType.DT_HALF)
+    add_op(g, OperatorType.OP_RELU,
+           ElementUnaryParams(op_type=OperatorType.OP_RELU), [x], y)
+    # f16 with no loss scaling at all
+    rep = precision_diagnostics(g, drift_budget=1e9)
+    assert any("loss scaling" in d.message
+               for d in rep.by_code("FFA704"))
+    # ceiling above f16's max finite value (~6.5e4)
+    guard = StepGuardConfig(init_loss_scale=2.0 ** 20)
+    rep2 = precision_diagnostics(g, step_guard=guard, drift_budget=1e9)
+    assert any("overflow" in d.message for d in rep2.by_code("FFA704"))
+    # a sane guard is quiet
+    guard3 = StepGuardConfig(init_loss_scale=2.0 ** 15,
+                             min_loss_scale=2.0 ** -13)
+    assert not precision_diagnostics(
+        g, step_guard=guard3, drift_budget=1e9
+    ).by_code("FFA704")
+
+
+def test_ffa705_drift_budget_and_fix_hint():
+    g = Graph()
+    x = pt([8, 16384])
+    h = pt([8, 32])
+    add_op(g, OperatorType.OP_LINEAR, LinearParams(32), [x], h)
+    h.compute_dtype = DataType.DT_BF16  # bf16 accumulate over 16384 terms
+    total, contrib = estimate_drift(g)
+    assert total > DEFAULT_DRIFT_BUDGET
+    rep = precision_diagnostics(g)
+    errs = rep.by_code("FFA705")
+    assert len(errs) == 1
+    # the fix_hint names the op to promote and the config knob
+    assert errs[0].fix_hint and "precision_drift_budget" in errs[0].fix_hint
+    assert errs[0].op_name
+    # raising the budget (the documented escape hatch) silences it
+    assert not precision_diagnostics(
+        g, drift_budget=total + 1.0
+    ).by_code("FFA705")
+    # the proper fix — fp32 accumulator — brings the estimate under
+    h.accum_dtype = DataType.DT_FLOAT
+    total_fixed, _ = estimate_drift(g)
+    assert total_fixed < DEFAULT_DRIFT_BUDGET
+    assert precision_diagnostics(g).by_code("FFA705") == []
+
+
+def test_estimate_drift_fp32_graph_is_negligible():
+    g = Graph()
+    x = pt([8, 1024])
+    h = pt([8, 64])
+    add_op(g, OperatorType.OP_LINEAR, LinearParams(64), [x], h)
+    total, _ = estimate_drift(g)
+    assert total < 1e-5  # fp32 eps-scale, nowhere near any budget
+
+
+# ----------------------------------------------------------------------
+# FFA407 + PM_PRECISION in the substitution loader
+# ----------------------------------------------------------------------
+def _precision_rule(src_para=(), dst_para=(), name="prec_rule"):
+    return {"rule": [{
+        "name": name,
+        "srcOp": [{"type": "OP_LINEAR",
+                   "input": [{"opId": -1, "tsId": 0}],
+                   "para": [dict(p) for p in src_para]}],
+        "dstOp": [{"type": "OP_LINEAR",
+                   "input": [{"opId": -1, "tsId": 0}],
+                   "para": [dict(p) for p in dst_para]}],
+        "mappedOutput": [{"srcOpId": 0, "srcTsId": 0,
+                          "dstOpId": 0, "dstTsId": 0}],
+    }]}
+
+
+def test_ffa407_rejects_non_float_precision_value(tmp_path):
+    bad = _precision_rule(
+        dst_para=[{"key": "PM_PRECISION",
+                   "value": int(DataType.DT_INT32)}],
+        name="int_precision")
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(bad))
+    rep = analyze_rules_path(str(p))
+    errs = rep.by_code("FFA407")
+    assert errs and "float DataType" in errs[0].message
+
+
+def test_ffa407_low_precision_accumulating_dst_needs_accum(tmp_path):
+    bad = _precision_rule(
+        dst_para=[{"key": "PM_PRECISION",
+                   "value": int(DataType.DT_BF16)}],
+        name="bf16_no_accum")
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(bad))
+    rep = analyze_rules_path(str(p))
+    errs = rep.by_code("FFA407")
+    assert len(errs) == 1
+    assert "PM_ACCUM_PRECISION" in (errs[0].fix_hint or "")
+    # declaring the accumulator makes the rule sound
+    good = _precision_rule(
+        dst_para=[{"key": "PM_PRECISION", "value": int(DataType.DT_BF16)},
+                  {"key": "PM_ACCUM_PRECISION",
+                   "value": int(DataType.DT_FLOAT)}],
+        name="bf16_with_accum")
+    p2 = tmp_path / "good.json"
+    p2.write_text(json.dumps(good))
+    assert analyze_rules_path(str(p2)).ok
+
+
+def test_pm_precision_gates_matching_and_stamps_dst():
+    from flexflow_tpu.pcg.lowering import layers_to_pcg
+    from flexflow_tpu.search.substitution_loader import (
+        apply_rule,
+        load_rule_collection,
+    )
+
+    rules = load_rule_collection(_precision_rule(
+        src_para=[{"key": "PM_PRECISION", "value": int(DataType.DT_BF16)}],
+        dst_para=[{"key": "PM_PRECISION", "value": int(DataType.DT_BF16)},
+                  {"key": "PM_ACCUM_PRECISION",
+                   "value": int(DataType.DT_FLOAT)}],
+        name="bf16_gate"))
+    model = FFModel(FFConfig())
+    x = model.create_tensor((64, 32), DataType.DT_FLOAT)
+    model.dense(x, 16)
+    graph, _ = layers_to_pcg(model.layers)
+    # the un-annotated fp32 graph does NOT match a bf16 pattern
+    assert list(apply_rule(graph, rules[0])) == []
+    # annotate the site bf16 -> the rule fires and stamps the dst op
+    lin = next(op for op in graph.ops
+               if op.op_type == OperatorType.OP_LINEAR)
+    lin.outputs[0].compute_dtype = DataType.DT_BF16
+    cands = list(apply_rule(graph, rules[0]))
+    assert len(cands) == 1
+    out = next(op for op in cands[0].ops
+               if op.op_type == OperatorType.OP_LINEAR).outputs[0]
+    assert out.compute_dtype is DataType.DT_BF16
+    assert out.accum_dtype is DataType.DT_FLOAT
+
+
+# ----------------------------------------------------------------------
+# satellite: effective-dtype byte accounting
+# ----------------------------------------------------------------------
+def test_collective_bytes_use_effective_dtype():
+    from flexflow_tpu.analysis.collectives import estimate_collective_bytes
+
+    def reduction_graph(annotate):
+        g = Graph()
+        x = pt([8, 16], replicas=[4])
+        if annotate:
+            bf16(x)
+        y = pt([8, 16])
+        add_op(g, OperatorType.OP_REDUCTION,
+               ReductionParams(reduction_dim=0, reduction_degree=4),
+               [x], y)
+        return g
+
+    full = estimate_collective_bytes(reduction_graph(False))
+    half = estimate_collective_bytes(reduction_graph(True))
+    assert len(full) == 1 and len(half) == 1
+    # the bf16 wire moves exactly half the fp32 bytes: the historical
+    # 2x over-pricing of bf16 graphs is gone
+    assert half[0]["bytes"] * 2 == full[0]["bytes"]
+
+
+def test_cost_model_bytes_use_effective_dtype_weights_stay_wide():
+    from flexflow_tpu.search.cost_model import op_bytes, op_decode_bytes
+
+    def linear_op(annotate):
+        g = Graph()
+        x = pt([8, 16])
+        h = pt([8, 32])
+        op = add_op(g, OperatorType.OP_LINEAR, LinearParams(32), [x], h)
+        op.weights.append(pt([16, 32]))
+        if annotate:
+            annotate_graph_precision(g, compute_dtype=DataType.DT_BF16)
+        return op
+
+    wide, narrow = linear_op(False), linear_op(True)
+    w_bytes = 16 * 32 * 4  # fp32 master weights in BOTH accounts
+    # the graph-entry tensor keeps its storage dtype (only op outputs
+    # carry annotations); the bf16 output streams at half width
+    assert op_bytes(wide) == w_bytes + (8 * 16 + 8 * 32) * 4
+    assert op_bytes(narrow) == w_bytes + 8 * 16 * 4 + 8 * 32 * 2
+    assert op_decode_bytes(narrow) < op_decode_bytes(wide)
+
+
+def test_kv_page_bytes_explicit_dtype_and_session_capacity():
+    from flexflow_tpu.runtime.kvcache import (
+        KVCacheConfig,
+        KVCacheExhaustedError,
+        PagePool,
+    )
+
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    m = FFModel(cfg)
+    x = m.create_tensor((8, 16, 32), DataType.DT_FLOAT)
+    t = m.multihead_attention(x, x, x, 32, 4)
+    t = m.dense(t, 4)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.05),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    from flexflow_tpu.runtime.kvcache import kv_page_bytes
+
+    pb32 = kv_page_bytes(m, 16, kv_dtype="float32")
+    pb16 = kv_page_bytes(m, 16, kv_dtype="float16")
+    pb8 = kv_page_bytes(m, 16, kv_dtype="int8")
+    assert pb32 == 4 * pb8 and pb16 == 2 * pb8
+    # default keeps the executor-compute-dtype derivation
+    assert kv_page_bytes(m, 16) == pb32  # fp32 compile
+
+    # regression: in one fixed byte budget, a quantized int8 pool admits
+    # (at least) 2x the sessions an fp32 pool does
+    budget = 64 * pb32  # 64 fp32 pages' worth of HBM
+
+    def sessions(kv_dtype):
+        page_bytes = kv_page_bytes(m, 16, kv_dtype=kv_dtype)
+        pool = PagePool(KVCacheConfig(num_pages=budget // page_bytes,
+                                      page_size=16, kv_dtype=kv_dtype))
+        n = 0
+        while True:
+            try:
+                pool.reserve(f"s{n}", 64)  # 4 pages per session
+            except KVCacheExhaustedError:
+                return n
+            n += 1
+
+    assert sessions("int8") >= 2 * sessions("float32")
+    assert KVCacheConfig(num_pages=4, kv_dtype="int8").kv_dtype == "int8"
+    with pytest.raises(ValueError, match="kv_dtype"):
+        KVCacheConfig(num_pages=4, kv_dtype="not_a_dtype")
+
+
+# ----------------------------------------------------------------------
+# strategy_io / artifact-store round-trips preserve dtypes
+# ----------------------------------------------------------------------
+def _mixed_model(store=None, budget=4):
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    cfg.search_budget = budget
+    cfg.allow_mixed_precision = True
+    m = FFModel(cfg)
+    x = m.create_tensor((32, 4), DataType.DT_FLOAT)
+    t = m.dense(x, 32, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, 3)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.1),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.METRICS_ACCURACY], artifact_store=store)
+    return m
+
+
+def _dtype_map(graph):
+    return {
+        op.name: [(t.data_type.name,
+                   t.compute_dtype.name if t.compute_dtype else None,
+                   t.accum_dtype.name if t.accum_dtype else None)
+                  for t in op.outputs]
+        for op in graph.ops
+    }
+
+
+def test_strategy_io_round_trip_preserves_dtypes(tmp_path):
+    from flexflow_tpu.runtime.strategy_io import (
+        apply_imported_strategy,
+        export_strategy,
+        import_strategy,
+    )
+
+    m = _mixed_model()
+    before = _dtype_map(m.graph)
+    assert any(c == "DT_BF16" for recs in before.values()
+               for (_, c, _) in recs), "mixed compile must annotate bf16"
+    path = str(tmp_path / "strategy.json")
+    export_strategy(m.graph, None, path)
+    strategy = import_strategy(path)
+    # wipe the annotations, re-apply from the file: dim-for-dim identical
+    for op in m.graph.ops:
+        for t in op.outputs:
+            t.compute_dtype = None
+            t.accum_dtype = None
+    apply_imported_strategy(m.graph, strategy)
+    assert _dtype_map(m.graph) == before
+
+
+def test_strategy_io_rejects_prev3_with_precision_state(tmp_path):
+    from flexflow_tpu.runtime.strategy_io import (
+        StrategyImportError,
+        export_strategy,
+        import_strategy,
+    )
+
+    m = _mixed_model()
+    path = str(tmp_path / "strategy.json")
+    export_strategy(m.graph, None, path)
+    with open(path) as f:
+        blob = json.load(f)
+    blob["version"] = 2  # pre-precision reader's schema
+    with open(path, "w") as f:
+        json.dump(blob, f)
+    with pytest.raises(StrategyImportError, match="precision"):
+        import_strategy(path)
+
+
+def test_artifact_cache_hit_replays_with_precision_intact(tmp_path):
+    from flexflow_tpu.runtime.artifact_store import ArtifactStore
+
+    st = ArtifactStore(str(tmp_path))
+    m1 = _mixed_model(store=st)
+    assert m1.strategy_provenance["source"] == "search"
+    m2 = _mixed_model(store=st)
+    assert m2.strategy_provenance["source"] == "artifact_cache"
+    d1, d2 = _dtype_map(m1.graph), _dtype_map(m2.graph)
+    assert d1 == d2
+    assert any(c == "DT_BF16" for recs in d2.values()
+               for (_, c, _) in recs)
+    # and the stored payload itself carries the annotations (schema v4)
+    payload = st.get(m1._artifact_key)
+    assert payload["strategy_schema"] == 4
+    stored = [o.get("compute_dtype") for n in payload["nodes"]
+              for o in n["outputs"]]
+    assert "DT_BF16" in stored
+
+
+# ----------------------------------------------------------------------
+# verify tolerances derive from the drift budget
+# ----------------------------------------------------------------------
+def test_tolerance_from_budget_derivation():
+    from flexflow_tpu.runtime.verify import (
+        DRIFT_TO_TOLERANCE,
+        DTYPE_TOLERANCES,
+        tolerance_from_budget,
+    )
+
+    # at the default budget the cap lands exactly on the bf16 table row,
+    # so existing behavior is unchanged
+    assert DEFAULT_DRIFT_BUDGET * DRIFT_TO_TOLERANCE == \
+        DTYPE_TOLERANCES["bfloat16"][0]
+    assert tolerance_from_budget("bfloat16", None) == \
+        DTYPE_TOLERANCES["bfloat16"]
+    assert tolerance_from_budget("float32", None) == \
+        DTYPE_TOLERANCES["float32"]
+    # tightening the budget tightens the tolerance with it
+    rt, at = tolerance_from_budget("bfloat16", 0.01)
+    assert rt == at == 0.01 * DRIFT_TO_TOLERANCE
+    rt32, _ = tolerance_from_budget("float32", 1e-12)
+    assert rt32 == 1e-12 * DRIFT_TO_TOLERANCE
+
+
+def test_tight_budget_flips_borderline_strategy_to_typed_failure():
+    """Acceptance: a strategy whose drift passes at the default budget
+    becomes a typed StrategyDivergenceError when the budget tightens —
+    the runtime check and FFA705 share FFConfig.precision_drift_budget."""
+    from flexflow_tpu.runtime.verify import (
+        StrategyDivergenceError,
+        verify_strategy,
+    )
+
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    cfg.search_budget = 4
+    m = FFModel(cfg)
+    x = m.create_tensor((32, 4), DataType.DT_FLOAT)
+    t = m.dense(x, 32, ActiMode.AC_MODE_RELU)
+    t = m.scalar_multiply(t, 1.0)
+    t = m.dense(t, 3)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.1),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    # seed a BORDERLINE drift into the strategy side only: a 1e-6
+    # multiplicative nudge, far under the fp32 table tolerance (2e-4)
+    sm = next(op for op in m.graph.ops
+              if op.op_type == OperatorType.OP_SCALAR_MULTIPLY)
+    sm.params = dataclasses.replace(sm.params, scalar=1.0 + 1e-6)
+    m.executor.invalidate_step_cache()
+    rng = np.random.RandomState(0)
+    xd = rng.randn(64, 4).astype(np.float32)
+    yd = rng.randint(0, 3, (64, 1)).astype(np.int32)
+    v = verify_strategy(m, (xd, yd), steps=2, batch_size=32)
+    assert v.ok, v.summary()  # borderline PASS at the default budget
+    m.config.precision_drift_budget = 1e-10
+    with pytest.raises(StrategyDivergenceError):
+        verify_strategy(m, (xd, yd), steps=2, batch_size=32,
+                        raise_on_divergence=True)
+
+
+# ----------------------------------------------------------------------
+# clean zoo sweep: zero FFA7xx errors on searched mixed strategies
+# ----------------------------------------------------------------------
+def mixed_mlp():
+    return _mixed_model()
+
+
+def mixed_cnn():
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.search_budget = 3
+    cfg.allow_mixed_precision = True
+    m = FFModel(cfg)
+    x = m.create_tensor((8, 3, 16, 16), DataType.DT_FLOAT)
+    t = m.conv2d(x, 8, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = m.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = m.flat(t)
+    t = m.dense(t, 4)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.05),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    return m
+
+
+def mixed_attention():
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.search_budget = 3
+    cfg.allow_mixed_precision = True
+    m = FFModel(cfg)
+    x = m.create_tensor((8, 16, 32), DataType.DT_FLOAT)
+    t = m.multihead_attention(x, x, x, 32, 4)
+    t = m.dense(t, 32, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, 4)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.05),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    return m
+
+
+def mixed_moe():
+    from flexflow_tpu import models as zoo
+
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    cfg.search_budget = 2
+    cfg.allow_mixed_precision = True
+    m = FFModel(cfg)
+    zoo.build_moe(m, 16, input_dim=32, num_classes=4, num_exp=4,
+                  num_select=2, hidden=16)
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    return m
+
+
+def mixed_fsdp():
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.allow_mixed_precision = True
+    cfg.fsdp_degree = len(jax.devices())  # manual ZeRO lowering, no search
+    m = FFModel(cfg)
+    x = m.create_tensor((8, 16), DataType.DT_FLOAT)
+    t = m.dense(x, 32, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, 4)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.05),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    return m
+
+
+def mixed_longctx():
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    cfg.search_budget = 2
+    cfg.allow_mixed_precision = True
+    m = FFModel(cfg)
+    x = m.create_tensor((2, 128, 32), DataType.DT_FLOAT)
+    t = m.multihead_attention(x, x, x, 32, 4, causal=True)
+    t = m.dense(t, 32, ActiMode.AC_MODE_RELU)
+    t = m.dense(t, 4)
+    t = m.softmax(t)
+    m.compile(SGDOptimizer(lr=0.05),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    return m
+
+
+def mixed_decode():
+    from flexflow_tpu import AggrMode
+
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    cfg.search_budget = 1
+    cfg.allow_mixed_precision = True
+    m = FFModel(cfg)
+    ids = m.create_tensor((2, 16), DataType.DT_INT32)
+    t = m.embedding(ids, 32, 16, AggrMode.AGGR_MODE_NONE)
+    t = m.multihead_attention(t, t, t, 16, 2, causal=True)
+    t = m.softmax(m.dense(t, 32))
+    m.compile(SGDOptimizer(lr=0.01),
+              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    m.compile_decode()
+    return m
+
+
+@pytest.mark.parametrize("builder", [mixed_mlp, mixed_cnn,
+                                     mixed_attention, mixed_moe,
+                                     mixed_fsdp, mixed_longctx,
+                                     mixed_decode])
+def test_mixed_zoo_sweep_zero_ffa7xx_errors(builder):
+    """Searched mixed-precision zoo strategies must come back with ZERO
+    FFA7xx errors: the default inference (bf16 compute, fp32 accum) is
+    clean by construction."""
+    m = builder()
+    # compile annotated the graph; the full analyzer stack must be clean
+    rep = analyze_model(m)
+    assert not [d for d in rep.errors if d.code.startswith("FFA7")], \
+        rep.summary()
+    ndev = min(m.config.numWorkers, len(jax.devices()))
+    assert strategy_violations(
+        m.graph, getattr(m, "searched_views", None), ndev) == []
+    # the trajectory records the precision vetting
+    kinds = [e["kind"] for e in m.search_trajectory.events]
+    assert "precision_lint" in kinds
+
+
+# ----------------------------------------------------------------------
+# FFL301: float64 creep on the step path
+# ----------------------------------------------------------------------
+def _fflint(src, path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from fflint import lint_source
+    finally:
+        sys.path.pop(0)
+    return lint_source(src, path)
+
+
+def test_ffl301_flags_float64_creep_in_step_paths():
+    src = (
+        "import numpy as np\n"
+        "def step(state, batch):\n"
+        "    a = np.array(batch)\n"
+        "    b = np.float64(0.0)\n"
+        "    c = np.zeros((2,), dtype='float64')\n"
+        "    return a, b, c\n"
+    )
+    hits = [f for f in _fflint(
+        src, os.path.join(REPO, "flexflow_tpu", "parallel", "x.py"))
+        if f.code == "FFL301"]
+    assert len(hits) == 3
+    # outside step-path modules the rule is silent
+    assert not [f for f in _fflint(
+        src, os.path.join(REPO, "flexflow_tpu", "core", "x.py"))
+        if f.code == "FFL301"]
+    # explicit narrow dtype and pragma both satisfy it
+    clean = (
+        "import numpy as np\n"
+        "def step(state):\n"
+        "    a = np.zeros((2,), dtype=np.float32)\n"
+        "    b = np.float64(0.0)  # fflint: disable=FFL301\n"
+        "    return a, b\n"
+    )
+    assert not [f for f in _fflint(
+        clean, os.path.join(REPO, "flexflow_tpu", "parallel", "x.py"))
+        if f.code == "FFL301"]
+
+
+def test_fflint_tree_is_clean_including_ffl301():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from fflint import lint_path
+    finally:
+        sys.path.pop(0)
+    findings = []
+    for sub in ("flexflow_tpu", "tools", "tests"):
+        findings.extend(lint_path(os.path.join(REPO, sub)))
+    assert findings == [], [f.format() for f in findings]

@@ -430,7 +430,10 @@ def test_replica_set_death_failover_and_elastic_restart(tmp_path):
         outs = [r.result(timeout=180.0) for r in reqs]
         assert len(outs) == 6  # no admitted request was lost to the death
         t0 = time.monotonic()
-        while rs.replica_count() < 2 and time.monotonic() - t0 < 120:
+        # _restart_replica registers the replacement BEFORE bumping the
+        # restarts stat — wait on both, not just the count
+        while (rs.replica_count() < 2 or rs.stats["restarts"] < 1) \
+                and time.monotonic() - t0 < 120:
             time.sleep(0.05)
         assert rs.replica_count() == 2  # restored via the elastic path
         assert rs.stats["restarts"] == 1

@@ -397,7 +397,7 @@ def test_strategy_export_records_weight_shard(tmp_path):
     path = str(tmp_path / "strat.json")
     strategy_io.export_strategy(m.graph, None, path)
     blob = json.loads(open(path).read())
-    assert blob["version"] == strategy_io.SCHEMA_VERSION == 2
+    assert blob["version"] == strategy_io.SCHEMA_VERSION == 3
     ws = {r["name"]: r["weight_shard"] for r in blob["ops"]
           if r["weight_shard"]}
     assert ws and all(v == {"axis": "fsdp", "degree": deg}

@@ -36,6 +36,18 @@ FFL103  host-sync call inside a step-path function of parallel/ or
         raises under jit or, on concrete per-step values, forces a
         device->host round-trip per step. Hoist host reads out of the
         step path, or pragma genuinely host-side helpers.
+FFL301  float64 creep inside a step-path function of parallel/ or
+        kernels/ modules
+        An `np.float64`/`jnp.float64` reference, a `dtype="float64"`
+        keyword, or a dtype-less `np.array(...)` (which defaults to
+        float64 for Python floats) inside the traced per-step closures
+        silently widens the whole downstream flow to fp64 — the TPU
+        has no fp64 MXU path, so XLA either software-emulates it
+        (order-of-magnitude slowdown) or demotes it, and either way the
+        static precision story (analysis/precision.py FFA7xx) no longer
+        matches the executed math. Pin an explicit narrow dtype, or
+        pragma genuinely host-side float64 math (e.g. accumulating
+        telemetry counters).
 FFL201  bare `print()` inside flexflow_tpu/ library code
         Historical: fit/eval reported progress via bare print()s —
         invisible to telemetry, unredirectable, and uncapturable. Route
@@ -75,6 +87,9 @@ RULES = {
               "kernels/",
     "FFL201": "bare print() in flexflow_tpu/ library code (use "
               "flexflow_tpu.obs.progress; __main__ modules exempt)",
+    "FFL301": "float64 creep (np.float64 / dtype='float64' / dtype-less "
+              "np.array) inside a step-path function of parallel/ or "
+              "kernels/",
 }
 
 _PRAGMA = re.compile(r"#\s*fflint:\s*disable=([A-Z0-9,\s]+)")
@@ -314,6 +329,55 @@ def _check_step_path_sync(tree: ast.AST, path: str,
 
 
 # ----------------------------------------------------------------------
+# FFL301 — float64 creep on the step path
+# ----------------------------------------------------------------------
+_F64_NAMES = frozenset({
+    "np.float64", "numpy.float64", "jnp.float64", "jax.numpy.float64",
+})
+
+
+def _check_float64(tree: ast.AST, path: str,
+                   findings: List[Finding]) -> None:
+    if not _in_step_path_module(path):
+        return
+    for node, fn_name in _walk_innermost_fn(tree):
+        if not _is_step_path_fn(fn_name):
+            continue
+        if isinstance(node, ast.Attribute) and _dotted(node) in _F64_NAMES:
+            findings.append(Finding(
+                path, node.lineno, node.col_offset, "FFL301",
+                f"`{_dotted(node)}` inside step-path function "
+                f"`{fn_name}` widens the traced flow to fp64 (no TPU "
+                "fp64 MXU path, and the FFA7xx static precision story "
+                "no longer matches the executed math); pin bf16/f32",
+            ))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        fn = _dotted(node.func)
+        leaf = fn.split(".")[-1]
+        root = fn.split(".")[0]
+        for kw in node.keywords:
+            if kw.arg == "dtype" and \
+                    getattr(kw.value, "value", None) in ("float64",
+                                                         "double"):
+                findings.append(Finding(
+                    path, kw.value.lineno, kw.value.col_offset, "FFL301",
+                    f"dtype='float64' inside step-path function "
+                    f"`{fn_name}`: fp64 has no TPU MXU path; pin "
+                    "bf16/f32 or pragma host-side math",
+                ))
+        if leaf in ("array", "asarray") and root in ("np", "numpy") \
+                and not any(k.arg == "dtype" for k in node.keywords):
+            findings.append(Finding(
+                path, node.lineno, node.col_offset, "FFL301",
+                f"dtype-less {fn}() inside step-path function "
+                f"`{fn_name}` defaults Python floats to float64; pass "
+                "an explicit dtype",
+            ))
+
+
+# ----------------------------------------------------------------------
 # FFL201 — bare print() in library code
 # ----------------------------------------------------------------------
 def _in_flexflow_tpu(path: str) -> bool:
@@ -352,6 +416,7 @@ def lint_source(source: str, path: str) -> List[Finding]:
     _check_asarray(tree, path, findings)
     _check_donated_reuse(tree, path, findings)
     _check_step_path_sync(tree, path, findings)
+    _check_float64(tree, path, findings)
     _check_prints(tree, path, findings)
     pragmas = _pragmas(source)
     file_off: Set[str] = set()
