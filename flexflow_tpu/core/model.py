@@ -1857,6 +1857,12 @@ class FFModel:
                     obs.event("oom_forensics", cat="obs", path=path)
                 except Exception as dump_err:  # fflint: disable=FFL002 — forensics must not mask the OOM
                     warnings.warn(f"oom forensics dump failed: {dump_err}")
+            # flight recorder (obs/flight_recorder.py): typed failures
+            # (non-finite grads, strategy divergence, KV exhaustion,
+            # slice loss, ...) dump the recent event/metric tail plus
+            # live-state providers; no-op for untyped exceptions or
+            # without an armed recorder
+            obs.record_failure(e, where="fit")
             raise
         finally:
             if _own_session:
@@ -1974,6 +1980,12 @@ class FFModel:
                                 or failovers >= 3):
                             raise
                         failovers += 1
+                        # even a HANDLED slice loss leaves a forensics
+                        # bundle: the post-incident review wants the
+                        # pre-failover event tail, not just the recovery
+                        obs.record_failure(e, where="slice_failover",
+                                           surviving_devices=surv,
+                                           attempt=failovers)
                         obs.event(
                             "slice_failover", cat="runtime", step=e.step,
                             kind=type(e).__name__,

@@ -30,8 +30,15 @@ import contextlib
 import sys
 from typing import Optional
 
+from .anomaly import Anomaly, AnomalySentinel  # noqa: F401
 from .calibration import CalibrationStore, resolve_calibration  # noqa: F401
-from .metrics import MetricsRegistry, parse_prometheus  # noqa: F401
+from .fleet import FleetAggregator, MetricSpool  # noqa: F401
+from .metrics import (  # noqa: F401
+    MetricsRegistry,
+    merge_histogram_states,
+    parse_prometheus,
+    parse_prometheus_labeled,
+)
 from .request_trace import (  # noqa: F401
     NULL_REQUEST_TRACE,
     RequestTrace,
@@ -131,6 +138,23 @@ def observe(name: str, value: float, help: str = "", **labels) -> None:
     t = _ACTIVE
     if t is not None:
         t.metrics.histogram(name, help, **labels).observe(value)
+
+
+def forensics_dump(reason: str, error: Optional[BaseException] = None,
+                   **extra) -> Optional[str]:
+    """Dump a flight-recorder forensics bundle (obs/flight_recorder.py);
+    None when no recorder is installed."""
+    from . import flight_recorder as _fr
+
+    return _fr.dump(reason=reason, error=error, **extra)
+
+
+def record_failure(exc: BaseException, **extra) -> Optional[str]:
+    """Dump a forensics bundle iff `exc` is a typed runtime failure (at
+    most once per exception instance); None otherwise."""
+    from . import flight_recorder as _fr
+
+    return _fr.maybe_dump_failure(exc, **extra)
 
 
 # ----------------------------------------------------------------------

@@ -416,6 +416,109 @@ def _cmd_bench(args) -> int:
     return 1 if att["regressed"] and args.strict else 0
 
 
+def _fleet_domains(args):
+    if not getattr(args, "domains", None):
+        return None
+    import json as _json
+
+    from ..runtime.fault_domains import FaultDomainMap
+
+    with open(args.domains) as f:
+        return FaultDomainMap.from_json(_json.load(f))
+
+
+def _cmd_fleet(args) -> int:
+    import time as _time
+
+    from .fleet import FleetAggregator
+
+    agg = FleetAggregator(args.spool_dir, staleness_s=args.staleness,
+                          death_s=args.death,
+                          fault_domains=_fleet_domains(args))
+    while True:
+        view = agg.aggregate()
+        if args.prom:
+            with open(args.prom, "w") as f:
+                f.write(view.to_prometheus())
+        if args.watch:
+            print("\033[2J\033[H", end="")
+        print(view.table())
+        corrupt = [r for r in view.records if r.error]
+        for r in corrupt:
+            print(f"CORRUPT {r.process}: {r.error}")
+        if not args.watch:
+            return 1 if corrupt else 0
+        try:
+            _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+def _cmd_forensics(args) -> int:
+    import json as _json
+    import os as _os
+
+    from . import flight_recorder as fr
+
+    entries, problems = fr.read_index(args.dir)
+    if args.validate:
+        entries, problems = fr.validate_dir(args.dir)
+        for msg in problems:
+            print(f"PROBLEM: {msg}")
+        print(f"{len(entries)} bundle(s) indexed, "
+              f"{len(problems)} problem(s)")
+        return 1 if problems else 0
+    if args.show:
+        if not entries:
+            print("no forensics bundles indexed")
+            return 1
+        if args.show == "latest":
+            rec = entries[-1]
+        else:
+            hits = [e for e in entries if e.get("file") == args.show
+                    or args.show in (e.get("file") or "")]
+            if not hits:
+                print(f"no bundle matches {args.show!r}")
+                return 1
+            rec = hits[-1]
+        payload = fr.read_bundle(_os.path.join(rec["_dir"], rec["file"]))
+        if args.json:
+            print(_json.dumps(payload, indent=2, sort_keys=True))
+            return 0
+        err = payload.get("error") or {}
+        print(f"bundle:  {rec['file']}")
+        print(f"process: {payload.get('process')} "
+              f"(pid {payload.get('pid')})")
+        print(f"reason:  {payload.get('reason')}"
+              + (f" — {err.get('type')}: {err.get('message')}" if err
+                 else ""))
+        events = payload.get("events") or []
+        print(f"events:  {len(events)} in ring"
+              + (f"; tail: " + ", ".join(
+                  str(e.get("name")) for e in events[-8:]) if events
+                 else ""))
+        metrics = payload.get("metrics") or {}
+        for series in sorted(metrics):
+            pts = metrics[series]
+            vals = [v for _, v in pts[-5:]]
+            print(f"metric:  {series} ({len(pts)} samples; recent "
+                  + ", ".join(f"{v:.4g}" for v in vals) + ")")
+        for name in sorted(payload.get("state") or {}):
+            print(f"state:   {name}")
+        if payload.get("extra"):
+            blob = _json.dumps(payload["extra"], sort_keys=True)
+            print(f"extra:   {blob[:300]}")
+        return 0
+    for rec in entries:
+        print(f"{rec.get('unixtime', 0):.3f} {rec.get('process', '?'):<16} "
+              f"{rec.get('reason', '?'):<24} {rec.get('file')}")
+    for msg in problems:
+        print(f"PROBLEM: {msg}")
+    if not entries and not problems:
+        print("no forensics bundles indexed")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m flexflow_tpu.obs",
@@ -478,6 +581,40 @@ def main(argv=None) -> int:
                         "regression (default 0.05)")
     b.add_argument("--strict", action="store_true",
                    help="exit 1 when the newest round regressed")
+    fl = sub.add_parser(
+        "fleet",
+        help="aggregate a fleet spool directory (obs/fleet.py): live "
+             "table, merged ff_fleet_* Prometheus page, staleness "
+             "classification",
+    )
+    fl.add_argument("spool_dir")
+    fl.add_argument("--prom", help="write the merged Prometheus page here")
+    fl.add_argument("--watch", action="store_true",
+                    help="refresh the table until interrupted")
+    fl.add_argument("--interval", type=float, default=2.0)
+    fl.add_argument("--staleness", type=float, default=10.0,
+                    help="spool age (s) after which a process is stale")
+    fl.add_argument("--death", type=float, default=30.0,
+                    help="spool age (s) after which a process is dead")
+    fl.add_argument("--domains",
+                    help="FaultDomainMap JSON (to_json) mapping spool "
+                         "process names to slices")
+    fo = sub.add_parser(
+        "forensics",
+        help="inspect flight-recorder forensics bundles "
+             "(obs/flight_recorder.py): list the index, --show one "
+             "bundle, --validate everything",
+    )
+    fo.add_argument("dir",
+                    help="forensics dir (or the telemetry dir holding "
+                         "one)")
+    fo.add_argument("--show",
+                    help="bundle file name (or 'latest') to detail")
+    fo.add_argument("--json", action="store_true",
+                    help="with --show: dump the raw payload JSON")
+    fo.add_argument("--validate", action="store_true",
+                    help="integrity-check every indexed bundle; exit 1 "
+                         "on any problem")
     args = p.parse_args(argv)
     if args.cmd == "calibrate" and args.action == "diff" \
             and not args.other:
@@ -485,7 +622,8 @@ def main(argv=None) -> int:
     return {"trace": _cmd_trace, "summary": _cmd_summary,
             "prom": _cmd_prom, "requests": _cmd_requests,
             "calibrate": _cmd_calibrate, "explain": _cmd_explain,
-            "bench": _cmd_bench}[args.cmd](args)
+            "bench": _cmd_bench, "fleet": _cmd_fleet,
+            "forensics": _cmd_forensics}[args.cmd](args)
 
 
 if __name__ == "__main__":

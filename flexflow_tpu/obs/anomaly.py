@@ -1,0 +1,269 @@
+"""Streaming anomaly sentinel: EWMA + robust-z (MAD) detectors.
+
+Fixed SLO thresholds (PR 10) catch absolute violations but miss the
+regime changes that precede them: a step-time level shift after a
+strategy swap, a latency spike building under a traffic ramp, a replica
+whose heartbeat gap is quietly growing. The sentinel watches any named
+series with a per-series `SeriesDetector` that keeps a bounded window of
+recent values and judges each new sample against a *robust* baseline —
+median / MAD (scaled by 0.6745 so the score reads like a z-score on
+Gaussian data) — plus an EWMA mean for the reported baseline. MAD is
+robust to a minority of outliers, so a burst does not poison the
+baseline it is judged against; a *sustained* shift is absorbed after the
+window turns over, so a level change fires once and then becomes the new
+normal (which is the desired semantics for "alert on change").
+
+Guard rails against false positives:
+
+- **warmup**: no verdicts until the window has `warmup` samples;
+- **min_delta**: deviations smaller than an absolute floor are never
+  anomalous, regardless of z (a queue-depth of 1 against an all-zero
+  baseline has an astronomical z but is not an incident);
+- **hysteresis**: `hysteresis` *consecutive* breaches are required
+  before firing (one weird sample is noise);
+- **cooldown_s**: after firing, the detector stays silent for a spell so
+  one incident produces one anomaly, not one per sample.
+
+`GapDetector` is the degenerate absolute-threshold variant for
+heartbeat gaps, where "no data" *is* the signal and a statistical
+baseline of gaps would learn the outage.
+
+Anomalies are recorded on the sentinel (`recent()` / `blame()`) and,
+when a telemetry session is active, emitted as `anomaly` events plus
+`ff_anomalies_total{series,kind}` — consumers (the serving autoscaler,
+the strategy tuner) call `blame()` to tag the scale-up / re-search they
+trigger with the anomaly that caused it (docs/observability.md).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+# kinds emitted by the detectors
+KIND_SPIKE = "spike"
+KIND_DROP = "drop"
+KIND_GAP = "gap"
+
+_MAD_SCALE = 0.6745  # MAD -> sigma-equivalent for Gaussian data
+
+
+@dataclasses.dataclass
+class Anomaly:
+    """One detector verdict, with enough context to debug the call."""
+
+    series: str
+    kind: str  # spike | drop | gap
+    value: float
+    score: float  # robust z (spike/drop) or gap/limit ratio (gap)
+    baseline: float  # window median (spike/drop) or gap limit (gap)
+    at: float  # unix time
+
+    @property
+    def tag(self) -> str:
+        """Compact cause tag carried on downstream events
+        (`replica_scale_up`, `tuner_research_started`)."""
+        return f"{self.series}:{self.kind}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _median(sorted_vals: List[float]) -> float:
+    n = len(sorted_vals)
+    mid = n // 2
+    if n % 2:
+        return sorted_vals[mid]
+    return 0.5 * (sorted_vals[mid - 1] + sorted_vals[mid])
+
+
+class SeriesDetector:
+    """EWMA + MAD robust-z detector over one series (not thread-safe on
+    its own; the owning `AnomalySentinel` serializes access)."""
+
+    def __init__(self, series: str, *, alpha: float = 0.2,
+                 z_threshold: float = 4.0, warmup: int = 8,
+                 hysteresis: int = 2, cooldown_s: float = 5.0,
+                 window: int = 128, min_delta: float = 0.0,
+                 direction: str = "both"):
+        if direction not in ("both", "high", "low"):
+            raise ValueError(f"bad direction {direction!r}")
+        self.series = series
+        self.alpha = alpha
+        self.z_threshold = z_threshold
+        self.warmup = max(1, warmup)
+        self.hysteresis = max(1, hysteresis)
+        self.cooldown_s = cooldown_s
+        self.min_delta = min_delta
+        self.direction = direction
+        self.ewma: Optional[float] = None
+        self._window: Deque[float] = deque(maxlen=max(window, self.warmup))
+        self._breaches = 0  # consecutive breaches toward hysteresis
+        self._last_fire_t: Optional[float] = None
+
+    def observe(self, value: float, now: Optional[float] = None
+                ) -> Optional[Anomaly]:
+        now = time.time() if now is None else now
+        value = float(value)
+        anomaly = None
+        if len(self._window) >= self.warmup:
+            s = sorted(self._window)
+            med = _median(s)
+            mad = _median(sorted(abs(v - med) for v in s))
+            delta = value - med
+            # sigma-equivalent robust z; an exactly-constant baseline
+            # (mad == 0) defers entirely to the min_delta floor
+            z = (_MAD_SCALE * delta / mad) if mad > 0 else (
+                float("inf") if abs(delta) >= max(self.min_delta, 1e-12)
+                else 0.0
+            )
+            breach = (abs(z) >= self.z_threshold
+                      and abs(delta) >= self.min_delta)
+            if breach and self.direction == "high":
+                breach = delta > 0
+            elif breach and self.direction == "low":
+                breach = delta < 0
+            if breach:
+                self._breaches += 1
+                in_cooldown = (self._last_fire_t is not None
+                               and now - self._last_fire_t < self.cooldown_s)
+                if self._breaches >= self.hysteresis and not in_cooldown:
+                    anomaly = Anomaly(
+                        series=self.series,
+                        kind=KIND_SPIKE if delta > 0 else KIND_DROP,
+                        value=value,
+                        score=z if z != float("inf") else float("inf"),
+                        baseline=med,
+                        at=now,
+                    )
+                    self._last_fire_t = now
+                    self._breaches = 0
+            else:
+                self._breaches = 0
+        self._window.append(value)
+        self.ewma = (value if self.ewma is None
+                     else self.alpha * value + (1 - self.alpha) * self.ewma)
+        return anomaly
+
+
+class GapDetector:
+    """Absolute-threshold detector for heartbeat gaps: fires when the
+    observed gap exceeds `limit_s`, with the same hysteresis/cooldown
+    guard rails as `SeriesDetector` (a statistical baseline is wrong
+    here — it would learn the outage as the new normal)."""
+
+    def __init__(self, series: str, *, limit_s: float,
+                 hysteresis: int = 1, cooldown_s: float = 10.0):
+        self.series = series
+        self.limit_s = limit_s
+        self.hysteresis = max(1, hysteresis)
+        self.cooldown_s = cooldown_s
+        self._breaches = 0
+        self._last_fire_t: Optional[float] = None
+
+    def observe(self, gap_s: float, now: Optional[float] = None
+                ) -> Optional[Anomaly]:
+        now = time.time() if now is None else now
+        if gap_s < self.limit_s:
+            self._breaches = 0
+            return None
+        self._breaches += 1
+        if self._breaches < self.hysteresis:
+            return None
+        if (self._last_fire_t is not None
+                and now - self._last_fire_t < self.cooldown_s):
+            return None
+        self._last_fire_t = now
+        self._breaches = 0
+        return Anomaly(series=self.series, kind=KIND_GAP, value=gap_s,
+                       score=gap_s / self.limit_s, baseline=self.limit_s,
+                       at=now)
+
+
+class AnomalySentinel:
+    """A bag of per-series detectors plus a bounded log of verdicts.
+
+    `observe()` lazily creates the series' detector (keyword knobs apply
+    on first sight only) and, on a verdict, records it and emits the
+    `anomaly` event + `ff_anomalies_total{series,kind}` counter through
+    the active telemetry session (no-ops without one). Thread-safe: the
+    autoscaler loop, serve threads, and step boundaries all feed one
+    sentinel.
+    """
+
+    def __init__(self, *, emit: bool = True, history: int = 256,
+                 on_anomaly=None):
+        self.emit = emit
+        self.on_anomaly = on_anomaly  # callable(Anomaly) or None
+        self._detectors: Dict[str, object] = {}
+        self._anomalies: Deque[Anomaly] = deque(maxlen=history)
+        self._lock = threading.Lock()
+
+    # -- feeding ---------------------------------------------------------
+    def observe(self, series: str, value: float, *,
+                now: Optional[float] = None, **knobs) -> Optional[Anomaly]:
+        with self._lock:
+            det = self._detectors.get(series)
+            if det is None:
+                det = SeriesDetector(series, **knobs)
+                self._detectors[series] = det
+            anomaly = det.observe(value, now)
+            if anomaly is not None:
+                self._anomalies.append(anomaly)
+        if anomaly is not None:
+            self._publish(anomaly)
+        return anomaly
+
+    def observe_gap(self, series: str, gap_s: float, *,
+                    limit_s: float = 10.0, now: Optional[float] = None,
+                    **knobs) -> Optional[Anomaly]:
+        with self._lock:
+            det = self._detectors.get(series)
+            if det is None:
+                det = GapDetector(series, limit_s=limit_s, **knobs)
+                self._detectors[series] = det
+            anomaly = det.observe(gap_s, now)
+            if anomaly is not None:
+                self._anomalies.append(anomaly)
+        if anomaly is not None:
+            self._publish(anomaly)
+        return anomaly
+
+    def _publish(self, anomaly: Anomaly) -> None:
+        if self.emit:
+            # late import: obs/__init__ imports this module
+            from . import count, event
+            count("ff_anomalies_total",
+                  help="anomaly detector verdicts by series and kind",
+                  series=anomaly.series, kind=anomaly.kind)
+            event("anomaly", cat="anomaly", series=anomaly.series,
+                  kind=anomaly.kind, value=anomaly.value,
+                  score=anomaly.score, baseline=anomaly.baseline)
+        if self.on_anomaly is not None:
+            try:
+                self.on_anomaly(anomaly)
+            except Exception:  # fflint: disable=FFL002
+                pass
+
+    # -- consuming -------------------------------------------------------
+    def recent(self, *, max_age_s: Optional[float] = None,
+               series_prefix: Optional[str] = None,
+               now: Optional[float] = None) -> List[Anomaly]:
+        now = time.time() if now is None else now
+        with self._lock:
+            out = list(self._anomalies)
+        if max_age_s is not None:
+            out = [a for a in out if now - a.at <= max_age_s]
+        if series_prefix is not None:
+            out = [a for a in out if a.series.startswith(series_prefix)]
+        return out
+
+    def blame(self, *, max_age_s: float = 30.0,
+              now: Optional[float] = None) -> Optional[str]:
+        """Cause tag of the most recent anomaly inside the age window —
+        what a scale-up / re-search event should name as its trigger —
+        or None if the window is quiet."""
+        hits = self.recent(max_age_s=max_age_s, now=now)
+        return hits[-1].tag if hits else None

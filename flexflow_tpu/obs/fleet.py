@@ -1,0 +1,369 @@
+"""Fleet observatory: cross-process metric spools + aggregation.
+
+Every telemetry surface before this module is per-process; the fleet
+view is built from *spools* — each process (or logical process: a
+serving replica, a ReplicaSet controller) periodically snapshots its
+`MetricsRegistry.export_state()` plus health/provenance into one file in
+a shared spool directory. Writes are crash-atomic in the artifact-store
+idiom: serialize to `<path>.tmp.<pid>`, `os.replace` into place, with a
+crc32 over the canonical payload bytes in the envelope — a reader never
+sees a torn spool, only the previous complete one.
+
+`FleetAggregator` scans the directory and merges the live spools into
+one registry with the rollup semantics the fleet page needs:
+
+- **counters** are summed across processes into one unlabeled series —
+  by construction the rollup conserves counts (a killed replica's final
+  spool still contributes its tally; nothing is silently lost);
+- **gauges** keep per-process identity: each series gains
+  `{process,replica,slice}` labels (slice resolved through a
+  `FaultDomainMap`, treating spool process names as hosts);
+- **histograms** merge bucket counts and reservoirs via
+  `Histogram.merge_state`, so fleet percentiles are computed over the
+  union of every process's recent samples.
+
+Staleness is classified from spool heartbeat age (`live` under
+`staleness_s`, `stale` under `death_s`, `dead` beyond — or immediately
+when a final spool declares status `dead`/`exited`), and the stale set
+feeds `FaultDomainMap.classify_stale` so "both processes of slice 1 are
+stale" reads as a slice loss, not two unrelated hiccups. The merged
+page is exported with `ff_fleet_*` meta-series (process states,
+heartbeat ages, spool read errors) and served by the
+`python -m flexflow_tpu.obs fleet` CLI (table / `--prom` / `--watch`).
+Format details: docs/observability.md ("Fleet observatory").
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from .metrics import MetricsRegistry
+
+logger = logging.getLogger("flexflow_tpu.obs.fleet")
+
+SPOOL_SCHEMA = 1
+SPOOL_SUFFIX = ".spool.json"
+
+# process states, in increasing order of concern
+STATE_LIVE = "live"
+STATE_STALE = "stale"
+STATE_DEAD = "dead"
+STATE_EXITED = "exited"  # clean shutdown (final spool said so)
+
+
+class SpoolCorruptionError(RuntimeError):
+    """A spool file failed its integrity check (schema / crc / JSON)."""
+
+
+def _canonical_payload_bytes(payload: dict) -> bytes:
+    return json.dumps(payload, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+class MetricSpool:
+    """Per-process atomic spool writer.
+
+    `write()` snapshots either the attached registry
+    (`registry.export_state()`) or caller-supplied series records into
+    `<dir>/<process>.spool.json`. Call it from a periodic loop (the
+    serving autoscaler tick, the telemetry spool thread) and once more
+    at shutdown with a terminal status so the aggregator can tell a
+    clean exit from a death."""
+
+    def __init__(self, dir: str, process: str, *,
+                 registry: Optional[MetricsRegistry] = None,
+                 replica: Optional[str] = None,
+                 slice_id: Optional[int] = None):
+        self.dir = dir
+        self.process = process
+        self.registry = registry
+        self.replica = replica
+        self.slice_id = slice_id
+        os.makedirs(dir, exist_ok=True)
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.dir, self.process + SPOOL_SUFFIX)
+
+    def write(self, *, series: Optional[List[dict]] = None,
+              status: str = STATE_LIVE,
+              health: Optional[dict] = None,
+              provenance: Optional[dict] = None) -> str:
+        if series is None:
+            series = (self.registry.export_state()
+                      if self.registry is not None else [])
+        payload = {
+            "schema": SPOOL_SCHEMA,
+            "process": self.process,
+            "pid": os.getpid(),
+            "replica": self.replica,
+            "slice": self.slice_id,
+            "unixtime": time.time(),
+            "status": status,
+            "health": health or {},
+            "provenance": provenance or {},
+            "series": series,
+        }
+        payload = json.loads(json.dumps(payload, default=str))
+        envelope = {
+            "schema": SPOOL_SCHEMA,
+            "crc32": zlib.crc32(_canonical_payload_bytes(payload))
+            & 0xFFFFFFFF,
+            "payload": payload,
+        }
+        path = self.path
+        tmp = path + f".tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            json.dump(envelope, f)
+        os.replace(tmp, path)
+        return path
+
+
+def read_spool(path: str) -> dict:
+    """Load + integrity-check one spool; returns the payload or raises
+    SpoolCorruptionError. Thanks to the atomic replace, a concurrent
+    writer can never make this raise — only a genuinely damaged file."""
+    try:
+        with open(path) as f:
+            envelope = json.load(f)
+    except json.JSONDecodeError as e:
+        raise SpoolCorruptionError(f"{path}: not valid JSON ({e})") from e
+    if not isinstance(envelope, dict) or "payload" not in envelope:
+        raise SpoolCorruptionError(f"{path}: missing payload")
+    payload = envelope["payload"]
+    if envelope.get("schema") != SPOOL_SCHEMA:
+        raise SpoolCorruptionError(
+            f"{path}: schema {envelope.get('schema')!r} != {SPOOL_SCHEMA}")
+    crc = zlib.crc32(_canonical_payload_bytes(payload)) & 0xFFFFFFFF
+    if crc != envelope.get("crc32"):
+        raise SpoolCorruptionError(
+            f"{path}: crc32 mismatch ({envelope.get('crc32')!r} recorded, "
+            f"{crc} computed)")
+    return payload
+
+
+@dataclasses.dataclass
+class SpoolRecord:
+    """One scanned spool: its payload plus the aggregator's verdict."""
+
+    process: str
+    path: str
+    state: str  # live | stale | dead | exited
+    age_s: float
+    payload: Optional[dict] = None  # None when corrupt
+    error: Optional[str] = None
+
+    @property
+    def replica(self) -> Optional[str]:
+        return (self.payload or {}).get("replica")
+
+    @property
+    def slice_id(self) -> Optional[int]:
+        return (self.payload or {}).get("slice")
+
+
+@dataclasses.dataclass
+class FleetView:
+    """One aggregation pass: scanned records + the merged registry."""
+
+    records: List[SpoolRecord]
+    registry: MetricsRegistry
+    classification: Optional[object] = None  # FailureClassification
+    generated_at: float = 0.0
+
+    def to_prometheus(self) -> str:
+        return self.registry.to_prometheus()
+
+    def counter_total(self, name: str, **labels) -> float:
+        s = self.registry.find(name, **labels)
+        return 0.0 if s is None else s.value
+
+    def states(self) -> Dict[str, str]:
+        return {r.process: r.state for r in self.records}
+
+    def table(self) -> str:
+        """Human-readable fleet table (the CLI's live view)."""
+        cols = ("process", "state", "age", "replica", "slice", "requests")
+        rows: List[Tuple[str, ...]] = [cols]
+        for r in sorted(self.records, key=lambda r: r.process):
+            requests = ""
+            for rec in (r.payload or {}).get("series", []):
+                if (rec.get("name") == "ff_serving_requests_total"
+                        and rec.get("kind") == "counter"):
+                    requests = str(int(rec.get("value", 0)))
+                    break
+            rows.append((
+                r.process, r.state, f"{r.age_s:.1f}s",
+                str(r.replica or "-"), str(r.slice_id
+                                           if r.slice_id is not None
+                                           else "-"),
+                requests or "-",
+            ))
+        widths = [max(len(row[i]) for row in rows) for i in range(len(cols))]
+        lines = ["  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
+                 for row in rows]
+        if self.classification is not None:
+            lines.append("")
+            lines.append(f"classification: {self.classification.describe()}")
+        return "\n".join(lines)
+
+
+class FleetAggregator:
+    """Scan a spool directory and merge it into one fleet registry."""
+
+    def __init__(self, dir: str, *, staleness_s: float = 10.0,
+                 death_s: float = 30.0, fault_domains=None):
+        self.dir = dir
+        self.staleness_s = staleness_s
+        self.death_s = death_s
+        self.fault_domains = fault_domains
+
+    # -- scanning --------------------------------------------------------
+    def scan(self, now: Optional[float] = None) -> List[SpoolRecord]:
+        now = time.time() if now is None else now
+        records: List[SpoolRecord] = []
+        if not os.path.isdir(self.dir):
+            return records
+        for fname in sorted(os.listdir(self.dir)):
+            if not fname.endswith(SPOOL_SUFFIX):
+                continue
+            path = os.path.join(self.dir, fname)
+            process = fname[: -len(SPOOL_SUFFIX)]
+            try:
+                payload = read_spool(path)
+            except (SpoolCorruptionError, OSError) as e:
+                records.append(SpoolRecord(
+                    process=process, path=path, state=STATE_DEAD,
+                    age_s=float("inf"), payload=None, error=str(e)))
+                continue
+            age = max(0.0, now - float(payload.get("unixtime", 0.0)))
+            status = payload.get("status", STATE_LIVE)
+            if status in (STATE_DEAD, STATE_EXITED):
+                state = status  # the final spool already said so
+            elif age >= self.death_s:
+                state = STATE_DEAD
+            elif age >= self.staleness_s:
+                state = STATE_STALE
+            else:
+                state = STATE_LIVE
+            records.append(SpoolRecord(process=process, path=path,
+                                       state=state, age_s=age,
+                                       payload=payload))
+        return records
+
+    # -- merging ---------------------------------------------------------
+    def aggregate(self, records: Optional[List[SpoolRecord]] = None,
+                  now: Optional[float] = None) -> FleetView:
+        now = time.time() if now is None else now
+        if records is None:
+            records = self.scan(now)
+        reg = MetricsRegistry()
+        merge_conflicts = 0
+        for r in records:
+            if r.payload is None:
+                continue
+            ident = self._identity_labels(r)
+            for rec in r.payload.get("series", []):
+                name = rec.get("name")
+                kind = rec.get("kind")
+                labels = dict(rec.get("labels") or {})
+                try:
+                    if kind == "counter":
+                        reg.counter(name, **labels).inc(
+                            float(rec.get("value", 0.0)))
+                    elif kind == "gauge":
+                        reg.gauge(name, **labels, **ident).set(
+                            float(rec.get("value", 0.0)))
+                    elif kind == "histogram":
+                        reg.histogram(name, **labels).merge_state(
+                            rec["state"])
+                except (ValueError, KeyError, TypeError) as e:
+                    merge_conflicts += 1
+                    logger.warning("fleet merge: skipping %s from %s (%s)",
+                                   name, r.process, e)
+        self._meta_series(reg, records, merge_conflicts, now)
+        classification = self._classify(records)
+        if classification is not None and classification.kind != "ok":
+            reg.gauge("ff_fleet_lost_slices",
+                      help="slices with every process stale/dead").set(
+                          len(classification.lost_slices))
+        return FleetView(records=records, registry=reg,
+                         classification=classification, generated_at=now)
+
+    def _identity_labels(self, r: SpoolRecord) -> Dict[str, str]:
+        ident = {"process": r.process}
+        if r.replica:
+            ident["replica"] = str(r.replica)
+        slice_id = r.slice_id
+        if slice_id is None and self.fault_domains is not None:
+            labels = self.fault_domains.host_labels(r.process)
+            if labels:
+                ident.update(labels)
+        elif slice_id is not None:
+            ident["slice"] = str(slice_id)
+        return ident
+
+    def _meta_series(self, reg: MetricsRegistry,
+                     records: List[SpoolRecord],
+                     merge_conflicts: int, now: float) -> None:
+        by_state: Dict[str, int] = {}
+        corrupt = 0
+        for r in records:
+            by_state[r.state] = by_state.get(r.state, 0) + 1
+            if r.error is not None:
+                corrupt += 1
+            else:
+                reg.gauge("ff_fleet_heartbeat_age_seconds",
+                          help="seconds since each process's last spool",
+                          process=r.process).set(r.age_s)
+                reg.gauge("ff_fleet_process_up",
+                          help="1 when the process's spool is live",
+                          process=r.process).set(
+                              1.0 if r.state == STATE_LIVE else 0.0)
+        for state in (STATE_LIVE, STATE_STALE, STATE_DEAD, STATE_EXITED):
+            reg.gauge("ff_fleet_processes",
+                      help="spooled processes by health state",
+                      state=state).set(by_state.get(state, 0))
+        reg.gauge("ff_fleet_spools_corrupt",
+                  help="spool files that failed integrity checks").set(
+                      corrupt)
+        reg.gauge("ff_fleet_merge_conflicts",
+                  help="series skipped during merge (e.g. bucket "
+                       "mismatch)").set(merge_conflicts)
+        reg.gauge("ff_fleet_last_aggregate_unixtime",
+                  help="when this fleet page was generated").set(now)
+
+    def _classify(self, records: List[SpoolRecord]):
+        if self.fault_domains is None:
+            return None
+        stale = [r.process for r in records
+                 if r.state in (STATE_STALE, STATE_DEAD)]
+        known = getattr(self.fault_domains, "hosts", None) or {}
+        stale = [p for p in stale if p in known]
+        try:
+            return self.fault_domains.classify_stale(stale)
+        except Exception as e:
+            logger.warning("fleet classify_stale failed (%s)", e)
+            return None
+
+    # -- sentinel feed ---------------------------------------------------
+    def observe_into(self, sentinel, records: Optional[List[SpoolRecord]]
+                     = None, now: Optional[float] = None) -> None:
+        """Feed per-process heartbeat gaps into an `AnomalySentinel`
+        (`heartbeat_gap:<process>` gap detectors at the staleness
+        limit), so a quietly-degrading process fires before the death
+        window closes."""
+        now = time.time() if now is None else now
+        if records is None:
+            records = self.scan(now)
+        for r in records:
+            if r.error is not None or r.state == STATE_EXITED:
+                continue
+            sentinel.observe_gap(f"heartbeat_gap:{r.process}", r.age_s,
+                                 limit_s=self.staleness_s, now=now)

@@ -1,0 +1,386 @@
+"""Crash flight recorder: bounded in-memory ring + forensics bundles.
+
+When a process dies, its telemetry dies with it — the events.jsonl tail
+may be unflushed, the metrics page is whatever was last written, and the
+KV pool / strategy state that explains the failure is gone. The flight
+recorder keeps a bounded ring of the most recent trace events (fed as a
+`Tracer` sink, so it sees events even past the tracer's `max_events`
+cap) and of the health-relevant metric series (fed by `record_metric`
+from step boundaries and sentinel observations), plus a set of named
+*providers* — callables that snapshot live state (HBM watermarks,
+topology fingerprint, strategy/calibration provenance, KV pool audits)
+at dump time only.
+
+On any typed failure (`NonFiniteGradientsError`,
+`StrategyDivergenceError`, `KVCacheExhaustedError`, `SliceLossError`,
+replica death, tuner rollback) `dump()` writes a forensics bundle into
+`<dir>/forensics/` — tmp+`os.replace` with a crc32 over the canonical
+payload bytes, the same crash-atomic envelope the artifact store uses —
+and appends one line to an append-only `INDEX.jsonl` that survives
+elastic restarts (a restarted process keeps appending; the index is the
+recovery-time map of every incident the fleet has had in that
+directory). Bundles are inspected offline via
+`python -m flexflow_tpu.obs forensics` (`--show` / `--validate`);
+schema in docs/observability.md.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("flexflow_tpu.obs.flight_recorder")
+
+FORENSICS_DIRNAME = "forensics"
+INDEX_FILE = "INDEX.jsonl"
+BUNDLE_SCHEMA = 1
+
+# Typed failures worth a bundle, matched by class name anywhere in the
+# exception's MRO so this module never imports the runtime packages that
+# define them (they import obs).
+TYPED_FAILURES = frozenset({
+    "NonFiniteGradientsError",
+    "StrategyDivergenceError",
+    "KVCacheExhaustedError",
+    "SliceLossError",
+    "CheckpointCorruptionError",
+    "CanaryMismatchError",
+    "ArtifactCorruptionError",
+})
+
+# marker attribute set on an exception after its bundle is written, so
+# the same failure propagating through several hooks dumps exactly once
+_DUMPED_ATTR = "__ff_forensics_bundle__"
+
+
+def _canonical_payload_bytes(payload: dict) -> bytes:
+    return json.dumps(payload, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+class FlightRecorder:
+    """Bounded ring of recent events + metric samples, dumped on demand.
+
+    Thread-safe; all recording paths are cheap appends to bounded
+    deques. Providers run only at dump time and are individually
+    guarded — a provider that throws contributes an error string, never
+    kills the dump."""
+
+    def __init__(self, dir: str, *, process: Optional[str] = None,
+                 capacity: int = 2048, metric_window: int = 512):
+        self.dir = dir
+        self.process = process or f"pid{os.getpid()}"
+        self._events: Deque[dict] = deque(maxlen=max(1, capacity))
+        # series -> deque of (unixtime, value)
+        self._metrics: Dict[str, Deque[Tuple[float, float]]] = {}
+        self._metric_window = max(1, metric_window)
+        self._providers: Dict[str, Callable[[], object]] = {}
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    # -- recording -------------------------------------------------------
+    def record_event(self, event: dict) -> None:
+        """Tracer-sink entry point (`tracer.add_sink(rec.record_event)`)."""
+        with self._lock:
+            self._events.append(event)
+
+    def record_metric(self, series: str, value: float,
+                      t: Optional[float] = None) -> None:
+        with self._lock:
+            dq = self._metrics.get(series)
+            if dq is None:
+                dq = deque(maxlen=self._metric_window)
+                self._metrics[series] = dq
+            dq.append((time.time() if t is None else t, float(value)))
+
+    def register_provider(self, name: str,
+                          fn: Callable[[], object]) -> None:
+        """Register a dump-time state snapshotter (KV pool audit,
+        strategy provenance, ...). Last registration under a name wins."""
+        with self._lock:
+            self._providers[name] = fn
+
+    def unregister_provider(self, name: str) -> None:
+        with self._lock:
+            self._providers.pop(name, None)
+
+    # -- dumping ---------------------------------------------------------
+    def snapshot(self) -> dict:
+        """The dump payload body, minus envelope/reason: event tail,
+        metric time series, and every provider's (guarded) output."""
+        with self._lock:
+            events = list(self._events)
+            metrics = {k: list(v) for k, v in self._metrics.items()}
+            providers = dict(self._providers)
+        provided: Dict[str, object] = {}
+        for name, fn in providers.items():
+            try:
+                provided[name] = fn()
+            except Exception as e:
+                provided[name] = {"error": f"{type(e).__name__}: {e}"}
+        return {"events": events, "metrics": metrics, "state": provided}
+
+    @property
+    def forensics_dir(self) -> str:
+        return os.path.join(self.dir, FORENSICS_DIRNAME)
+
+    def dump(self, *, reason: str, error: Optional[BaseException] = None,
+             process: Optional[str] = None, extra: Optional[dict] = None,
+             ) -> str:
+        """Write one forensics bundle; returns its path. Crash-atomic
+        (tmp + os.replace, crc32 envelope) and indexed append-only."""
+        process = process or self.process
+        now = time.time()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        payload = dict(self.snapshot())
+        payload.update({
+            "schema": BUNDLE_SCHEMA,
+            "unixtime": now,
+            "process": process,
+            "pid": os.getpid(),
+            "reason": reason,
+        })
+        if error is not None:
+            payload["error"] = {"type": type(error).__name__,
+                                "message": str(error)}
+        if extra:
+            payload["extra"] = extra
+        # normalize to pure JSON (default=str for stray objects) so the
+        # crc computed here matches a recompute over the re-parsed file
+        payload = json.loads(json.dumps(payload, default=str))
+        fdir = self.forensics_dir
+        os.makedirs(fdir, exist_ok=True)
+        name = f"{process}-{int(now * 1000):013d}-{seq:03d}-{reason}.json"
+        path = os.path.join(fdir, name)
+        crc = zlib.crc32(_canonical_payload_bytes(payload)) & 0xFFFFFFFF
+        envelope = {"schema": BUNDLE_SCHEMA, "crc32": crc,
+                    "payload": payload}
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(envelope, f)
+        os.replace(tmp, path)
+        index_line = {"unixtime": now, "file": name, "process": process,
+                      "reason": reason, "crc32": crc,
+                      "error_type": (type(error).__name__
+                                     if error is not None else None)}
+        with open(os.path.join(fdir, INDEX_FILE), "a") as f:
+            f.write(json.dumps(index_line) + "\n")
+            f.flush()
+        try:
+            from . import count, event
+            event("forensics_dump", cat="obs", reason=reason,
+                  process=process, file=name)
+            count("ff_forensics_dumps_total",
+                  help="flight-recorder forensics bundles written",
+                  reason=reason)
+        except Exception:  # fflint: disable=FFL002 — best-effort signal
+            pass
+        logger.warning("flight recorder: wrote forensics bundle %s "
+                       "(reason=%s)", path, reason)
+        return path
+
+
+# ----------------------------------------------------------------------
+# module-level recorder (one per process, like the obs session)
+# ----------------------------------------------------------------------
+_RECORDER: Optional[FlightRecorder] = None
+_INSTALL_LOCK = threading.Lock()
+
+
+def install(dir: str, *, process: Optional[str] = None,
+            capacity: int = 2048, metric_window: int = 512,
+            ) -> FlightRecorder:
+    """Install the process-wide recorder (replacing any prior one) and
+    wire the default providers: topology fingerprint and HBM watermarks
+    (both guarded — absent backends degrade to an error string)."""
+    global _RECORDER
+    rec = FlightRecorder(dir, process=process, capacity=capacity,
+                         metric_window=metric_window)
+    rec.register_provider("topology", _topology_provider)
+    rec.register_provider("hbm_watermarks", _hbm_provider)
+    with _INSTALL_LOCK:
+        _RECORDER = rec
+    return rec
+
+
+def recorder() -> Optional[FlightRecorder]:
+    return _RECORDER
+
+
+def uninstall(rec: Optional[FlightRecorder] = None) -> None:
+    """Remove the process-wide recorder (or only `rec`, if it is still
+    the installed one — a session tearing down must not evict a newer
+    session's recorder)."""
+    global _RECORDER
+    with _INSTALL_LOCK:
+        if rec is None or _RECORDER is rec:
+            _RECORDER = None
+
+
+def _topology_provider() -> dict:
+    from ..runtime.elastic import topology_fingerprint
+
+    return topology_fingerprint()
+
+
+def _hbm_provider() -> dict:
+    from .step_profile import HbmSampler
+
+    s = HbmSampler()
+    return {"source": s.source,
+            "bytes_by_device": {str(k): int(v)
+                                for k, v in s.sample().items()}}
+
+
+def dump(*, reason: str, error: Optional[BaseException] = None,
+         **extra) -> Optional[str]:
+    """Dump a bundle through the installed recorder; None when no
+    recorder is installed (the disabled path stays silent and cheap)."""
+    rec = recorder()
+    if rec is None:
+        return None
+    try:
+        return rec.dump(reason=reason, error=error, extra=extra or None)
+    except Exception as e:
+        logger.error("flight recorder: dump failed (%s)", e)
+        return None
+
+
+def maybe_dump_failure(exc: BaseException, *, reason: Optional[str] = None,
+                       **extra) -> Optional[str]:
+    """Dump iff `exc` is a typed failure (by class name, anywhere in the
+    MRO) that has not already produced a bundle. Returns the bundle path
+    or None. Safe to call from multiple hooks on the same exception —
+    the first dump marks it."""
+    rec = recorder()
+    if rec is None:
+        return None
+    names = {c.__name__ for c in type(exc).__mro__}
+    if not (names & TYPED_FAILURES):
+        return None
+    if getattr(exc, _DUMPED_ATTR, None) is not None:
+        return getattr(exc, _DUMPED_ATTR)
+    path = dump(reason=reason or type(exc).__name__, error=exc, **extra)
+    if path is not None:
+        try:
+            setattr(exc, _DUMPED_ATTR, path)
+        except Exception:  # fflint: disable=FFL002 — slotted exceptions
+            pass
+    return path
+
+
+# ----------------------------------------------------------------------
+# offline: validation + index reading (the `obs forensics` CLI)
+# ----------------------------------------------------------------------
+def read_bundle(path: str) -> dict:
+    """Load + integrity-check one bundle; returns the payload. Raises
+    ValueError on any corruption (bad JSON, schema, crc)."""
+    with open(path) as f:
+        try:
+            envelope = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path}: not valid JSON ({e})") from e
+    problems = validate_envelope(envelope, path=path)
+    if problems:
+        raise ValueError("; ".join(problems))
+    return envelope["payload"]
+
+
+def validate_envelope(envelope: object, *, path: str = "<bundle>"
+                      ) -> List[str]:
+    problems: List[str] = []
+    if not isinstance(envelope, dict):
+        return [f"{path}: envelope is not an object"]
+    payload = envelope.get("payload")
+    if not isinstance(payload, dict):
+        return [f"{path}: missing payload object"]
+    if envelope.get("schema") != BUNDLE_SCHEMA:
+        problems.append(f"{path}: schema {envelope.get('schema')!r} "
+                        f"!= {BUNDLE_SCHEMA}")
+    crc = zlib.crc32(_canonical_payload_bytes(payload)) & 0xFFFFFFFF
+    if crc != envelope.get("crc32"):
+        problems.append(f"{path}: crc32 mismatch "
+                        f"({envelope.get('crc32')!r} recorded, "
+                        f"{crc} computed)")
+    for key in ("unixtime", "process", "reason", "events", "metrics",
+                "state"):
+        if key not in payload:
+            problems.append(f"{path}: payload missing {key!r}")
+    if not isinstance(payload.get("events"), list):
+        problems.append(f"{path}: events is not a list")
+    return problems
+
+
+def validate_bundle(path: str) -> List[str]:
+    """Problems list for one bundle file (empty = valid)."""
+    try:
+        with open(path) as f:
+            envelope = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable ({e})"]
+    return validate_envelope(envelope, path=path)
+
+
+def read_index(dir: str) -> Tuple[List[dict], List[str]]:
+    """Parse `<dir>/INDEX.jsonl` (where `dir` is the forensics dir OR a
+    telemetry dir containing one). Returns (entries, problems); a
+    truncated final line (crash mid-append) is reported, earlier entries
+    still parse — append-only means history is never rewritten."""
+    fdir = dir
+    if not os.path.exists(os.path.join(fdir, INDEX_FILE)):
+        sub = os.path.join(dir, FORENSICS_DIRNAME)
+        if os.path.exists(os.path.join(sub, INDEX_FILE)):
+            fdir = sub
+    index_path = os.path.join(fdir, INDEX_FILE)
+    entries: List[dict] = []
+    problems: List[str] = []
+    if not os.path.exists(index_path):
+        return entries, [f"{index_path}: no index"]
+    with open(index_path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                problems.append(f"{index_path}:{i}: unparseable entry "
+                                "(truncated append?)")
+                continue
+            rec["_dir"] = fdir
+            entries.append(rec)
+    return entries, problems
+
+
+def validate_dir(dir: str) -> Tuple[List[dict], List[str]]:
+    """Validate every indexed bundle under `dir`. Returns (entries,
+    problems): index parse problems, missing bundle files, and per-bundle
+    envelope/crc failures; also flags bundles on disk that the index
+    does not know about."""
+    entries, problems = read_index(dir)
+    seen = set()
+    for rec in entries:
+        fname = rec.get("file")
+        if not fname:
+            problems.append(f"index entry missing file: {rec!r}")
+            continue
+        seen.add(fname)
+        path = os.path.join(rec["_dir"], fname)
+        if not os.path.exists(path):
+            problems.append(f"{fname}: indexed but missing on disk")
+            continue
+        problems.extend(validate_bundle(path))
+    if entries:
+        fdir = entries[0]["_dir"]
+        for fname in sorted(os.listdir(fdir)):
+            if (fname.endswith(".json") and fname not in seen
+                    and not fname.startswith(".")):
+                problems.append(f"{fname}: on disk but not indexed")
+    return entries, problems

@@ -103,6 +103,47 @@ class Histogram:
         i = min(len(s) - 1, max(0, int(math.ceil(q * len(s))) - 1))
         return s[i]
 
+    # -- mergeable state (fleet aggregation) ----------------------------
+    def state(self, max_samples: int = _RESERVOIR) -> dict:
+        """JSON-serializable mergeable state: bucket edges/counts, sum,
+        count, and (a bounded stride-subsample of) the reservoir, so a
+        fleet aggregator can reconstruct cross-process percentiles."""
+        with self._lock:
+            samples = list(self._samples)
+            counts = list(self.counts)
+            total, n = self.sum, self.count
+        if len(samples) > max_samples:
+            stride = len(samples) / max_samples
+            samples = [samples[int(i * stride)] for i in range(max_samples)]
+        return {"buckets": list(self.buckets), "counts": counts,
+                "sum": total, "count": n, "samples": samples}
+
+    def merge_state(self, state: dict) -> None:
+        """Fold another histogram's `state()` into this one. Bucket edges
+        must match (or this histogram must still be empty, in which case
+        it adopts the incoming edges); the reservoirs are concatenated
+        and stride-subsampled back under the cap so merged quantiles
+        reflect both populations."""
+        edges = tuple(float(b) for b in state["buckets"])
+        with self._lock:
+            if self.count == 0 and not self._samples:
+                self.buckets = edges
+                self.counts = [0] * len(edges)
+            elif edges != self.buckets:
+                raise ValueError(
+                    f"histogram bucket edges differ: {edges!r} vs "
+                    f"{self.buckets!r}"
+                )
+            for i, c in enumerate(state["counts"]):
+                self.counts[i] += int(c)
+            self.sum += float(state["sum"])
+            self.count += int(state["count"])
+            self._samples.extend(float(v) for v in state["samples"])
+            if len(self._samples) > _RESERVOIR:
+                stride = len(self._samples) / _RESERVOIR
+                self._samples = [self._samples[int(i * stride)]
+                                 for i in range(_RESERVOIR)]
+
 
 def _fmt_labels(labels: Optional[Tuple[Tuple[str, str], ...]],
                 extra: Optional[Dict[str, str]] = None) -> str:
@@ -231,6 +272,27 @@ class MetricsRegistry:
     def to_jsonl(self) -> str:
         return "".join(json.dumps(r) + "\n" for r in self.snapshot())
 
+    def export_state(self) -> List[dict]:
+        """One mergeable record per series — unlike `snapshot()` (which
+        reduces histograms to fixed percentiles), histogram records carry
+        the full `Histogram.state()` so a `FleetAggregator` can merge
+        reservoirs across processes without precision loss."""
+        out: List[dict] = []
+        with self._lock:
+            fams = {
+                name: (kind, dict(series))
+                for name, (kind, _h, series) in sorted(self._families.items())
+            }
+        for name, (kind, series) in fams.items():
+            for key, s in series.items():
+                rec = {"name": name, "kind": kind, "labels": dict(key)}
+                if kind == "histogram":
+                    rec["state"] = s.state()
+                else:
+                    rec["value"] = s.value
+                out.append(rec)
+        return out
+
 
 def parse_prometheus(text: str) -> Dict[str, float]:
     """Minimal parser for the text exposition format (tests + the CLI's
@@ -247,4 +309,48 @@ def parse_prometheus(text: str) -> Dict[str, float]:
                            else float(value))
         except ValueError as e:
             raise ValueError(f"line {i}: bad sample {line!r} ({e})") from e
+    return out
+
+
+def merge_histogram_states(states) -> dict:
+    """Merge an iterable of `Histogram.state()` dicts into one. Raises
+    ValueError on mismatched bucket edges (series exported with custom
+    buckets cannot be silently blended into default-bucket series)."""
+    acc = Histogram(threading.Lock())
+    for st in states:
+        acc.merge_state(st)
+    return acc.state()
+
+
+def parse_series_key(series: str) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
+    """Split a `name{k="v",...}` series key into (name, sorted label
+    tuple) — the inverse of `_fmt_labels`, so `parse_prometheus` output
+    round-trips into the structured form the aggregator merges on."""
+    if "{" not in series:
+        return series, ()
+    name, _, rest = series.partition("{")
+    body = rest.rstrip()
+    if not body.endswith("}"):
+        raise ValueError(f"bad series key {series!r}: unterminated labels")
+    body = body[:-1]
+    labels: List[Tuple[str, str]] = []
+    # values are always double-quoted by _fmt_labels and never contain
+    # quotes themselves in this codebase's label vocabulary
+    for part in filter(None, body.split(",")):
+        k, _, v = part.partition("=")
+        if not _ or not v.startswith('"') or not v.endswith('"'):
+            raise ValueError(f"bad label {part!r} in series {series!r}")
+        labels.append((k.strip(), v[1:-1]))
+    return name, tuple(sorted(labels))
+
+
+def parse_prometheus_labeled(
+    text: str,
+) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]:
+    """Structured variant of `parse_prometheus`: keys are (name, sorted
+    label tuple) so callers can filter/merge by label without re-parsing
+    the flat series strings."""
+    out: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    for series, value in parse_prometheus(text).items():
+        out[parse_series_key(series)] = value
     return out

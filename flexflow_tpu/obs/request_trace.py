@@ -184,11 +184,17 @@ def mint_request_trace(request_id: str):
 # stage decomposition + SLO monitoring (all requests, sampled or not)
 # ----------------------------------------------------------------------
 def record_request_stages(req, *, generated: Optional[int] = None,
-                          slo: Optional["SLOMonitor"] = None) -> dict:
+                          slo: Optional["SLOMonitor"] = None,
+                          replica: Optional[str] = None) -> dict:
     """Decompose a finished request's latency from its lifecycle
     timestamps into ff_request_stage_seconds{stage} observations and
     feed the SLO monitor. Returns the stage dict (also attached to the
     sampled trace's `complete` event by the caller).
+
+    With `replica` (the serving batcher passes its name) observations
+    and SLO violation counts carry a `replica` label, so the fleet page
+    pins p99 violations to a replica instead of a blended histogram;
+    without it the series stay unlabeled (back-compatible keys).
 
     queue   = submit -> last admission
     prefill = admission -> first token
@@ -218,12 +224,13 @@ def record_request_stages(req, *, generated: Optional[int] = None,
                      else req.max_new_tokens) - 1
             if extra > 0:
                 stages["tpot"] = stages["decode"] / extra
+    labels = {"replica": replica} if replica is not None else {}
     for stage, v in stages.items():
         observe("ff_request_stage_seconds", v, help=STAGE_HELP,
-                stage=stage)
+                stage=stage, **labels)
     if slo is not None:
         ttft = (first - req.submitted_t) if first is not None else None
-        slo.observe(ttft_s=ttft, latency_s=total)
+        slo.observe(ttft_s=ttft, latency_s=total, replica=replica)
     return stages
 
 
@@ -250,6 +257,9 @@ class SLOMonitor:
         from .metrics import Histogram
 
         self.latency = Histogram(threading.Lock())
+        # ttft reservoir: the anomaly sentinel reads its p95 (a target-
+        # relative verdict window can't see a spike still under target)
+        self.ttft = Histogram(threading.Lock())
         self.violations = {"ttft": 0, "p99_latency": 0}
 
     @property
@@ -257,31 +267,35 @@ class SLOMonitor:
         return (self.ttft_target_s is not None
                 or self.latency_p99_target_s is not None)
 
-    def _count(self, slo: str) -> None:
+    def _count(self, slo: str, replica: Optional[str] = None) -> None:
         from . import count
 
+        labels = {"replica": replica} if replica is not None else {}
         count("ff_slo_violations_total", 1.0,
               help="completed requests that violated a serving SLO "
-                   "target", slo=slo)
+                   "target", slo=slo, **labels)
 
     def observe(self, *, ttft_s: Optional[float] = None,
-                latency_s: Optional[float] = None) -> None:
+                latency_s: Optional[float] = None,
+                replica: Optional[str] = None) -> None:
         if latency_s is not None:
             self.latency.observe(latency_s)
+        if ttft_s is not None:
+            self.ttft.observe(ttft_s)
         with self._lock:
             if self.ttft_target_s is not None and ttft_s is not None:
                 bad = ttft_s > self.ttft_target_s
                 self._verdicts["ttft"].append(bad)
                 if bad:
                     self.violations["ttft"] += 1
-                    self._count("ttft")
+                    self._count("ttft", replica)
             if (self.latency_p99_target_s is not None
                     and latency_s is not None):
                 bad = latency_s > self.latency_p99_target_s
                 self._verdicts["p99_latency"].append(bad)
                 if bad:
                     self.violations["p99_latency"] += 1
-                    self._count("p99_latency")
+                    self._count("p99_latency", replica)
 
     def latency_quantile(self, q: float) -> float:
         return self.latency.quantile(q)

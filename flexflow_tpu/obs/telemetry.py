@@ -20,6 +20,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 import time
 from typing import Optional
 
@@ -59,6 +60,18 @@ class TelemetryConfig:
         when calibration_path is set — the measured overlap efficiency
         and collective bandwidths written through to the store.
     step_profile_repeats: timed repeats per measurement in that capture.
+    flight_recorder: keep the crash flight recorder armed (a bounded
+        ring of recent events + metric samples; typed failures dump
+        forensics bundles into ``<dir>/forensics/`` —
+        obs/flight_recorder.py).
+    flight_recorder_events: ring capacity.
+    anomaly_detection: arm the session's AnomalySentinel (step-time
+        regressions fire `anomaly` events — obs/anomaly.py).
+    fleet_spool_dir: when set, a background thread snapshots this
+        session's registry into ``<fleet_spool_dir>/<process>.spool.json``
+        every fleet_spool_interval_s for cross-process aggregation
+        (obs/fleet.py); a final spool with status "exited" is written at
+        finish(). fleet_process defaults to ``proc-<pid>``.
     """
 
     dir: str
@@ -72,6 +85,12 @@ class TelemetryConfig:
     calibration_path: Optional[str] = None
     step_profile: bool = False
     step_profile_repeats: int = 2
+    flight_recorder: bool = True
+    flight_recorder_events: int = 2048
+    anomaly_detection: bool = True
+    fleet_spool_dir: Optional[str] = None
+    fleet_spool_interval_s: float = 2.0
+    fleet_process: Optional[str] = None
     events_file: str = "events.jsonl"
     prom_file: str = "metrics.prom"
     metrics_jsonl_file: str = "metrics.jsonl"
@@ -110,15 +129,62 @@ class Telemetry:
         self.tracer = Tracer(events_path, flush_every=config.flush_every,
                              max_events=config.max_events)
         self.metrics = MetricsRegistry()
+        # satellite of the fleet observatory: overflow past max_events
+        # is visible LIVE on the metrics page, not only at close()
+        dropped = self.metrics.counter(
+            "ff_trace_events_dropped_total",
+            "trace events dropped past the tracer's max_events cap")
+        self.tracer.on_drop = dropped.inc
         self.calibration = None
         if config.calibration_path:
             from .calibration import CalibrationStore
 
             self.calibration = CalibrationStore(config.calibration_path)
+        self.sentinel = None
+        if config.anomaly_detection:
+            from .anomaly import AnomalySentinel
+
+            self.sentinel = AnomalySentinel()
+        self.recorder = None
+        if config.flight_recorder:
+            from . import flight_recorder as _fr
+
+            self.recorder = _fr.install(
+                config.dir,
+                process=config.fleet_process,
+                capacity=config.flight_recorder_events)
+            self.recorder.register_provider("metrics_snapshot",
+                                            self.metrics.snapshot)
+            self.tracer.add_sink(self.recorder.record_event)
+        self.spool = None
+        self._spool_stop = None
+        if config.fleet_spool_dir:
+            from .fleet import MetricSpool
+
+            self.spool = MetricSpool(
+                config.fleet_spool_dir,
+                config.fleet_process or f"proc-{os.getpid()}",
+                registry=self.metrics)
+            self.spool.write()
+            self._spool_stop = threading.Event()
+            t = threading.Thread(target=self._spool_loop,
+                                 name="ff-fleet-spool", daemon=True)
+            t.start()
+            self._spool_thread = t
         self._finished = False
         self._attached_models: list = []
         self.tracer.instant("session_start", cat="obs",
                             unixtime=time.time())
+
+    def _spool_loop(self) -> None:
+        while not self._spool_stop.wait(self.config.fleet_spool_interval_s):
+            try:
+                self.spool.write()
+            except OSError as e:
+                import logging
+
+                logging.getLogger("flexflow_tpu.obs").warning(
+                    "fleet spool write failed (%s)", e)
 
     # -- model wiring ----------------------------------------------------
     def attach_model(self, model) -> None:
@@ -128,6 +194,18 @@ class Telemetry:
         if model in self._attached_models:
             return
         self._attached_models.append(model)
+        if self.recorder is not None:
+            # forensics bundles carry the strategy + calibration
+            # provenance of whatever the model is running at dump time
+            self.recorder.register_provider(
+                "strategy_provenance",
+                lambda m=model: dict(
+                    getattr(m, "strategy_provenance", None) or {}))
+            if self.calibration is not None:
+                self.recorder.register_provider(
+                    "calibration_provenance",
+                    lambda: {"path": self.config.calibration_path,
+                             "dirty": self.calibration.dirty})
         traj = getattr(model, "search_trajectory", None)
         if traj is not None:
             self._replay_trajectory(traj)
@@ -238,6 +316,12 @@ class Telemetry:
             ).set(batch_size / dur_s / max(1, n_chips))
         if loss is not None:
             self.metrics.gauge("ff_loss", "last observed loss").set(loss)
+        if self.recorder is not None:
+            self.recorder.record_metric("step_time_s", dur_s)
+        if self.sentinel is not None:
+            # min_delta keeps dispatch-time jitter (sub-ms on the async
+            # path) from ever reading as a regression
+            self.sentinel.observe("step_time_s", dur_s, min_delta=0.005)
 
     def record_chunk(self, *, first_step: int, steps: int, dur_s: float,
                      batch_size: int, n_chips: int,
@@ -304,6 +388,18 @@ class Telemetry:
         self.tracer.instant("session_end", cat="obs", unixtime=time.time())
         self.tracer.close()
         self.write_metrics()
+        if self.spool is not None:
+            self._spool_stop.set()
+            self._spool_thread.join(timeout=5.0)
+            try:
+                self.spool.write(status="exited")
+            except OSError:  # fflint: disable=FFL002 — best-effort final
+                pass
+        if self.recorder is not None:
+            from . import flight_recorder as _fr
+
+            self.tracer.remove_sink(self.recorder.record_event)
+            _fr.uninstall(self.recorder)
         if self.calibration is not None and self.calibration.dirty:
             self.calibration.save()
         with open(os.path.join(self.config.dir,

@@ -116,6 +116,12 @@ class NullTracer:
     def lane(self, cat, name):
         return 0
 
+    def add_sink(self, fn):
+        return None
+
+    def remove_sink(self, fn):
+        return None
+
 
 NULL_TRACER = NullTracer()
 
@@ -166,21 +172,29 @@ class Tracer:
 
     Events accumulate in memory and flush to `path` (append) every
     `flush_every` events and on `close()`. A `max_events` cap bounds both
-    memory and disk; overflow is counted in `dropped` and reported as one
-    final instant event at close."""
+    memory and disk; overflow is counted in `dropped`, reported live
+    through the `on_drop` callback (telemetry wires it to the
+    `ff_trace_events_dropped_total` counter so the fleet page sees trace
+    loss before process exit) and summarized as one final instant event
+    at close. Sinks added via `add_sink` see EVERY emitted event —
+    including ones past the cap — so a flight recorder's bounded ring
+    keeps the freshest tail even after the trace file stops growing."""
 
     enabled = True
 
     def __init__(self, path: Optional[str] = None, *, t0: Optional[float] = None,
-                 flush_every: int = 256, max_events: int = 200_000):
+                 flush_every: int = 256, max_events: int = 200_000,
+                 on_drop=None):
         self.path = path
         self.t0 = time.perf_counter() if t0 is None else t0
         self.flush_every = max(1, flush_every)
         self.max_events = max_events
+        self.on_drop = on_drop  # callable(n_dropped) or None
         self.events: List[dict] = []
         self.dropped = 0
         self._written = 0  # events already flushed to disk
         self._emitted = 0
+        self._sinks: List = []
         self._lock = threading.Lock()
         # named lanes: (cat, lane-name) -> stable tid within the category.
         # tid 0 is the anonymous default lane, so named lanes start at 1;
@@ -238,15 +252,46 @@ class Tracer:
             "args": series,
         })
 
-    def emit(self, event: dict) -> None:
+    def add_sink(self, fn) -> None:
+        """Register `fn(event)` to observe every emitted event (even past
+        `max_events`). Sinks must be fast and non-throwing; exceptions
+        are swallowed so a broken observer cannot take down the traced
+        workload."""
         with self._lock:
+            if fn not in self._sinks:
+                self._sinks.append(fn)
+
+    def remove_sink(self, fn) -> None:
+        with self._lock:
+            if fn in self._sinks:
+                self._sinks.remove(fn)
+
+    def emit(self, event: dict) -> None:
+        on_drop = None
+        with self._lock:
+            sinks = list(self._sinks)
             if self._emitted >= self.max_events:
                 self.dropped += 1
-                return
-            self._emitted += 1
-            self.events.append(event)
-            if self.path and len(self.events) - self._written >= self.flush_every:
-                self._flush_locked()
+                on_drop = self.on_drop
+            else:
+                self._emitted += 1
+                self.events.append(event)
+                if (self.path
+                        and len(self.events) - self._written
+                        >= self.flush_every):
+                    self._flush_locked()
+        # callbacks run outside the lock: on_drop typically bumps a
+        # metric counter (own lock) and sinks may be arbitrary observers
+        if on_drop is not None:
+            try:
+                on_drop(1)
+            except Exception:  # fflint: disable=FFL002
+                pass
+        for fn in sinks:
+            try:
+                fn(event)
+            except Exception:  # fflint: disable=FFL002
+                pass
 
     # -- output ----------------------------------------------------------
     def _flush_locked(self) -> None:

@@ -142,6 +142,14 @@ class FaultDomainMap:
             return None
         return self.hosts.get(host_id)
 
+    def host_labels(self, host_id: str) -> Dict[str, str]:
+        """Metric labels pinning ``host_id`` to its fault domain — what
+        the fleet aggregator (obs/fleet.py) stamps on per-process gauges
+        so a fleet page groups by slice. Empty for unknown hosts (no
+        misleading label beats a wrong one)."""
+        s = self.slice_of_host(host_id)
+        return {} if s is None else {"slice": str(s)}
+
     def surviving_devices(self, lost_slices: Iterable[int]) -> Tuple[int, ...]:
         lost = set(lost_slices)
         out: List[int] = []

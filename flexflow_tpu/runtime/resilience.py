@@ -188,6 +188,10 @@ def retry(
             return fn()
         except policy.retry_on as e:
             if attempt == attempts - 1:
+                # exhausted retries are a typed-failure-grade incident:
+                # keep the tail that shows every attempt + backoff
+                obs.forensics_dump("retries_exhausted", error=e,
+                                   attempts=attempts)
                 raise
             d = policy.delay(attempt)
             obs.count("ff_retries_total",
@@ -447,6 +451,19 @@ class FaultInjector:
                 continue
             plan["remaining"] -= 1
             self.fired[site] = self.fired.get(site, 0) + 1
+            # chaos provenance in the flight recorder ring: a forensics
+            # bundle written moments later says whether the "failure"
+            # was injected, and by which plan
+            from ..obs import flight_recorder as _fr
+
+            rec = _fr.recorder()
+            if rec is not None:
+                rec.record_event({
+                    "ts": time.monotonic(), "ph": "i",
+                    "name": "fault_injected", "cat": "chaos", "tid": 0,
+                    "args": {"site": site, "step": step,
+                             "raises": plan["exc"] is not None},
+                })
             if plan["exc"] is not None:
                 raise plan["exc"]
             return plan

@@ -52,7 +52,7 @@ import threading
 import time
 import uuid
 from collections import OrderedDict, deque
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -1159,6 +1159,12 @@ class ContinuousBatcher:
                 req.trace.shed("kv_exhausted", stage="admit",
                                replica=self.name,
                                pages_needed=e.pages_needed)
+                # a can-NEVER-fit request is a sizing bug, not
+                # backpressure — worth a forensics bundle (deduped per
+                # exception; backpressure requeues below stay silent)
+                obs.record_failure(e, replica=self.name,
+                                   request=req.id,
+                                   kv_snapshot=self.pool.snapshot())
                 req._finish(error=RequestShedError(
                     f"request {req.id} can never fit the KV page pool: "
                     f"{e}", reason="kv_exhausted",
@@ -1317,7 +1323,7 @@ class ContinuousBatcher:
                       help="tokens generated by the serving runtime")
             self.stats["finished"] += 1
             stages = record_request_stages(slot.req, generated=generated,
-                                           slo=self.slo)
+                                           slo=self.slo, replica=self.name)
             slot.req.trace.completed(
                 self.name, generation=slot.generation, tokens=generated,
                 **{f"{k}_s": round(v, 6) for k, v in stages.items()},
@@ -1677,7 +1683,8 @@ class ReplicaSet:
                  scale_up_queue_depth: Optional[int] = None,
                  scale_down_idle_s: float = 10.0,
                  autoscale_interval_s: float = 0.25,
-                 artifact_store=None):
+                 artifact_store=None,
+                 fleet_spool_dir: Optional[str] = None):
         self.model_fn = model_fn
         self.config = config
         # strategy/artifact store (runtime/artifact_store.py): every
@@ -1731,6 +1738,140 @@ class ReplicaSet:
         self.stats = {"submitted": 0, "requeued": 0, "restarts": 0,
                       "spares_used": 0, "scale_ups": 0, "scale_downs": 0,
                       "cold_start_s": []}
+        # fleet observatory (obs/fleet.py, obs/anomaly.py): the sentinel
+        # watches latency/ttft p95, queue depth, shed rate, KV occupancy
+        # and per-replica heartbeat gaps each autoscale tick; scale-ups
+        # name the anomaly that preceded them. With fleet_spool_dir set,
+        # every replica's counters are spooled per tick — and once more
+        # with a terminal status at death/drain — so the cross-process
+        # rollup conserves request counts through kills and scale-downs.
+        from ..obs.anomaly import AnomalySentinel
+
+        self.sentinel = AnomalySentinel()
+        self.fleet_spool_dir = fleet_spool_dir
+        self._spools: Dict[str, object] = {}
+        # replica name -> (iterations seen, monotonic time it changed)
+        self._progress: Dict[str, Tuple[int, float]] = {}
+        self._shed_seen = 0.0
+
+    # -- fleet observatory ----------------------------------------------
+    @staticmethod
+    def _series_rec(name: str, kind: str, value) -> dict:
+        if kind == "histogram":
+            return {"name": name, "kind": kind, "labels": {},
+                    "state": value}
+        return {"name": name, "kind": kind, "labels": {},
+                "value": float(value)}
+
+    def _replica_series(self, batcher: ContinuousBatcher) -> List[dict]:
+        st = batcher.stats
+        snap = batcher.pool.snapshot()
+        c, g = self._series_rec, self._series_rec
+        return [
+            c("ff_serving_requests_total", "counter", st["finished"]),
+            c("ff_serving_admitted_total", "counter", st["admitted"]),
+            c("ff_serving_prefills_total", "counter", st["prefills"]),
+            c("ff_serving_shed_decode_total", "counter",
+              st["shed_decode"]),
+            c("ff_serving_stranded_requeued_total", "counter",
+              st["stranded_requeued"]),
+            g("ff_serving_active_slots", "gauge", batcher.active_slots),
+            g("ff_kv_pages_in_use", "gauge", snap["pages_in_use"]),
+            g("ff_kv_pages_shared", "gauge", snap["pages_shared"]),
+        ]
+
+    def _write_replica_spool(self, batcher: ContinuousBatcher,
+                             status: str = "live") -> None:
+        if self.fleet_spool_dir is None:
+            return
+        from ..obs.fleet import MetricSpool
+
+        sp = self._spools.get(batcher.name)
+        if sp is None:
+            sp = MetricSpool(self.fleet_spool_dir, batcher.name,
+                             replica=batcher.name)
+            self._spools[batcher.name] = sp
+        try:
+            sp.write(series=self._replica_series(batcher), status=status)
+        except OSError as e:
+            logger.warning("fleet spool write for %s failed (%s)",
+                           batcher.name, e)
+
+    def _write_set_spool(self, status: str = "live") -> None:
+        if self.fleet_spool_dir is None:
+            return
+        from ..obs.fleet import MetricSpool
+
+        sp = self._spools.get("replicaset")
+        if sp is None:
+            sp = MetricSpool(self.fleet_spool_dir, "replicaset")
+            self._spools["replicaset"] = sp
+        st = self.stats
+        rec = self._series_rec
+        series = [
+            rec("ff_serving_submitted_total", "counter", st["submitted"]),
+            rec("ff_serving_requeued_total", "counter", st["requeued"]),
+            rec("ff_replica_restarts_total", "counter", st["restarts"]),
+            rec("ff_replica_scale_ups_total", "counter", st["scale_ups"]),
+            rec("ff_serving_queue_depth", "gauge", len(self.queue)),
+            rec("ff_serving_replicas", "gauge", self.replica_count()),
+            rec("ff_serving_latency_seconds", "histogram",
+                self.latency.state()),
+        ]
+        try:
+            sp.write(series=series, status=status)
+        except OSError as e:
+            logger.warning("fleet replicaset spool write failed (%s)", e)
+
+    def _observe_fleet(self, depth: int) -> None:
+        """One autoscale tick of sentinel feeding + spool refresh. Knob
+        choices: hysteresis 1 (the tick itself already integrates over
+        the interval, and the scale-up decision wants the anomaly tag
+        available the same tick the pressure appears); min_delta floors
+        absolute — a queue depth of 1 against an all-zero warm baseline
+        is not an incident, a slots-sized jump is; direction "high"
+        because a draining queue or falling latency is recovery, and a
+        recovery-tagged detector in cooldown would mask the NEXT real
+        spike from the scale-up blame window."""
+        now = time.monotonic()
+        s = self.sentinel
+        s.observe("queue_depth", float(depth),
+                  min_delta=float(self.config.slots), hysteresis=1,
+                  direction="high")
+        if self.latency.count >= 8:
+            s.observe("serving_latency_p95", self.latency.quantile(0.95),
+                      min_delta=0.1, hysteresis=1, direction="high")
+        if self.slo.ttft.count >= 8:
+            s.observe("ttft_p95", self.slo.ttft.quantile(0.95),
+                      min_delta=0.05, hysteresis=1, direction="high")
+        with self._lock:
+            reps = list(self._replicas.values())
+        shed = 0.0
+        occupancy = 0.0
+        for r in reps:
+            b = r.batcher
+            shed += b.stats["shed_decode"]
+            snap = b.pool.snapshot()
+            occupancy = max(occupancy, snap["pages_in_use"]
+                            / max(1, b.pool.config.num_pages))
+            it = b.stats["iterations"]
+            last = self._progress.get(b.name)
+            if last is None or last[0] != it:
+                self._progress[b.name] = (it, now)
+            elif b.thread_alive():
+                s.observe_gap(f"replica_heartbeat:{b.name}",
+                              now - last[1],
+                              limit_s=self.health_timeout_s)
+            self._write_replica_spool(b)
+        if reps:
+            s.observe("kv_occupancy", occupancy, min_delta=0.2,
+                      hysteresis=1, direction="high")
+        delta = max(0.0, shed - self._shed_seen)
+        self._shed_seen = shed
+        s.observe("shed_rate",
+                  delta / max(self.autoscale_interval_s, 1e-6),
+                  min_delta=1.0, hysteresis=1, direction="high")
+        self._write_set_spool()
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ReplicaSet":
@@ -1792,6 +1933,10 @@ class ReplicaSet:
                         _shed("aborted")
                     rep.batcher._release(slot_idx)
             rep.monitor.stop()
+            # final spool AFTER the serve thread stopped: the tallies
+            # are final, so the fleet rollup conserves counters exactly
+            self._write_replica_spool(rep.batcher, status="exited")
+        self._write_set_spool(status="exited")
 
     # -- replica management ---------------------------------------------
     def _store_scope(self):
@@ -1980,6 +2125,21 @@ class ReplicaSet:
                   detail=str(exc)[:300])
         obs.gauge_set("ff_serving_replicas", self.replica_count(),
                       help="live serving replicas")
+        # forensics: the dying replica's KV pool audit + final counters,
+        # while its state still exists (obs/flight_recorder.py)
+        try:
+            kv_pool: dict = {"snapshot": batcher.pool.snapshot()}
+            kv_pool["audit"] = batcher.pool.audit().to_dict()
+        except Exception as e:  # fflint: disable=FFL002 — forensics only
+            kv_pool = {"error": f"{type(e).__name__}: {e}"}
+        obs.forensics_dump("replica_death", error=exc,
+                           replica=batcher.name, requeued=requeued,
+                           stats=dict(batcher.stats), kv_pool=kv_pool)
+        # terminal spool: the fleet rollup keeps this replica's final
+        # tallies (counter conservation through the kill) and reads the
+        # explicit "dead" status without waiting out the age window
+        self._write_replica_spool(batcher, status="dead")
+        self._spools.pop(batcher.name, None)
         if self._closed:
             return
         with self._lock:
@@ -2035,6 +2195,7 @@ class ReplicaSet:
 
         while not self._scaler_stop.wait(self.autoscale_interval_s):
             depth = len(self.queue)
+            self._observe_fleet(depth)
             with self._lock:
                 pending = self._pending_restarts
             # replicas mid-restart count toward capacity: scaling up to
@@ -2052,11 +2213,17 @@ class ReplicaSet:
                               error=type(e).__name__, detail=str(e)[:300])
                     continue
                 self.stats["scale_ups"] += 1
+                # the sentinel saw this tick's observations already
+                # (_observe_fleet runs first), so the pressure that
+                # motivated this scale-up is in its blame window
+                blame = self.sentinel.blame(
+                    max_age_s=max(5.0, 20 * self.autoscale_interval_s))
                 obs.event("replica_scale_up", cat="serving",
                           replica=rep.name, queue_depth=depth,
                           cause=("slo" if slo_pressure
                                  and depth < self.scale_up_queue_depth
                                  else "queue_depth"),
+                          anomaly=blame or "",
                           slo_violation_rate=round(
                               self.slo.violation_rate(), 4))
                 self._idle_since = None
@@ -2114,6 +2281,8 @@ class ReplicaSet:
         rep.batcher.stop(timeout=5.0)
         rep.monitor.stop()
         self.stats["scale_downs"] += 1
+        self._write_replica_spool(rep.batcher, status="exited")
+        self._spools.pop(rep.name, None)
         obs.event("replica_scale_down", cat="serving", replica=rep.name)
         obs.gauge_set("ff_serving_replicas", self.replica_count(),
                       help="live serving replicas")

@@ -233,6 +233,12 @@ class StrategyTuner:
         # winners are written through for fleet-wide reuse
         self._artifact_store = None
         self._quarantine_scope: Optional[str] = None
+        # anomaly sentinel over the drift score (obs/anomaly.py): a
+        # drift spike that later trips the re-search trigger becomes the
+        # tagged cause on tuner_research_started
+        from ..obs.anomaly import AnomalySentinel
+
+        self.sentinel = AnomalySentinel()
 
     # ------------------------------------------------------------------
     # artifact store: persisted quarantines + winner write-through
@@ -374,6 +380,7 @@ class StrategyTuner:
         score = self.drift_score()
         obs.gauge_set(DRIFT_GAUGE, score, help=DRIFT_GAUGE_HELP,
                       leg=self.leg)
+        self.sentinel.observe("tuner_drift_score", score, min_delta=0.05)
         if self.state == self.IDLE:
             self._evaluate_trigger(step, score)
             return False
@@ -432,8 +439,9 @@ class StrategyTuner:
         self._search_result = None
         self._search_cm = cost_model
         self._search_step = step
+        blame = self.sentinel.blame()
         obs.event("tuner_research_started", cat="tuner", step=step,
-                  drift_score=round(score, 4))
+                  drift_score=round(score, 4), anomaly=blame or "")
         model.search_trajectory.event(
             "tuner_research_started", step=step,
             drift_score=round(score, 4),
@@ -893,6 +901,15 @@ class StrategyTuner:
         obs.event("tuner_cycle_finished", cat="tuner", step=step,
                   outcome=outcome,
                   **{k: v for k, v in detail.items() if v is not None})
+        if outcome in ("rolled_back", "quarantined"):
+            # rollbacks are the tuner's crash-equivalent: keep the event
+            # tail + strategy provenance around the failed swap
+            obs.forensics_dump(
+                f"tuner_{outcome}", step=step, leg=self.leg,
+                outcomes=dict(self.outcomes),
+                swap_history=self.swap_history[-5:],
+                detail={k: v for k, v in detail.items()
+                        if isinstance(v, (str, int, float, bool))})
 
 
 def _corrupt_one_param(state, plan):

@@ -1,0 +1,85 @@
+#!/usr/bin/env bash
+# Fleet observatory end-to-end check (docs/observability.md "Fleet
+# observatory"): drive a 3-replica ReplicaSet through the overload ramp
+# with a mid-ramp replica kill, every replica spooling its counters into
+# a shared fleet directory, and assert the cross-process contract:
+#
+#   1. the fleet rollup CONSERVES request counts through the kill — the
+#      victim's final tally survives in its terminal spool, so summed
+#      ff_serving_requests_total equals the client's completed count;
+#   2. the killed replica's spool classifies stale/dead, never live;
+#   3. the scale-up the ramp provokes names the anomaly the sentinel
+#      blamed it on (replica_scale_up event carries a non-empty
+#      `anomaly` tag);
+#   4. the replica death dumped a forensics bundle naming the victim,
+#      and `obs forensics --validate` accepts the whole bundle dir;
+#   5. the `obs fleet` CLI renders the same spools as a table and a
+#      parseable Prometheus page with the ff_fleet_* meta-series.
+#
+# Runs on the virtual CPU mesh; CI wires it into the lint workflow.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export JAX_NUM_CPU_DEVICES="${JAX_NUM_CPU_DEVICES:-4}"
+# jax<0.5 ignores JAX_NUM_CPU_DEVICES; the XLA flag is what actually
+# multiplies the host platform (same fallback as tests/conftest.py)
+case "${XLA_FLAGS:-}" in *xla_force_host_platform_device_count*) ;; *)
+    export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=$JAX_NUM_CPU_DEVICES"
+;; esac
+WORKDIR="$(mktemp -d)"
+trap 'rm -rf "$WORKDIR"' EXIT
+SPOOL="$WORKDIR/spool"
+TEL="$WORKDIR/tel"
+
+# the load harness judges criteria 1-4 itself (verify_fleet); headroom
+# of one replica above the floor lets the ramp trigger exactly the
+# scale-up criterion 3 needs. --p99-factor is opened wide on purpose:
+# the latency bound is serving_check.sh's gate — this leg gates the
+# fleet accounting, and a tight bound here would just double-fail CPU
+# runner noise.
+python scripts/load_check.py \
+    --replicas 3 --max-replicas 4 \
+    --warm-s 3 --ramp-s 6 --post-s 2 \
+    --search-budget 1 --p99-factor 40 \
+    --fleet-spool "$SPOOL" --expect-scale-up \
+    --telemetry-dir "$TEL" --request-sample-rate 1.0 \
+    --json "$WORKDIR/load.json" >/dev/null
+echo "fleet_check: load leg OK (criteria judged in-harness)"
+
+# the fleet CLI must render the SAME spools: a table naming every
+# process, and a Prometheus page whose rollup + meta-series parse
+python -m flexflow_tpu.obs fleet "$SPOOL" --prom "$WORKDIR/fleet.prom" \
+    > "$WORKDIR/fleet.table"
+grep -q "replicaset" "$WORKDIR/fleet.table" \
+    || { echo "fleet_check: controller spool missing from table"; exit 1; }
+python - "$WORKDIR/fleet.prom" "$WORKDIR/load.json" <<'EOF'
+import json
+import sys
+
+from flexflow_tpu.obs.metrics import parse_prometheus_labeled
+
+page = open(sys.argv[1]).read()
+series = parse_prometheus_labeled(page)
+names = {name for name, _ in series}
+for want in ("ff_fleet_heartbeat_age_seconds", "ff_fleet_processes",
+             "ff_fleet_spools_corrupt", "ff_serving_requests_total"):
+    assert want in names, f"fleet page missing {want}: {sorted(names)}"
+assert series[("ff_fleet_spools_corrupt", ())] == 0.0
+summary = json.load(open(sys.argv[2]))
+expected = summary["fleet"]["expected_requests"]
+total = series[("ff_serving_requests_total", ())]
+assert total == expected, (
+    f"CLI rollup {total} != in-harness expectation {expected}")
+by_state = {lab: v for (name, lab), v in series.items()
+            if name == "ff_fleet_processes"}
+assert sum(by_state.values()) == summary["fleet"]["spooled_processes"]
+print(f"fleet_check: CLI page OK ({len(series)} series, "
+      f"{total:.0f} requests conserved)")
+EOF
+
+# the forensics CLI must accept every bundle the run dumped
+python -m flexflow_tpu.obs forensics "$TEL" --validate >/dev/null
+python -m flexflow_tpu.obs forensics "$TEL" --show latest >/dev/null
+echo "fleet_check: forensics CLI OK"
+echo "fleet_check: OK"

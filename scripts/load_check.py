@@ -105,7 +105,7 @@ class Record:
         self.submit_error = submit_error
 
 
-def offered_load(rs, args, records, stop_evt, killed_evt, fi):
+def offered_load(rs, args, records, stop_evt, killed_evt, fi, kill_info):
     """Open-loop generator: warm at the base rate, ramp to ramp x base,
     cool back down. The replica kill fires mid-ramp."""
     from flexflow_tpu.runtime.serving import RequestShedError
@@ -120,11 +120,24 @@ def offered_load(rs, args, records, stop_evt, killed_evt, fi):
         while time.monotonic() < t_end and not stop_evt.is_set():
             if (phase == "ramp" and not killed_evt.is_set()
                     and time.monotonic() > t_end - dur * (1 - args.kill_at)):
-                victim = sorted(rs.replica_names())[0]
-                fi.inject("replica_death", replica=victim)
-                killed_evt.set()
-                print(f"[load_check] injected replica_death on {victim}",
-                      file=sys.stderr)
+                # kill the BUSIEST replica, and only once it provably has
+                # in-flight work: criterion 4's "requeued request finishes
+                # under its original trace id" needs the victim to strand
+                # something, and an idle victim mid-tick would make the
+                # whole check flaky. If every replica is momentarily idle
+                # this retries next loop iteration.
+                with rs._lock:
+                    busy = sorted(
+                        ((r.batcher.active_slots, name)
+                         for name, r in rs._replicas.items()
+                         if r.batcher.thread_alive()), reverse=True)
+                if busy and busy[0][0] > 0:
+                    victim = busy[0][1]
+                    fi.inject("replica_death", replica=victim)
+                    kill_info["victim"] = victim
+                    killed_evt.set()
+                    print(f"[load_check] injected replica_death on "
+                          f"{victim}", file=sys.stderr)
             plen = int(rng.randint(2, args.max_prompt + 1))
             prompt = rng.randint(0, args.vocab, plen).astype(np.int32)
             new = int(rng.randint(2, args.max_new + 1))
@@ -199,6 +212,110 @@ def verify_request_trace(tel_dir, *, expect_requeue):
             tr = json.load(f)
         if "traceEvents" not in tr:
             failures.append("trace.json is not Chrome-trace shaped")
+    return verdict, failures
+
+
+def verify_fleet(args, *, expected_requests, victim, killed):
+    """The --fleet-spool criteria (obs/fleet.py, docs/observability.md
+    "Fleet observatory"): judged from the spool directory and the
+    finished telemetry session AFTER the ReplicaSet has stopped.
+
+      a. the cross-process rollup **conserves request counts** — the
+         fleet-summed ``ff_serving_requests_total`` equals the client's
+         completed count (warmup + offered load), i.e. the killed
+         replica's final tally survived in its terminal spool;
+      b. the killed replica's spool reads as **stale or dead**, never
+         live (its death spool declares the terminal status);
+      c. when the autoscaler added capacity, the ``replica_scale_up``
+         event names the **anomaly** the sentinel blamed it on;
+      d. a ``replica_death`` **forensics bundle** names the victim and
+         passes ``validate_bundle``.
+    Returns (verdict-dict-for-summary, failure-strings)."""
+    from flexflow_tpu.obs import flight_recorder as fr
+    from flexflow_tpu.obs.fleet import FleetAggregator
+
+    failures = []
+    agg = FleetAggregator(args.fleet_spool, staleness_s=5.0, death_s=15.0)
+    view = agg.aggregate()
+    states = view.states()
+    total = view.counter_total("ff_serving_requests_total")
+    corrupt = [r.process for r in view.records if r.error is not None]
+    verdict = {
+        "spooled_processes": len(view.records),
+        "states": states,
+        "requests_total": total,
+        "expected_requests": expected_requests,
+        "corrupt_spools": corrupt,
+    }
+    if corrupt:
+        failures.append(f"corrupt spool file(s): {corrupt}")
+    if not view.records:
+        failures.append("fleet spool dir has no spools at all")
+    # (a) counter conservation across the kill
+    if total != expected_requests:
+        failures.append(
+            f"fleet rollup lost requests: ff_serving_requests_total sums "
+            f"to {total:.0f} across spools but the client saw "
+            f"{expected_requests} completions"
+        )
+    # (b) the victim's terminal spool classifies stale/dead, not live
+    if killed and victim is not None:
+        vstate = states.get(victim)
+        if vstate is None:
+            failures.append(
+                f"killed replica {victim} left no spool behind")
+        elif vstate not in ("stale", "dead"):
+            failures.append(
+                f"killed replica {victim} classified {vstate!r}, "
+                "expected stale/dead")
+        verdict["victim"] = victim
+        verdict["victim_state"] = vstate
+    # (c) anomaly-attributed scale-up, from the finished events.jsonl
+    if args.telemetry_dir:
+        from flexflow_tpu.obs.tracer import read_events_jsonl
+
+        events, _ = read_events_jsonl(
+            os.path.join(args.telemetry_dir, "events.jsonl"))
+        ups = [e for e in events if e.get("name") == "replica_scale_up"]
+        tagged = [e for e in ups if e.get("args", {}).get("anomaly")]
+        verdict["scale_ups"] = len(ups)
+        verdict["scale_up_anomalies"] = sorted(
+            {e["args"]["anomaly"] for e in tagged})
+        if ups and not tagged:
+            failures.append(
+                f"{len(ups)} replica_scale_up event(s) but none carries "
+                "the anomaly tag that motivated it")
+        if args.expect_scale_up and not ups:
+            failures.append(
+                "fleet leg expected the overload ramp to trigger a "
+                "replica_scale_up but none fired")
+    # (d) a valid replica_death forensics bundle naming the victim
+    if killed and args.telemetry_dir:
+        entries, index_problems = fr.read_index(args.telemetry_dir)
+        failures.extend(index_problems)
+        deaths = [e for e in entries
+                  if e.get("reason") == "replica_death"]
+        verdict["forensics_bundles"] = len(entries)
+        verdict["replica_death_bundles"] = len(deaths)
+        named = []
+        for e in deaths:
+            path = os.path.join(e["_dir"], e["file"])
+            problems = fr.validate_bundle(path)
+            if problems:
+                failures.append(
+                    f"replica_death bundle {e['file']} invalid: "
+                    + "; ".join(problems[:3]))
+                continue
+            payload = fr.read_bundle(path)
+            if payload.get("extra", {}).get("replica") == victim:
+                named.append(e["file"])
+        if not deaths:
+            failures.append(
+                "replica kill fired but no replica_death forensics "
+                "bundle was dumped")
+        elif victim is not None and not named:
+            failures.append(
+                f"no replica_death bundle names the victim {victim}")
     return verdict, failures
 
 
@@ -348,6 +465,23 @@ def run_shared_prefix(args):
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--max-replicas", type=int, default=None,
+                    help="autoscaler ceiling (default: --replicas, i.e. "
+                         "no scale-up headroom); the fleet leg sets this "
+                         "above --replicas so the overload ramp provokes "
+                         "an anomaly-attributed replica_scale_up")
+    ap.add_argument("--fleet-spool", type=str, default=None,
+                    help="fleet spool directory (obs/fleet.py): every "
+                         "replica's counters are spooled per autoscale "
+                         "tick and once more with a terminal status at "
+                         "death/drain; adds the fleet criteria — counter "
+                         "conservation through the kill, stale/dead "
+                         "classification of the victim, anomaly-tagged "
+                         "scale-ups, and a valid replica_death forensics "
+                         "bundle (needs --telemetry-dir for the last two)")
+    ap.add_argument("--expect-scale-up", action="store_true",
+                    help="with --fleet-spool: fail unless the ramp "
+                         "actually triggered a replica_scale_up")
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--max-len", type=int, default=32)
     ap.add_argument("--max-prompt", type=int, default=6)
@@ -458,6 +592,7 @@ def main():
     ckpt_dir = tempfile.mkdtemp(prefix="ff_load_check_ckpt_")
     rs = ReplicaSet(
         build_model_fn(args), cfg, replicas=args.replicas,
+        max_replicas=args.max_replicas,
         ckpt_dir=ckpt_dir, fault_injector=fi,
         health_timeout_s=args.health_timeout_s,
         restart_backoff_s=0.1,
@@ -466,6 +601,7 @@ def main():
         # strategy search would starve the surviving replicas mid-ramp
         warm_spares=1,
         artifact_store=store,
+        fleet_spool_dir=args.fleet_spool,
     ).start()
 
     # jit warmup: run a few requests through every replica so the decode
@@ -473,23 +609,48 @@ def main():
     # warm phase — compile time is a cold-start cost, not serving latency,
     # and leaving it in would inflate the pre-ramp p99 the bound hangs off
     wrng = np.random.RandomState(args.seed + 1)
-    warmups = [rs.submit(wrng.randint(0, args.vocab,
-                                      int(wrng.randint(2, args.max_prompt + 1))
-                                      ).astype(np.int32),
+
+    def warm_req():
+        plen = int(wrng.randint(2, args.max_prompt + 1))
+        return rs.submit(wrng.randint(0, args.vocab, plen).astype(np.int32),
                          max_new_tokens=args.max_new, deadline_s=120.0)
-               for _ in range(2 * args.replicas * args.slots)]
+
+    n_warm = 2 * args.replicas * args.slots
+    if args.max_replicas and args.max_replicas > args.replicas:
+        # with scale-up headroom, the jit-warmup flood must stay below
+        # the autoscale queue threshold — a warmup-triggered scale-up
+        # would fire before the anomaly sentinel has any baseline, and
+        # the fleet criterion wants the RAMP's scale-up, blamed on a
+        # real anomaly
+        wave = max(1, rs.scale_up_queue_depth - 1)
+        warmups = []
+        for i in range(0, n_warm, wave):
+            batch = [warm_req() for _ in range(min(wave, n_warm - i))]
+            for w in batch:
+                w.wait(timeout=120.0)
+            warmups.extend(batch)
+    else:
+        warmups = [warm_req() for _ in range(n_warm)]
+    warm_completed = 0
     for w in warmups:
         w.wait(timeout=120.0)
+        try:
+            w.result(timeout=0.5)
+            warm_completed += 1
+        except BaseException:
+            pass  # shed warmups don't count toward conservation
     print("[load_check] warmup done, starting offered load",
           file=sys.stderr)
 
     records = []
     stop_evt = threading.Event()
     killed_evt = threading.Event()
+    kill_info = {}
     if args.no_kill:
         killed_evt.set()
     gen = threading.Thread(
-        target=offered_load, args=(rs, args, records, stop_evt, killed_evt, fi),
+        target=offered_load,
+        args=(rs, args, records, stop_evt, killed_evt, fi, kill_info),
         daemon=True,
     )
     t_run0 = time.monotonic()
@@ -628,6 +789,20 @@ def main():
         )
         summary["trace"] = verdict
         failures.extend(trace_failures)
+
+    # fleet criteria (with --fleet-spool): counter conservation through
+    # the kill, victim classification, anomaly-attributed scale-ups, and
+    # a valid replica_death forensics bundle. Judged after obs.finish()
+    # so events.jsonl is flushed.
+    if args.fleet_spool:
+        fleet_verdict, fleet_failures = verify_fleet(
+            args,
+            expected_requests=warm_completed + counts["completed"],
+            victim=kill_info.get("victim"),
+            killed=killed_evt.is_set() and not args.no_kill,
+        )
+        summary["fleet"] = fleet_verdict
+        failures.extend(fleet_failures)
 
     print(json.dumps(summary, indent=2, default=str))
     if args.json:

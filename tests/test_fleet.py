@@ -1,0 +1,548 @@
+"""Fleet observatory tests (flexflow_tpu/obs/fleet.py, obs/anomaly.py,
+obs/flight_recorder.py): spool atomicity + integrity, cross-process
+rollup semantics (counter conservation, gauge identity labels, histogram
+reservoir merge), staleness classification, the anomaly sentinel's
+warmup/hysteresis/false-positive guarantees, forensics bundle schema and
+the restart-surviving index, plus the `obs fleet` / `obs forensics` CLI
+round-trips. Pure obs-layer tests — no model build, no mesh."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import zlib
+
+import pytest
+
+import flexflow_tpu.obs as obs
+from flexflow_tpu.obs import flight_recorder as fr
+from flexflow_tpu.obs.anomaly import AnomalySentinel, GapDetector, \
+    SeriesDetector
+from flexflow_tpu.obs.fleet import (
+    FleetAggregator,
+    MetricSpool,
+    SpoolCorruptionError,
+    read_spool,
+)
+from flexflow_tpu.obs.metrics import (
+    MetricsRegistry,
+    merge_histogram_states,
+    parse_prometheus_labeled,
+)
+from flexflow_tpu.runtime.fault_domains import FaultDomainMap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_session():
+    obs.finish()
+    yield
+    obs.finish()
+
+
+def make_registry(requests=5.0, depth=3.0):
+    reg = MetricsRegistry()
+    reg.counter("ff_serving_requests_total",
+                help="serving requests answered").inc(requests)
+    reg.gauge("ff_serving_queue_depth").set(depth)
+    h = reg.histogram("ff_serving_latency_seconds")
+    for v in (0.1, 0.2, 0.3):
+        h.observe(v)
+    return reg
+
+
+# ---------------------------------------------------------------------
+# spool write/read
+# ---------------------------------------------------------------------
+
+def test_spool_write_read_roundtrip(tmp_path):
+    sp = MetricSpool(str(tmp_path), "proc-a", registry=make_registry(),
+                     replica="replica0", slice_id=1)
+    path = sp.write(health={"ok": True}, provenance={"sig": "abc"})
+    assert path.endswith("proc-a.spool.json")
+    payload = read_spool(path)
+    assert payload["process"] == "proc-a"
+    assert payload["replica"] == "replica0"
+    assert payload["slice"] == 1
+    assert payload["status"] == "live"
+    assert payload["health"] == {"ok": True}
+    names = {rec["name"] for rec in payload["series"]}
+    assert "ff_serving_requests_total" in names
+    # histograms carry full mergeable state, not a lossy summary
+    hist = next(r for r in payload["series"]
+                if r["name"] == "ff_serving_latency_seconds")
+    assert hist["kind"] == "histogram"
+    assert hist["state"]["count"] == 3
+
+
+def test_spool_corruption_detected(tmp_path):
+    sp = MetricSpool(str(tmp_path), "p", registry=make_registry())
+    path = sp.write()
+    env = json.load(open(path))
+    env["payload"]["series"][0]["value"] = 999.0  # crc now stale
+    json.dump(env, open(path, "w"))
+    with pytest.raises(SpoolCorruptionError, match="crc32"):
+        read_spool(path)
+    # the aggregator degrades, never throws: corrupt spool -> dead record
+    # with the error preserved, and the meta-series counts it
+    view = FleetAggregator(str(tmp_path)).aggregate()
+    rec = view.records[0]
+    assert rec.state == "dead" and "crc32" in rec.error
+    assert view.registry.find("ff_fleet_spools_corrupt").value == 1.0
+
+
+def test_spool_truncated_file_detected(tmp_path):
+    sp = MetricSpool(str(tmp_path), "p", registry=make_registry())
+    path = sp.write()
+    raw = open(path).read()
+    open(path, "w").write(raw[: len(raw) // 2])
+    with pytest.raises(SpoolCorruptionError):
+        read_spool(path)
+
+
+def test_spool_concurrent_writer_never_torn(tmp_path):
+    """os.replace keeps every read whole: a reader hammering the spool
+    while a writer rewrites it must never see a torn/corrupt file."""
+    sp = MetricSpool(str(tmp_path), "p", registry=make_registry())
+    sp.write()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            sp.write(health={"beat": i})
+            i += 1
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        for _ in range(300):
+            try:
+                payload = read_spool(sp.path)
+                assert payload["process"] == "p"
+            except SpoolCorruptionError as e:
+                errors.append(str(e))
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+    assert not errors, errors[:3]
+
+
+# ---------------------------------------------------------------------
+# aggregation semantics
+# ---------------------------------------------------------------------
+
+def test_counter_conservation_including_dead_spool(tmp_path):
+    """A killed process's terminal spool still contributes its tally:
+    the rollup conserves counts across the death."""
+    MetricSpool(str(tmp_path), "a", registry=make_registry(5)).write()
+    MetricSpool(str(tmp_path), "b", registry=make_registry(7)).write()
+    MetricSpool(str(tmp_path), "dead-c",
+                registry=make_registry(11)).write(status="dead")
+    view = FleetAggregator(str(tmp_path)).aggregate()
+    assert view.states()["dead-c"] == "dead"
+    assert view.counter_total("ff_serving_requests_total") == 23.0
+
+
+def test_gauges_keep_process_identity(tmp_path):
+    domains = FaultDomainMap.from_devices(8, 4).with_hosts(
+        {"a": 0, "b": 1})
+    MetricSpool(str(tmp_path), "a", registry=make_registry(depth=2),
+                replica="replica0").write()
+    MetricSpool(str(tmp_path), "b", registry=make_registry(depth=9),
+                replica="replica1").write()
+    view = FleetAggregator(str(tmp_path),
+                           fault_domains=domains).aggregate()
+    a = view.registry.find("ff_serving_queue_depth", process="a",
+                           replica="replica0", slice="0")
+    b = view.registry.find("ff_serving_queue_depth", process="b",
+                           replica="replica1", slice="1")
+    assert a is not None and a.value == 2.0
+    assert b is not None and b.value == 9.0
+
+
+def test_histogram_merge_across_spools(tmp_path):
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    for v in (0.1, 0.1, 0.1):
+        r1.histogram("ff_lat").observe(v)
+    for v in (5.0, 5.0, 5.0):
+        r2.histogram("ff_lat").observe(v)
+    MetricSpool(str(tmp_path), "a", registry=r1).write()
+    MetricSpool(str(tmp_path), "b", registry=r2).write()
+    view = FleetAggregator(str(tmp_path)).aggregate()
+    merged = view.registry.find("ff_lat")
+    assert merged.count == 6
+    # fleet percentiles span the union of both processes' samples
+    assert merged.quantile(0.1) <= 0.2
+    assert merged.quantile(0.9) >= 4.0
+
+
+def test_stale_and_dead_age_windows(tmp_path):
+    sp = MetricSpool(str(tmp_path), "p", registry=make_registry())
+    sp.write()
+    now = read_spool(sp.path)["unixtime"]
+    agg = FleetAggregator(str(tmp_path), staleness_s=10.0, death_s=30.0)
+    assert agg.scan(now=now + 1)[0].state == "live"
+    assert agg.scan(now=now + 11)[0].state == "stale"
+    assert agg.scan(now=now + 31)[0].state == "dead"
+
+
+def test_terminal_status_overrides_age(tmp_path):
+    """A fresh spool that declares status dead/exited classifies
+    immediately — no waiting out the staleness window."""
+    MetricSpool(str(tmp_path), "x",
+                registry=make_registry()).write(status="exited")
+    MetricSpool(str(tmp_path), "y",
+                registry=make_registry()).write(status="dead")
+    states = FleetAggregator(str(tmp_path)).aggregate().states()
+    assert states == {"x": "exited", "y": "dead"}
+
+
+def test_classify_slice_loss(tmp_path):
+    """Both processes of one slice stale -> the fleet page reads it as a
+    slice loss, not two unrelated hiccups."""
+    domains = FaultDomainMap.from_devices(8, 4).with_hosts(
+        {"a": 0, "b": 0, "c": 1, "d": 1})
+    for p in ("a", "b"):
+        MetricSpool(str(tmp_path), p,
+                    registry=make_registry()).write(status="dead")
+    for p in ("c", "d"):
+        MetricSpool(str(tmp_path), p, registry=make_registry()).write()
+    view = FleetAggregator(str(tmp_path),
+                           fault_domains=domains).aggregate()
+    assert view.classification is not None
+    assert view.classification.kind == "slice_loss"
+    assert view.classification.lost_slices == (0,)
+    assert view.registry.find("ff_fleet_lost_slices").value == 1.0
+
+
+def test_observe_into_feeds_gap_detectors(tmp_path):
+    sp = MetricSpool(str(tmp_path), "p", registry=make_registry())
+    sp.write()
+    now = read_spool(sp.path)["unixtime"]
+    agg = FleetAggregator(str(tmp_path), staleness_s=10.0)
+    sentinel = AnomalySentinel(emit=False)
+    agg.observe_into(sentinel, now=now + 1)  # fresh: quiet
+    assert sentinel.recent() == []
+    agg.observe_into(sentinel, now=now + 20)  # past staleness: fires
+    hits = sentinel.recent(series_prefix="heartbeat_gap:p")
+    assert len(hits) == 1 and hits[0].kind == "gap"
+
+
+def test_fleet_table_lists_processes(tmp_path):
+    MetricSpool(str(tmp_path), "p0", registry=make_registry(42),
+                replica="replica0").write()
+    table = FleetAggregator(str(tmp_path)).aggregate().table()
+    assert "p0" in table and "replica0" in table and "42" in table
+
+
+# ---------------------------------------------------------------------
+# metrics satellites: histogram merge + labeled prometheus round-trip
+# ---------------------------------------------------------------------
+
+def test_merge_histogram_states_units():
+    r = MetricsRegistry()
+    h = r.histogram("h")
+    for v in (0.1, 0.2):
+        h.observe(v)
+    s1 = h.state()
+    s2 = json.loads(json.dumps(s1))  # a serialization round-trip merges
+    merged = merge_histogram_states([s1, s2])
+    assert merged["count"] == 4
+    assert merged["sum"] == pytest.approx(0.6)
+    bad = dict(s2, buckets=[1.0, 2.0], counts=[1, 1])
+    with pytest.raises(ValueError, match="edges differ"):
+        merge_histogram_states([s1, bad])
+
+
+def test_parse_prometheus_labeled_roundtrip():
+    reg = MetricsRegistry()
+    reg.counter("ff_x_total", a="1", b="two").inc(3)
+    reg.counter("ff_x_total").inc(4)
+    reg.gauge("ff_g", process="p0").set(2.5)
+    series = parse_prometheus_labeled(reg.to_prometheus())
+    assert series[("ff_x_total", (("a", "1"), ("b", "two")))] == 3.0
+    assert series[("ff_x_total", ())] == 4.0
+    assert series[("ff_g", (("process", "p0"),))] == 2.5
+
+
+# ---------------------------------------------------------------------
+# anomaly sentinel
+# ---------------------------------------------------------------------
+
+def test_detector_warmup_never_fires():
+    det = SeriesDetector("s", warmup=8, hysteresis=1)
+    for i in range(7):
+        assert det.observe(0.0, now=float(i)) is None
+    # 8th sample is a huge spike but the window is still warming
+    assert det.observe(1000.0, now=7.5) is None
+
+
+def test_detector_spike_and_hysteresis():
+    det = SeriesDetector("s", warmup=8, hysteresis=2, min_delta=0.5)
+    for i in range(10):
+        det.observe(1.0, now=float(i))
+    # first breach arms hysteresis, second fires
+    assert det.observe(50.0, now=10.0) is None
+    a = det.observe(50.0, now=11.0)
+    assert a is not None and a.kind == "spike" and a.baseline == 1.0
+    assert a.tag == "s:spike"
+
+
+def test_detector_false_positive_bound():
+    """Stationary noise must not page anyone: a seeded random walk well
+    inside the z-threshold yields zero verdicts over 500 samples."""
+    import numpy as np
+
+    rng = np.random.RandomState(7)
+    det = SeriesDetector("s", warmup=8, hysteresis=2, min_delta=0.0)
+    fired = sum(
+        det.observe(10.0 + 0.5 * rng.randn(), now=float(i)) is not None
+        for i in range(500))
+    assert fired == 0
+
+
+def test_detector_min_delta_floor_on_constant_baseline():
+    """mad == 0 (exactly-constant history) defers to the absolute
+    min_delta floor: a +1 blip on an all-zero queue is not an incident,
+    a +8 jump is."""
+    det = SeriesDetector("s", warmup=4, hysteresis=1, min_delta=4.0)
+    for i in range(6):
+        det.observe(0.0, now=float(i))
+    assert det.observe(1.0, now=6.0) is None
+    a = det.observe(8.0, now=7.0)
+    assert a is not None and a.kind == "spike"
+
+
+def test_detector_direction_high_ignores_drops():
+    det = SeriesDetector("s", warmup=4, hysteresis=1, min_delta=1.0,
+                         direction="high")
+    for i in range(6):
+        det.observe(10.0, now=float(i))
+    assert det.observe(0.0, now=6.0) is None  # recovery, not an incident
+    assert det.observe(100.0, now=7.0) is not None
+
+
+def test_gap_detector_fires_and_cools_down():
+    det = GapDetector("hb", limit_s=5.0, cooldown_s=60.0)
+    assert det.observe(3.0, now=0.0) is None
+    a = det.observe(7.0, now=1.0)
+    assert a is not None and a.kind == "gap" and a.score > 1.0
+    # inside cooldown the still-open gap does not re-page
+    assert det.observe(9.0, now=2.0) is None
+
+
+def test_sentinel_history_blame_and_callback():
+    seen = []
+    s = AnomalySentinel(emit=False, on_anomaly=seen.append)
+    for i in range(10):
+        s.observe("queue", 0.0, now=float(i), warmup=4, hysteresis=1,
+                  min_delta=1.0)
+    s.observe("queue", 50.0, now=10.0)
+    assert len(seen) == 1
+    assert s.blame(now=11.0) == "queue:spike"
+    assert s.blame(now=1000.0, max_age_s=5.0) is None
+    assert s.recent(series_prefix="other") == []
+
+
+def test_sentinel_emits_counter_into_session(tmp_path):
+    from flexflow_tpu import TelemetryConfig
+
+    tel = obs.start(TelemetryConfig(dir=str(tmp_path / "tel")))
+    s = AnomalySentinel()
+    for i in range(10):
+        s.observe("q", 0.0, now=float(i), warmup=4, hysteresis=1,
+                  min_delta=1.0)
+    s.observe("q", 9.0, now=10.0)
+    found = tel.metrics.find("ff_anomalies_total", series="q",
+                             kind="spike")
+    assert found is not None and found.value == 1.0
+
+
+# ---------------------------------------------------------------------
+# flight recorder + forensics bundles
+# ---------------------------------------------------------------------
+
+def test_recorder_ring_bound_and_tracer_sink(tmp_path):
+    from flexflow_tpu.obs.tracer import Tracer
+
+    dropped = []
+    tracer = Tracer(max_events=5, on_drop=lambda n: dropped.append(n))
+    rec = fr.FlightRecorder(str(tmp_path), capacity=8)
+    tracer.add_sink(rec.record_event)
+    for i in range(20):
+        tracer.emit({"ts": float(i), "ph": "i", "name": f"e{i}",
+                     "cat": "test", "tid": 0, "args": {}})
+    # the trace file capped at 5, live drop counter saw the rest...
+    assert tracer.dropped == 15 and sum(dropped) == 15
+    # ...but the recorder's ring kept the freshest tail past the cap
+    snap = rec.snapshot()
+    assert len(snap["events"]) == 8
+    assert snap["events"][-1]["name"] == "e19"
+
+
+def test_dump_bundle_schema_validate_and_corruption(tmp_path):
+    rec = fr.FlightRecorder(str(tmp_path), process="t")
+    rec.record_metric("lat", 1.5)
+    rec.register_provider("pool", lambda: {"pages": 3})
+    path = rec.dump(reason="unit", error=RuntimeError("boom"),
+                    extra={"replica": "replica1"})
+    assert fr.validate_bundle(path) == []
+    payload = fr.read_bundle(path)
+    assert payload["reason"] == "unit"
+    assert payload["error"]["type"] == "RuntimeError"
+    assert payload["state"]["pool"] == {"pages": 3}
+    assert payload["extra"]["replica"] == "replica1"
+    entries, problems = fr.validate_dir(str(tmp_path))
+    assert len(entries) == 1 and problems == []
+    # flip one payload byte: crc catches it
+    env = json.load(open(path))
+    env["payload"]["reason"] = "tampered"
+    json.dump(env, open(path, "w"))
+    assert any("crc32" in p for p in fr.validate_bundle(path))
+    _, problems = fr.validate_dir(str(tmp_path))
+    assert problems
+
+
+def test_forensics_index_survives_restart(tmp_path):
+    rec = fr.install(str(tmp_path), process="run1")
+    rec.dump(reason="first")
+    fr.uninstall(rec)
+    rec2 = fr.install(str(tmp_path), process="run2")
+    rec2.dump(reason="second")
+    fr.uninstall(rec2)
+    entries, problems = fr.read_index(str(tmp_path))
+    assert problems == []
+    assert [e["reason"] for e in entries] == ["first", "second"]
+    # append-only index tolerates a truncated (crash mid-append) tail
+    idx = os.path.join(str(tmp_path), fr.FORENSICS_DIRNAME, fr.INDEX_FILE)
+    with open(idx, "a") as f:
+        f.write('{"unixtime": 1.0, "file": "trunc')
+    entries, problems = fr.read_index(str(tmp_path))
+    assert len(entries) == 2 and len(problems) == 1
+
+
+def test_maybe_dump_failure_typed_and_deduped(tmp_path):
+    class KVCacheExhaustedError(RuntimeError):
+        pass
+
+    rec = fr.install(str(tmp_path), process="t")
+    try:
+        exc = KVCacheExhaustedError("9 pages short")
+        first = fr.maybe_dump_failure(exc, request="r1")
+        assert first is not None
+        # the SAME exception propagating through another handler does
+        # not dump twice — it reports the bundle the first hook wrote
+        assert fr.maybe_dump_failure(exc) == first
+        # untyped failures stay silent
+        assert fr.maybe_dump_failure(ValueError("nope")) is None
+    finally:
+        fr.uninstall(rec)
+    entries, _ = fr.read_index(str(tmp_path))
+    assert len(entries) == 1
+    assert entries[0]["error_type"] == "KVCacheExhaustedError"
+
+
+def test_dump_without_recorder_is_noop():
+    assert fr.dump(reason="nobody-home") is None
+    assert obs.forensics_dump("nobody-home") is None
+
+
+# ---------------------------------------------------------------------
+# tracer drop counter + SLO replica label (satellites 1-2)
+# ---------------------------------------------------------------------
+
+def test_session_counts_dropped_trace_events(tmp_path):
+    from flexflow_tpu import TelemetryConfig
+
+    tel = obs.start(TelemetryConfig(dir=str(tmp_path / "tel"),
+                                    max_events=3))
+    for i in range(10):
+        obs.event(f"e{i}", cat="test")
+    found = tel.metrics.find("ff_trace_events_dropped_total")
+    assert found is not None and found.value >= 1.0
+    assert found.value == tel.tracer.dropped
+
+
+def test_slo_violations_carry_replica_label(tmp_path):
+    from flexflow_tpu import TelemetryConfig
+    from flexflow_tpu.obs.request_trace import SLOMonitor
+
+    tel = obs.start(TelemetryConfig(dir=str(tmp_path / "tel")))
+    mon = SLOMonitor(ttft_target_s=0.01)
+    mon.observe(ttft_s=0.5, replica="replica2")
+    mon.observe(ttft_s=0.5)  # back-compat: unlabeled without a replica
+    labeled = tel.metrics.find("ff_slo_violations_total", slo="ttft",
+                               replica="replica2")
+    plain = tel.metrics.find("ff_slo_violations_total", slo="ttft")
+    assert labeled is not None and labeled.value == 1.0
+    assert plain is not None and plain.value == 1.0
+    # the sentinel's p95 feed sees every ttft sample
+    assert mon.ttft.count == 2
+
+
+# ---------------------------------------------------------------------
+# CLI round-trips
+# ---------------------------------------------------------------------
+
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "flexflow_tpu.obs", *argv],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_cli_fleet_table_and_prom(tmp_path):
+    spool = tmp_path / "spool"
+    MetricSpool(str(spool), "p0", registry=make_registry(13),
+                replica="replica0").write()
+    MetricSpool(str(spool), "p1",
+                registry=make_registry(4)).write(status="exited")
+    prom = tmp_path / "fleet.prom"
+    res = _run_cli("fleet", str(spool), "--prom", str(prom))
+    assert res.returncode == 0, res.stderr
+    assert "p0" in res.stdout and "exited" in res.stdout
+    series = parse_prometheus_labeled(open(prom).read())
+    assert series[("ff_serving_requests_total", ())] == 17.0
+    assert series[("ff_fleet_processes", (("state", "live"),))] == 1.0
+
+
+def test_cli_fleet_exit_code_on_corrupt_spool(tmp_path):
+    spool = tmp_path / "spool"
+    sp = MetricSpool(str(spool), "p0", registry=make_registry())
+    sp.write()
+    open(sp.path, "w").write("{ nope")
+    res = _run_cli("fleet", str(spool))
+    assert res.returncode == 1
+    assert "CORRUPT" in res.stdout
+
+
+def test_cli_forensics_validate_show_and_corruption(tmp_path):
+    rec = fr.install(str(tmp_path), process="cli")
+    rec.record_metric("lat", 2.0)
+    path = rec.dump(reason="unit", extra={"replica": "replica0"})
+    fr.uninstall(rec)
+    res = _run_cli("forensics", str(tmp_path), "--validate")
+    assert res.returncode == 0, res.stderr
+    assert "0 problem(s)" in res.stdout
+    res = _run_cli("forensics", str(tmp_path), "--show", "latest")
+    assert res.returncode == 0, res.stderr
+    assert "reason:  unit" in res.stdout
+    env = json.load(open(path))
+    env["crc32"] = (env["crc32"] + 1) & 0xFFFFFFFF
+    json.dump(env, open(path, "w"))
+    res = _run_cli("forensics", str(tmp_path), "--validate")
+    assert res.returncode == 1
+    assert "crc32" in res.stdout
+
+
+def test_spool_crc_matches_canonical_bytes(tmp_path):
+    """The envelope crc is over canonical sorted-key JSON — the exact
+    bytes a reader recomputes, so equality is byte-precise."""
+    sp = MetricSpool(str(tmp_path), "p", registry=make_registry())
+    env = json.load(open(sp.write()))
+    canon = json.dumps(env["payload"], sort_keys=True,
+                       separators=(",", ":")).encode()
+    assert env["crc32"] == zlib.crc32(canon) & 0xFFFFFFFF
